@@ -1,0 +1,25 @@
+"""hlsjs_p2p_wrapper_tpu — a from-scratch, TPU-aware rebuild of the
+`hlsjs-p2p-wrapper` capability surface.
+
+What the reference is (see SURVEY.md §0): a browser integration layer
+wiring a closed-source WebRTC P2P segment-delivery agent into hls.js's
+fragment-loader seam, keeping ABR bandwidth estimation honest under
+mixed P2P/CDN delivery.  This package rebuilds that surface from
+scratch — including the P2P engine the reference outsources
+(SURVEY.md §2.10) — with the numeric hot paths (ABR estimation, swarm
+scheduling, swarm simulation) expressed as JAX ops that run on TPU.
+
+Layout:
+  core/      content addressing, loader state machine, session, facades
+  engine/    the in-tree P2P delivery engine (tracker, mesh, cache,
+             scheduler, CDN fallback, stats)
+  ops/       JAX/TPU numeric ops (EWMA estimator, scheduler scoring)
+  models/    learned-ABR policy model (flagship model for TPU training)
+  parallel/  SPMD swarm simulator over jax.sharding meshes
+  testing/   first-class fakes (sim player, mock CDN) — the reference's
+             test mocks promoted to supported tooling
+"""
+
+from .version import __version__, get_version
+
+__all__ = ["__version__", "get_version"]

@@ -1,0 +1,23 @@
+"""Core integration layer: content addressing, player bridges, loader,
+session lifecycle, and public facades."""
+
+from .clock import Clock, SystemClock, TimerHandle, VirtualClock
+from .errors import (ConfigurationError, LoaderError, MappingError,
+                     P2PWrapperError, PlayerStateError, SessionError,
+                     SetupSandboxError)
+from .events import EventEmitter, Events
+from .media_map import MediaMap
+from .request_setup import RequestStub, extract_info_from_request_setup
+from .segment_view import WIRE_SIZE, SegmentView
+from .track_view import TrackView
+from .utils import StaticProxyMeta, inherit_static_properties_readonly
+
+__all__ = [
+    "Clock", "SystemClock", "TimerHandle", "VirtualClock",
+    "ConfigurationError", "LoaderError", "MappingError", "P2PWrapperError",
+    "PlayerStateError", "SessionError", "SetupSandboxError",
+    "EventEmitter", "Events",
+    "MediaMap", "RequestStub", "extract_info_from_request_setup",
+    "WIRE_SIZE", "SegmentView", "TrackView",
+    "StaticProxyMeta", "inherit_static_properties_readonly",
+]

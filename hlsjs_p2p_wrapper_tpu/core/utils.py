@@ -1,0 +1,66 @@
+"""Static-property inheritance helpers.
+
+Rebuild of ``inheritStaticPropertiesReadOnly`` (lib/utils.js:3-19):
+the bundle facade must expose the wrapped player class's statics
+(events enum, error types, version, ...) read-only, excluding
+identity/machinery names and ``is_supported`` (which the bundle
+overrides — lib/hlsjs-p2p-bundle.js:49-60).
+"""
+
+from __future__ import annotations
+
+_SKIP = frozenset({
+    # Python class machinery (analogue of the reference's skip list
+    # ["prototype", "name", "length", "caller", "arguments"])
+    "__dict__", "__weakref__", "__module__", "__qualname__", "__doc__",
+    "__name__", "__init__", "__new__", "__slots__", "__annotations__",
+    # overridden by the bundle, like the reference skips "isSupported"
+    "is_supported", "isSupported",
+})
+
+
+class _ReadOnlyStatic:
+    """Class-level read-only proxy descriptor onto ``source.name``."""
+
+    def __init__(self, source: type, name: str):
+        self._source = source
+        self._name = name
+
+    def __get__(self, obj, objtype=None):
+        return getattr(self._source, self._name)
+
+    def __set__(self, obj, value):
+        raise AttributeError(f"static property '{self._name}' is read-only")
+
+
+class StaticProxyMeta(type):
+    """Metaclass making :class:`_ReadOnlyStatic` proxies immutable at
+    the class level (``Target.Events = x`` raises), since plain class
+    assignment would otherwise overwrite the descriptor."""
+
+    def __setattr__(cls, name, value):
+        current = cls.__dict__.get(name)
+        if isinstance(current, _ReadOnlyStatic):
+            raise AttributeError(f"static property '{name}' is read-only")
+        super().__setattr__(name, value)
+
+
+def inherit_static_properties_readonly(target: type, source: type) -> None:
+    """Expose ``source``'s public statics on ``target`` as read-only
+    proxies, without shadowing anything ``target`` already defines.
+    Only ``source``'s *own* statics are proxied (the analogue of the
+    reference's ``Object.getOwnPropertyNames`` walking static props,
+    lib/utils.js:15): plain functions (instance methods) are skipped so
+    the proxy never shadows methods ``target`` inherits from its own
+    bases.  For class-level write protection ``target`` should use
+    :class:`StaticProxyMeta` as its metaclass."""
+    import types
+
+    for name, value in vars(source).items():
+        if name in _SKIP or name.startswith("_"):
+            continue
+        if isinstance(value, types.FunctionType):
+            continue  # instance method, not a static
+        if name in target.__dict__:
+            continue  # target's own definition wins
+        type.__setattr__(target, name, _ReadOnlyStatic(source, name))

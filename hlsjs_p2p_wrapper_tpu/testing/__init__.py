@@ -1,0 +1,5 @@
+"""First-class test fakes (the reference's mocks, promoted)."""
+
+from .fixtures import DEFAULT_CONFIG, FakePlayer, make_fragments
+
+__all__ = ["DEFAULT_CONFIG", "FakePlayer", "make_fragments"]

@@ -1,0 +1,75 @@
+"""VirtualClock + EventEmitter behavior (rebuild-specific foundations)."""
+
+from hlsjs_p2p_wrapper_tpu.core import EventEmitter, Events, VirtualClock
+
+
+def test_virtual_clock_fires_in_order():
+    clock = VirtualClock()
+    fired = []
+    clock.call_later(30, lambda: fired.append("c"))
+    clock.call_later(10, lambda: fired.append("a"))
+    clock.call_later(20, lambda: fired.append("b"))
+    clock.advance(25)
+    assert fired == ["a", "b"]
+    assert clock.now() == 25
+    clock.advance(10)
+    assert fired == ["a", "b", "c"]
+
+
+def test_virtual_clock_cancel():
+    clock = VirtualClock()
+    fired = []
+    h = clock.call_later(10, lambda: fired.append("x"))
+    h.cancel()
+    clock.advance(20)
+    assert fired == []
+    assert h.cancelled and not h.fired
+
+
+def test_virtual_clock_nested_schedule():
+    clock = VirtualClock()
+    fired = []
+    clock.call_later(10, lambda: clock.call_later(5, lambda: fired.append("n")))
+    clock.advance(20)
+    assert fired == ["n"]
+
+
+def test_virtual_clock_fifo_at_equal_times():
+    clock = VirtualClock()
+    fired = []
+    clock.call_later(10, lambda: fired.append(1))
+    clock.call_later(10, lambda: fired.append(2))
+    clock.advance(10)
+    assert fired == [1, 2]
+
+
+def test_run_until_idle():
+    clock = VirtualClock()
+    fired = []
+    clock.call_later(100, lambda: fired.append(1))
+    clock.run_until_idle()
+    assert fired == [1]
+
+
+def test_emitter_on_off_once():
+    em = EventEmitter()
+    got = []
+    cb = lambda v: got.append(v)  # noqa: E731
+    em.on(Events.LEVEL_SWITCH, cb)
+    em.emit(Events.LEVEL_SWITCH, 1)
+    em.off(Events.LEVEL_SWITCH, cb)
+    em.emit(Events.LEVEL_SWITCH, 2)
+    assert got == [1]
+
+    em.once("custom", cb)
+    em.emit("custom", 3)
+    em.emit("custom", 4)
+    assert got == [1, 3]
+
+
+def test_emitter_enum_and_string_keys_interchangeable():
+    em = EventEmitter()
+    got = []
+    em.on(Events.ERROR.value, lambda: got.append(1))
+    em.emit(Events.ERROR)
+    assert got == [1]

@@ -1,0 +1,82 @@
+"""MediaMap unit tests (parity with reference test/media-map.js)."""
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core import (MappingError, MediaMap, SegmentView,
+                                        TrackView)
+from hlsjs_p2p_wrapper_tpu.testing import FakePlayer
+
+
+def make_map(level_count=3, live=False, defined_level=0, empty_level=True):
+    return MediaMap(FakePlayer(level_count, live, defined_level, empty_level))
+
+
+# --- get_segment_time (media-map.js:14-19 / test/media-map.js:7-43) ---
+
+def test_get_segment_time_returns_time():
+    mm = make_map()
+    sv = SegmentView(sn=30, track_view=TrackView(level=0, url_id=0), time=300.0)
+    assert mm.get_segment_time(sv) == 300.0
+
+
+def test_get_segment_time_undefined_raises():
+    mm = make_map()
+    sv = SegmentView(sn=30, track_view=TrackView(level=0, url_id=0))
+    with pytest.raises(MappingError):
+        mm.get_segment_time(sv)
+
+
+# --- get_segment_list (media-map.js:27-54 / test/media-map.js:45-124) ---
+
+def test_segment_list_window_intersection():
+    mm = make_map()
+    track = TrackView(level=0, url_id=0)
+    # fragments: sn in [25,200), start = sn*10
+    segs = mm.get_segment_list(track, 250.0, 30.0)
+    assert [s.sn for s in segs] == [25, 26, 27, 28]  # inclusive both ends
+    assert all(s.track_view == track for s in segs)
+    assert [s.time for s in segs] == [250.0, 260.0, 270.0, 280.0]
+
+
+def test_segment_list_window_before_timeline_empty():
+    mm = make_map()
+    assert mm.get_segment_list(TrackView(level=0, url_id=0), 0.0, 100.0) == []
+
+
+def test_segment_list_unparsed_level_returns_empty():
+    mm = make_map(level_count=3, live=None)  # no level gets details
+    assert mm.get_segment_list(TrackView(level=1, url_id=0), 250.0, 30.0) == []
+
+
+def test_segment_list_missing_level_raises():
+    mm = make_map(level_count=3)
+    with pytest.raises(MappingError):
+        mm.get_segment_list(TrackView(level=7, url_id=0), 250.0, 30.0)
+
+
+def test_segment_list_no_master_playlist_raises():
+    mm = make_map(level_count=0)
+    with pytest.raises(MappingError):
+        mm.get_segment_list(TrackView(level=0, url_id=0), 250.0, 30.0)
+
+
+# --- get_track_list (media-map.js:60-73 / test/media-map.js:126-137) ---
+
+def test_track_list_levels_times_url_ids():
+    mm = make_map(level_count=3)
+    tracks = mm.get_track_list()
+    assert len(tracks) == 6  # 3 levels x 2 redundant urls
+    assert {t.view_to_string() for t in tracks} == {
+        "L0U0", "L0U1", "L1U0", "L1U1", "L2U0", "L2U1"}
+
+
+def test_track_list_empty_before_master():
+    assert make_map(level_count=0).get_track_list() == []
+
+
+# --- get_segment_duration (media-map.js:75-87) ---
+
+def test_segment_duration_first_fragment():
+    mm = make_map()
+    sv = SegmentView(sn=30, track_view=TrackView(level=0, url_id=0), time=300.0)
+    assert mm.get_segment_duration(sv) == 10.0

@@ -1,0 +1,79 @@
+"""Request-setup sandbox tests (parity with reference test/xhr-setup.js)."""
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core import (SetupSandboxError,
+                                        extract_info_from_request_setup)
+
+URL = "http://foo.bar/video/segment1.ts"
+
+
+def test_no_setup_returns_empty_headers_no_credentials():
+    headers, with_credentials = extract_info_from_request_setup(None, URL)
+    assert headers == {}
+    assert with_credentials is False
+
+
+def test_header_harvesting():
+    # reference: test/xhr-setup.js:38-47
+    def setup(req, url):
+        req.set_request_header("X-Session", "abc123")
+        req.set_request_header("Authorization", "Bearer t")
+
+    headers, _ = extract_info_from_request_setup(setup, URL)
+    assert headers == {"X-Session": "abc123", "Authorization": "Bearer t"}
+
+
+def test_camelcase_alias_and_credentials():
+    def setup(req, url):
+        req.setRequestHeader("A", "1")
+        req.with_credentials = True
+
+    headers, with_credentials = extract_info_from_request_setup(setup, URL)
+    assert headers == {"A": "1"}
+    assert with_credentials is True
+
+
+def test_url_passthrough():
+    # reference: test/xhr-setup.js:49-54
+    seen = {}
+
+    def setup(req, url):
+        seen["url"] = url
+
+    extract_info_from_request_setup(setup, URL)
+    assert seen["url"] == URL
+
+
+def test_headers_base_extended():
+    # reference: test/xhr-setup.js:56-63
+    def setup(req, url):
+        req.set_request_header("B", "2")
+
+    headers, _ = extract_info_from_request_setup(setup, URL, {"A": "1"})
+    assert headers == {"A": "1", "B": "2"}
+
+
+def test_forbidden_method_access_raises():
+    # reference: test/xhr-setup.js:5-21
+    def setup(req, url):
+        req.open("GET", url)
+
+    with pytest.raises(SetupSandboxError):
+        extract_info_from_request_setup(setup, URL)
+
+
+def test_forbidden_property_assignment_raises():
+    def setup(req, url):
+        req.onreadystatechange = lambda: None
+
+    with pytest.raises(SetupSandboxError):
+        extract_info_from_request_setup(setup, URL)
+
+
+def test_user_exception_wrapped():
+    def setup(req, url):
+        raise ValueError("boom")
+
+    with pytest.raises(SetupSandboxError):
+        extract_info_from_request_setup(setup, URL)

@@ -1,0 +1,72 @@
+"""SegmentView unit tests (parity with reference test/segment-view.js)."""
+
+import json
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core import WIRE_SIZE, SegmentView, TrackView
+
+
+def make_sv(sn=42, level=1, url_id=0, time=420.0):
+    return SegmentView(sn=sn, track_view=TrackView(level=level, url_id=url_id),
+                       time=time)
+
+
+def test_json_round_trip():
+    # reference: test/segment-view.js:5-11 — ctor re-wraps plain objects
+    sv = make_sv()
+    payload = json.loads(json.dumps({
+        "sn": sv.sn,
+        "track_view": {"level": sv.track_view.level, "url_id": sv.track_view.url_id},
+        "time": sv.time,
+    }))
+    rt = SegmentView(payload)
+    assert rt.is_equal(sv)
+    assert isinstance(rt.track_view, TrackView)
+
+
+def test_wire_round_trip_is_12_bytes():
+    # reference: segment-view.js:9-17,59-61 — Uint32Array[level,urlId,sn]
+    sv = make_sv(sn=1337, level=3, url_id=1)
+    buf = sv.to_bytes()
+    assert isinstance(buf, bytes) and len(buf) == WIRE_SIZE == 12
+    rt = SegmentView.from_bytes(buf)
+    assert rt.is_equal(sv)
+    assert rt.track_view.level == 3 and rt.track_view.url_id == 1 and rt.sn == 1337
+
+
+def test_wire_format_layout_little_endian():
+    buf = make_sv(sn=2, level=0, url_id=1).to_bytes()
+    assert buf == (0).to_bytes(4, "little") + (1).to_bytes(4, "little") + (2).to_bytes(4, "little")
+
+
+def test_time_excluded_from_equality():
+    # reference: segment-view.js:33-39 — time is advisory
+    assert make_sv(time=1.0).is_equal(make_sv(time=999.0))
+
+
+@pytest.mark.parametrize("sn,level,url_id,expect", [
+    (42, 1, 0, True),
+    (43, 1, 0, False),
+    (42, 2, 0, False),
+    (42, 1, 1, False),
+])
+def test_is_equal_matrix(sn, level, url_id, expect):
+    assert make_sv().is_equal(make_sv(sn=sn, level=level, url_id=url_id)) is expect
+
+
+def test_is_equal_none():
+    assert not make_sv().is_equal(None)
+
+
+def test_is_in_track():
+    sv = make_sv(level=1, url_id=0)
+    assert sv.is_in_track(TrackView(level=1, url_id=0))
+    assert not sv.is_in_track(TrackView(level=1, url_id=1))
+    assert not sv.is_in_track(None)
+
+
+def test_view_to_string_and_id():
+    sv = make_sv(sn=7, level=2, url_id=1)
+    assert sv.view_to_string() == "L2U1S7"
+    assert sv.get_id() == 7

@@ -1,0 +1,51 @@
+"""TrackView unit tests (parity with reference test/track-view.js:4-41)."""
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core import TrackView
+
+
+def test_equality_same_ids():
+    a = TrackView(level=1, url_id=2)
+    b = TrackView(level=1, url_id=2)
+    assert a.is_equal(b) and b.is_equal(a)
+    assert a == b
+
+
+@pytest.mark.parametrize("level,url_id", [(0, 2), (1, 0), (3, 4)])
+def test_inequality(level, url_id):
+    a = TrackView(level=1, url_id=2)
+    b = TrackView(level=level, url_id=url_id)
+    assert not a.is_equal(b)
+    assert a != b
+
+
+def test_is_equal_none_tolerant():
+    assert not TrackView(level=0, url_id=0).is_equal(None)
+
+
+def test_view_to_string_unique_and_formatted():
+    seen = set()
+    for level in range(4):
+        for url_id in range(4):
+            s = TrackView(level=level, url_id=url_id).view_to_string()
+            assert s == f"L{level}U{url_id}"
+            assert s not in seen
+            seen.add(s)
+
+
+def test_type_is_video():
+    # Required by the agent's async loading path (reference CHANGELOG.md:37)
+    assert TrackView(level=0, url_id=0).type == "video"
+
+
+def test_construct_from_mapping_and_object():
+    a = TrackView({"level": 2, "url_id": 1})
+    b = TrackView({"level": 2, "urlId": 1})  # camelCase tolerated
+    c = TrackView(a)
+    assert a == b == c
+
+
+def test_hashable():
+    assert len({TrackView(level=0, url_id=0), TrackView(level=0, url_id=0),
+                TrackView(level=0, url_id=1)}) == 2
