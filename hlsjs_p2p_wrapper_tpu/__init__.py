@@ -20,6 +20,7 @@ Layout:
              test mocks promoted to supported tooling
 """
 
+from .core import P2PBundle, P2PWrapper
 from .version import __version__, get_version
 
-__all__ = ["__version__", "get_version"]
+__all__ = ["P2PBundle", "P2PWrapper", "__version__", "get_version"]

@@ -1,23 +1,31 @@
 """Core integration layer: content addressing, player bridges, loader,
 session lifecycle, and public facades."""
 
+from .bundle import P2PBundle
 from .clock import Clock, SystemClock, TimerHandle, VirtualClock
 from .errors import (ConfigurationError, LoaderError, MappingError,
                      P2PWrapperError, PlayerStateError, SessionError,
                      SetupSandboxError)
 from .events import EventEmitter, Events
+from .loader import LoaderState, p2p_loader_generator
 from .media_map import MediaMap
+from .player_interface import PlayerInterface
 from .request_setup import RequestStub, extract_info_from_request_setup
 from .segment_view import WIRE_SIZE, SegmentView
+from .session import P2PSessionManager
 from .track_view import TrackView
 from .utils import StaticProxyMeta, inherit_static_properties_readonly
+from .wrapper import P2PWrapper
 
 __all__ = [
+    "P2PBundle", "P2PWrapper", "P2PSessionManager",
     "Clock", "SystemClock", "TimerHandle", "VirtualClock",
     "ConfigurationError", "LoaderError", "MappingError", "P2PWrapperError",
     "PlayerStateError", "SessionError", "SetupSandboxError",
     "EventEmitter", "Events",
-    "MediaMap", "RequestStub", "extract_info_from_request_setup",
+    "LoaderState", "p2p_loader_generator",
+    "MediaMap", "PlayerInterface",
+    "RequestStub", "extract_info_from_request_setup",
     "WIRE_SIZE", "SegmentView", "TrackView",
     "StaticProxyMeta", "inherit_static_properties_readonly",
 ]

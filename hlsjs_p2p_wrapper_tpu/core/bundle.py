@@ -1,0 +1,55 @@
+"""Batteries-included bundle facade.
+
+Rebuild of ``StreamrootHlsjsBundle`` (lib/hlsjs-p2p-bundle.js:24-72):
+where the wrapper takes the player class by dependency injection, the
+bundle ships one — constructing :class:`P2PBundle` returns a fully
+wired player instance (the reference's constructor-returns-instance
+shim, bundle.js:25-29), statics are inherited read-only from the
+bundled player class (bundle.js:36-39), and ``is_supported`` is
+overridden with the bundle's own environment gating (bundle.js:49-60,
+where the reference excludes Safari/mobile by user agent).
+"""
+
+from __future__ import annotations
+
+import platform
+
+from .utils import StaticProxyMeta, inherit_static_properties_readonly
+from .wrapper import P2PWrapper
+from ..player import SimPlayer
+
+
+class P2PBundle(metaclass=StaticProxyMeta):
+    """``P2PBundle(player_config, p2p_config)`` → wired player."""
+
+    #: runtimes the bundle refuses to run on (the reference's
+    #: Safari/mobile exclusion analog; extend per deployment)
+    UNSUPPORTED_RUNTIMES: frozenset = frozenset()
+
+    def __new__(cls, player_config=None, p2p_config=None):
+        # Inject the bundled player class, create and bootstrap an
+        # instance — callers get the player, not the bundle object
+        return P2PWrapper(cls.bundled_player_class()).create_player(
+            player_config, p2p_config)
+
+    @classmethod
+    def bundled_player_class(cls):
+        return SimPlayer
+
+    @classmethod
+    def is_supported(cls) -> bool:
+        """Own feature detection overriding the player's
+        (bundle.js:49-60)."""
+        return (SimPlayer.is_supported()
+                and cls.get_runtime_name() not in cls.UNSUPPORTED_RUNTIMES)
+
+    @staticmethod
+    def get_runtime_name() -> str:
+        """Runtime identification (the ``getBrowserName`` analog,
+        bundle.js:68-70)."""
+        return platform.python_implementation()
+
+
+# Inherit the bundled player's statics read-only (Events enum,
+# DefaultConfig, ...) — bundle.js:36-39 via lib/utils.js:3-19
+inherit_static_properties_readonly(P2PBundle, SimPlayer)

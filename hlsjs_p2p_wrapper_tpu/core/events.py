@@ -54,7 +54,9 @@ class EventEmitter:
         key = _key(event)
         lst = self._listeners.get(key, [])
         for cb in list(lst):
-            if cb is listener or getattr(cb, "__wrapped__", None) is listener:
+            # equality, not identity: bound methods are re-created per
+            # attribute access, so `emitter.off(ev, obj.method)` must work
+            if cb == listener or getattr(cb, "__wrapped__", None) == listener:
                 lst.remove(cb)
 
     # Node-style alias used by PlayerInterface (player-interface.js:79)

@@ -1,0 +1,27 @@
+"""The in-tree P2P delivery engine.
+
+The reference outsources segment delivery to a closed-source module
+and only calls its contract (SURVEY.md §2.10); here the engine is
+in-tree: CDN transport + CDN-only agent (this milestone), then
+tracker signaling, peer mesh, segment cache, and deadline-aware
+scheduling (full P2P agent).
+"""
+
+from .cdn import CdnTransport, HttpCdnTransport, slice_for_range
+from .cdn_agent import CdnOnlyAgent, StreamTypes
+from .stats import AgentStats
+
+
+def default_agent_class():
+    """The engine the public facade wires by default: the full P2P
+    agent once built; until then the CDN-only engine."""
+    try:
+        from .agent import PeerAgent
+        return PeerAgent
+    except ImportError:
+        return CdnOnlyAgent
+
+
+__all__ = ["CdnTransport", "HttpCdnTransport", "slice_for_range",
+           "CdnOnlyAgent", "StreamTypes", "AgentStats",
+           "default_agent_class"]

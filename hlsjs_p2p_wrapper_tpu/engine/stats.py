@@ -1,0 +1,32 @@
+"""Engine transfer statistics.
+
+The public stats surface the reference exposes from its closed-source
+agent: ``{cdn, p2p, upload, peers}`` byte/peer counters
+(lib/hlsjs-p2p-wrapper.js:14-18, README.md:230-237).
+"""
+
+from __future__ import annotations
+
+
+class AgentStats:
+    """Cumulative transfer counters, read-only to consumers."""
+
+    def __init__(self):
+        self.cdn = 0     # bytes fetched from origin
+        self.p2p = 0     # bytes fetched from peers
+        self.upload = 0  # bytes served to peers
+        self.peers = 0   # currently connected peers
+
+    def as_dict(self) -> dict:
+        return {"cdn": self.cdn, "p2p": self.p2p, "upload": self.upload,
+                "peers": self.peers}
+
+    @property
+    def offload_ratio(self) -> float:
+        """Fraction of downloaded bytes that came from peers — the
+        repo-native north-star metric (BASELINE.json)."""
+        total = self.cdn + self.p2p
+        return self.p2p / total if total else 0.0
+
+    def __repr__(self) -> str:
+        return f"AgentStats({self.as_dict()})"

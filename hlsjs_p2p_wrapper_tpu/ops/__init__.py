@@ -1,0 +1,2 @@
+"""TPU-side numeric ops (JAX): batched ABR estimation, swarm
+scheduling scores."""

@@ -1,0 +1,8 @@
+"""The in-tree simulated media engine (hls.js-analog L0 layer)."""
+
+from .manifest import (Frag, LevelSpec, Manifest, make_vod_manifest,
+                       segment_size_bytes)
+from .sim import MediaElementSim, SimPlayer
+
+__all__ = ["Frag", "LevelSpec", "Manifest", "make_vod_manifest",
+           "segment_size_bytes", "MediaElementSim", "SimPlayer"]

@@ -1,0 +1,72 @@
+"""HLS manifest model for the simulated media engine.
+
+The reference reads hls.js's parsed playlist state
+(``hls.levels[..].details.fragments`` — SURVEY.md §2.9); this module
+is the rebuild's equivalent parsed-manifest representation plus
+helpers to synthesize multi-bitrate VOD/live timelines for tests,
+demos, and the swarm simulator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class Frag:
+    """One media segment on a level's timeline."""
+
+    sn: int
+    start: float
+    duration: float
+    url: str
+    level: int = 0
+    byte_range_start_offset: Optional[int] = None
+    byte_range_end_offset: Optional[int] = None
+
+
+@dataclass
+class LevelSpec:
+    """One quality level: bitrate + primary/redundant playlist URLs +
+    fragment timeline."""
+
+    bitrate: int
+    urls: List[str]
+    fragments: List[Frag] = field(default_factory=list)
+
+
+@dataclass
+class Manifest:
+    levels: List[LevelSpec]
+    live: bool = False
+
+    @property
+    def duration(self) -> float:
+        frags = self.levels[0].fragments
+        return frags[-1].start + frags[-1].duration if frags else 0.0
+
+
+def make_vod_manifest(level_bitrates=(300_000, 800_000, 2_000_000),
+                      frag_count: int = 60, seg_duration: float = 4.0,
+                      base_url: str = "http://cdn.example",
+                      first_sn: int = 0, live: bool = False,
+                      redundant: bool = False) -> Manifest:
+    """Synthesize an aligned multi-bitrate timeline.  Segment payload
+    sizes implied by bitrate: ``bitrate * seg_duration / 8`` bytes."""
+    levels = []
+    for li, bitrate in enumerate(level_bitrates):
+        urls = [f"{base_url}/{li}/0/playlist.m3u8"]
+        if redundant:
+            urls.append(f"{base_url}/{li}/1/playlist.m3u8")
+        frags = [Frag(sn=first_sn + i, start=(first_sn + i) * seg_duration,
+                      duration=seg_duration,
+                      url=f"{base_url}/{li}/seg{first_sn + i}.ts", level=li)
+                 for i in range(frag_count)]
+        levels.append(LevelSpec(bitrate=bitrate, urls=urls, fragments=frags))
+    return Manifest(levels=levels, live=live)
+
+
+def segment_size_bytes(level: LevelSpec, frag: Frag) -> int:
+    """Payload size implied by the level bitrate."""
+    return max(1, int(level.bitrate * frag.duration / 8))

@@ -1,0 +1,310 @@
+"""Simulated media engine (the in-tree L0 player).
+
+The reference integrates against hls.js, an external dependency that
+owns ABR, the stream controller, and the media buffer (SURVEY.md §1
+L0).  This rebuild is self-contained, so it ships a deterministic
+player with the same integration surface the wrapper layer consumes:
+
+- ``levels`` with ``details.fragments`` / ``url`` / ``url_id``
+- ``config`` dict honoring the forced defaults and instantiating
+  ``config["f_loader"]`` once per fragment (the fLoader seam,
+  wrapper-private.js:82-86)
+- the :class:`~..core.events.Events` bus (MANIFEST_LOADING,
+  LEVEL_SWITCH, MEDIA_ATTACHING, DESTROYING, ERROR, ...)
+- hls.js-shaped dynamics: ABR via the in-tree dual-EWMA estimator,
+  buffer-length-bounded fetching, playback/rebuffer accounting, seek
+
+Driven entirely by an injectable clock: on a VirtualClock it powers
+the e2e tests (the reference's karma tier) and the swarm simulator;
+on a SystemClock it plays "in real time".
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import List, Optional
+
+from ..core.abr import AbrController, compute_frag_last_kbps
+from ..core.clock import Clock, SystemClock
+from ..core.events import EventEmitter, Events
+from .manifest import Manifest
+
+DEFAULT_CONFIG = {
+    "f_loader": None,
+    "loader": None,
+    "max_buffer_size": 60 * 1000 * 1000,
+    "max_buffer_length": 30,
+    "live_sync_duration": None,
+    "live_sync_duration_count": None,
+    "frag_load_timeout": 20_000,
+    "frag_load_max_retry": 6,
+    "frag_load_retry_delay": 1000,
+    "request_setup": None,
+    "clock": None,
+    "manifest": None,
+    "manifest_delay_ms": 30.0,
+    "autoplay": True,
+}
+
+TICK_MS = 100.0
+
+
+class Level:
+    """Runtime level state with the attribute surface MediaMap and
+    PlayerInterface read (url list, url_id, details.fragments)."""
+
+    def __init__(self, index: int, spec, live: bool):
+        self.index = index
+        self.bitrate = spec.bitrate
+        self.url = list(spec.urls)
+        self.url_id = 0
+        self.details = SimpleNamespace(
+            live=live, fragments=list(spec.fragments),
+            totalduration=sum(f.duration for f in spec.fragments))
+
+
+class MediaElementSim:
+    """Stand-in for the HTML media element handed to the agent."""
+
+    def __init__(self):
+        self.current_time = 0.0
+        self.paused = False
+
+
+class SimPlayer(EventEmitter):
+    """Deterministic hls.js-shaped media engine."""
+
+    Events = Events
+    DefaultConfig = dict(DEFAULT_CONFIG)
+
+    def __init__(self, config: Optional[dict] = None):
+        super().__init__()
+        self.config = dict(DEFAULT_CONFIG)
+        self.config.update(config or {})
+        self.clock: Clock = self.config.get("clock") or SystemClock()
+
+        self.url: Optional[str] = None
+        self.media: Optional[MediaElementSim] = None
+        self._manifest: Optional[Manifest] = None
+        self._levels: Optional[List[Level]] = None
+
+        self.abr = AbrController(self)
+        self.current_level = 0
+        self.frag_last_kbps = 0
+
+        self.buffer_end = 0.0          # contiguous buffer ahead of playhead
+        self.next_sn: Optional[int] = None
+        self.ended = False
+        self.destroyed = False
+        self.last_error = None
+
+        self.rebuffer_ms = 0.0         # stall time while playing
+        self.play_ms = 0.0
+        self.bytes_loaded = 0
+        self.frags_loaded = 0
+
+        self._loading = False
+        self._loader = None
+        self._tick_timer = None
+
+    # -- public surface (hls.js-shaped) --------------------------------
+    @property
+    def levels(self):
+        return self._levels
+
+    @property
+    def load_level(self) -> int:
+        return self.current_level
+
+    @property
+    def next_load_level(self) -> int:
+        return self.abr.next_level(self._levels) if self._levels else 0
+
+    @property
+    def buffer_length(self) -> float:
+        position = self.media.current_time if self.media else 0.0
+        return max(0.0, self.buffer_end - position)
+
+    @staticmethod
+    def is_supported() -> bool:
+        return True
+
+    def load_source(self, url: str, manifest: Optional[Manifest] = None) -> None:
+        self.url = url
+        if manifest is not None:
+            self._manifest = manifest
+        elif self.config.get("manifest") is not None:
+            self._manifest = self.config["manifest"]
+        else:
+            raise ValueError(
+                "SimPlayer needs a Manifest (pass to load_source or set "
+                "config['manifest'])")
+        self.emit(Events.MANIFEST_LOADING, {"url": url})
+        self.clock.call_later(self.config["manifest_delay_ms"],
+                              self._parse_manifest)
+
+    def attach_media(self, media: Optional[MediaElementSim] = None) -> None:
+        # media is set before the event fires: MEDIA_ATTACHING handlers
+        # read `player.media` (reference: wrapper-private.js:178-180)
+        self.media = media or MediaElementSim()
+        self.emit(Events.MEDIA_ATTACHING, {})
+        self._ensure_ticking()
+
+    def seek(self, t: float) -> None:
+        """Jump the playhead; drops the buffer and any in-flight
+        fragment, like a real player flushing on seek."""
+        if self.media is None:
+            raise RuntimeError("seek before attach_media")
+        self._abort_inflight()
+        self.media.current_time = t
+        self.buffer_end = t
+        self.next_sn = self._sn_for_time(t)
+        self.ended = False
+
+    def destroy(self) -> None:
+        self.emit(Events.DESTROYING, {})
+        self._abort_inflight()
+        if self._tick_timer is not None:
+            self._tick_timer.cancel()
+            self._tick_timer = None
+        self.destroyed = True
+        self.remove_all_listeners()
+
+    def trigger(self, event, *args) -> None:
+        self.emit(event, *args)
+
+    # -- internals ------------------------------------------------------
+    def _parse_manifest(self) -> None:
+        if self.destroyed:
+            return
+        manifest = self._manifest
+        self._levels = [Level(i, spec, manifest.live)
+                        for i, spec in enumerate(manifest.levels)]
+        self.next_sn = manifest.levels[0].fragments[0].sn \
+            if manifest.levels[0].fragments else None
+        self.emit(Events.MANIFEST_PARSED,
+                  {"levels": self._levels, "live": manifest.live})
+        for i in range(len(self._levels)):
+            self.emit(Events.LEVEL_LOADED, {"level": i})
+        self._ensure_ticking()
+
+    def _ensure_ticking(self) -> None:
+        if self._tick_timer is None and not self.destroyed:
+            self._tick_timer = self.clock.call_later(TICK_MS, self._tick)
+
+    def _tick(self) -> None:
+        self._tick_timer = None
+        if self.destroyed:
+            return
+        self._advance_playback(TICK_MS)
+        self._maybe_fetch()
+        self._tick_timer = self.clock.call_later(TICK_MS, self._tick)
+
+    def _advance_playback(self, dt_ms: float) -> None:
+        if self.media is None or self.media.paused or self._levels is None:
+            return
+        dt_s = dt_ms / 1000.0
+        position = self.media.current_time
+        available = self.buffer_end - position
+        if available <= 0 and not self.ended:
+            self.rebuffer_ms += dt_ms
+            return
+        advance = min(dt_s, max(available, 0.0))
+        self.media.current_time = position + advance
+        self.play_ms += advance * 1000.0
+        if advance < dt_s and not self.ended:
+            self.rebuffer_ms += dt_ms * (1.0 - advance / dt_s)
+
+    def _frags(self, level_index: int):
+        return self._levels[level_index].details.fragments
+
+    def _sn_for_time(self, t: float) -> Optional[int]:
+        for frag in self._frags(self.current_level):
+            if frag.start + frag.duration > t:
+                return frag.sn
+        return None
+
+    def _frag_by_sn(self, level_index: int, sn: int):
+        for frag in self._frags(level_index):
+            if frag.sn == sn:
+                return frag
+        return None
+
+    def _maybe_fetch(self) -> None:
+        if (self._levels is None or self._loading or self.ended
+                or self.media is None or self.next_sn is None):
+            return
+        if self.buffer_length >= self.config["max_buffer_length"]:
+            return
+
+        next_level = self.abr.next_level(self._levels)
+        if next_level != self.current_level:
+            self.current_level = next_level
+            self.emit(Events.LEVEL_SWITCH, {"level": next_level})
+
+        frag = self._frag_by_sn(self.current_level, self.next_sn)
+        if frag is None:
+            self.ended = True
+            return
+
+        loader_cls = self.config.get("f_loader") or self.config.get("loader")
+        if loader_cls is None:
+            raise RuntimeError("SimPlayer has no fragment loader configured")
+
+        self._loading = True
+        self._loader = loader_cls(self.config)
+        self.emit(Events.FRAG_LOADING, {"frag": frag})
+        self.abr.on_frag_loading({"frag": frag})
+        self._loader.load(
+            frag.url, "arraybuffer",
+            lambda event, stats, f=frag: self._on_frag_loaded(f, event, stats),
+            lambda event, f=frag: self._on_frag_error(f, event),
+            lambda event, stats, f=frag: self._on_frag_timeout(f, event),
+            self.config["frag_load_timeout"],
+            self.config["frag_load_max_retry"],
+            self.config["frag_load_retry_delay"],
+            on_progress=lambda event, stats: None,
+            frag=frag)
+
+    def _on_frag_loaded(self, frag, event, stats) -> None:
+        if self.destroyed:
+            return
+        self._loading = False
+        self._loader = None
+        payload = event["current_target"]["response"]
+        stats["tbuffered"] = self.clock.now()
+        stats["length"] = len(payload) if payload is not None else stats.get(
+            "loaded", 0)
+        self.abr.on_frag_loaded({"frag": frag, "stats": stats})
+        self.frag_last_kbps = compute_frag_last_kbps(stats)
+        self.bytes_loaded += stats["length"]
+        self.frags_loaded += 1
+        self.buffer_end = frag.start + frag.duration
+        self.next_sn = frag.sn + 1
+        self.emit(Events.FRAG_LOADED, {"frag": frag, "stats": stats})
+        self.emit(Events.FRAG_BUFFERED, {"frag": frag, "stats": stats})
+
+    def _on_frag_error(self, frag, event) -> None:
+        if self.destroyed:
+            return
+        self._loading = False
+        self._loader = None
+        self.last_error = event
+        self.emit(Events.ERROR, {"type": "networkError",
+                                 "details": "fragLoadError", "fatal": True,
+                                 "frag": frag, "event": event})
+
+    def _on_frag_timeout(self, frag, event) -> None:
+        if self.destroyed:
+            return
+        self._abort_inflight()
+        self.last_error = {"timeout": True}
+        self.emit(Events.ERROR, {"type": "networkError",
+                                 "details": "fragLoadTimeOut", "fatal": False,
+                                 "frag": frag})
+
+    def _abort_inflight(self) -> None:
+        if self._loader is not None:
+            self._loader.abort()
+            self._loader = None
+        self._loading = False

@@ -1,5 +1,7 @@
 """First-class test fakes (the reference's mocks, promoted)."""
 
 from .fixtures import DEFAULT_CONFIG, FakePlayer, make_fragments
+from .mock_cdn import MockCdnTransport, serve_manifest, synthetic_payload
 
-__all__ = ["DEFAULT_CONFIG", "FakePlayer", "make_fragments"]
+__all__ = ["DEFAULT_CONFIG", "FakePlayer", "make_fragments",
+           "MockCdnTransport", "serve_manifest", "synthetic_payload"]

@@ -9,10 +9,17 @@ hardware.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's TPU-tunnel site hook (sitecustomize) re-forces its
+# own platform through jax.config at import time, overriding the env
+# var — push it back to CPU before any test touches devices.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

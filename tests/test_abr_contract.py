@@ -1,0 +1,113 @@
+"""Tier-2 ABR contract tests.
+
+Parity with reference test/hls-controllers.js: the numbers there were
+asserted against hls.js's *real* AbrController/StreamController; here
+the estimator is in-tree, so the same numbers pin OUR player-side
+model — which is what the loader's stat shaping must keep honest.
+"""
+
+import numpy as np
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core.abr import (AbrController,
+                                            EwmaBandwidthEstimator,
+                                            compute_frag_last_kbps)
+
+
+def test_bandwidth_estimate_from_loaded_fragment_stats():
+    # reference: test/hls-controllers.js:13-34 — 128,000 B in 1 s
+    # → ≈1,024,000 bps ± 4,000
+    abr = AbrController()
+    now = 10_000.0
+    frag = {"url": "http://foo.bar/foo", "level": 1}
+    stats = {"trequest": now - 1000.0, "tload": now, "loaded": 128_000}
+
+    abr.on_frag_loading({"frag": frag})
+    abr.on_frag_loaded({"frag": frag, "stats": stats})
+
+    assert abr.bw_estimator.get_estimate() == pytest.approx(1_024_000, abs=4_000)
+    assert abr.last_loaded_frag_level == 1
+
+
+def test_frag_last_kbps_after_buffered_fragment():
+    # reference: test/hls-controllers.js:48-78 — ≈1024 kbps ± 8
+    now = 10_000.0
+    stats = {"trequest": now - 1000.0, "tfirst": now - 1000.0,
+             "tbuffered": now, "loaded": 128_000, "length": 128_000}
+    assert compute_frag_last_kbps(stats) == pytest.approx(1024, abs=8)
+
+
+def test_estimator_default_before_samples():
+    est = EwmaBandwidthEstimator(default_estimate_bps=5e5)
+    assert est.get_estimate() == 5e5
+
+
+def test_estimator_converges_and_fast_tracks_drops():
+    est = EwmaBandwidthEstimator()
+    for _ in range(20):
+        est.sample(1000.0, 128_000)  # steady 1.024 Mbps
+    steady = est.get_estimate()
+    assert steady == pytest.approx(1_024_000, rel=0.01)
+    # bandwidth drops 8x; min(fast, slow) must react downward quickly
+    for _ in range(3):
+        est.sample(1000.0, 16_000)
+    assert est.get_estimate() < steady * 0.7
+
+
+def test_min_duration_clamp():
+    # "instant" P2P cache hits must not produce infinite bandwidth
+    est = EwmaBandwidthEstimator()
+    est.sample(0.0, 128_000)
+    assert est.get_estimate() == pytest.approx(8000.0 * 128_000 / 50.0)
+
+
+def test_next_level_selection():
+    abr = AbrController()
+    levels = [{"bitrate": 300_000}, {"bitrate": 800_000}, {"bitrate": 2_000_000}]
+    # default estimate 500kbps * 0.8 safety = 400k → level 0
+    assert abr.next_level(levels) == 0
+    abr.bw_estimator.sample(1000.0, 128_000)  # ~1.024 Mbps
+    assert abr.next_level(levels) == 1
+    for _ in range(10):
+        abr.bw_estimator.sample(1000.0, 1_000_000)  # 8 Mbps
+    assert abr.next_level(levels) == 2
+
+
+def test_jax_parity_with_python_estimator():
+    """ops/ewma.py must match core/abr.py sample-for-sample."""
+    import jax.numpy as jnp
+
+    from hlsjs_p2p_wrapper_tpu.ops import ewma as jewma
+
+    rng = np.random.default_rng(0)
+    T, B = 50, 4
+    durations = rng.uniform(20.0, 3000.0, size=(T, B))
+    nbytes = rng.integers(1_000, 2_000_000, size=(T, B))
+
+    # python online references, one per batch lane
+    py = [EwmaBandwidthEstimator() for _ in range(B)]
+    py_out = np.zeros((T, B))
+    for t in range(T):
+        for b in range(B):
+            py[b].sample(durations[t, b], int(nbytes[t, b]))
+            py_out[t, b] = py[b].get_estimate()
+
+    state = jewma.init_state(B, dtype=jnp.float64 if jnp.zeros(
+        1).dtype == jnp.float64 else jnp.float32)
+    _, jax_out = jewma.scan_samples(state, jnp.asarray(durations, jnp.float32),
+                                    jnp.asarray(nbytes, jnp.float32))
+    np.testing.assert_allclose(np.asarray(jax_out), py_out, rtol=1e-3)
+
+
+def test_jax_no_sample_mask_keeps_state():
+    import jax.numpy as jnp
+
+    from hlsjs_p2p_wrapper_tpu.ops import ewma as jewma
+
+    state = jewma.init_state(2)
+    state = jewma.update(state, jnp.array([1000.0, 1000.0]),
+                         jnp.array([128_000.0, 0.0]))
+    est = jewma.get_estimate(state)
+    assert float(est[0]) == pytest.approx(1_024_000, rel=1e-4)
+    # lane 1 had no sample → default estimate
+    assert float(est[1]) == pytest.approx(5e5)

@@ -1,0 +1,130 @@
+"""Bundle + full-stack e2e tests — the reference's karma tier
+(test/html/bundle.js) on a VirtualClock: real playback through the
+wrapper with the CDN-only engine, seek, and ABR under shaping."""
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu import P2PBundle, P2PWrapper
+from hlsjs_p2p_wrapper_tpu.core import Events, VirtualClock
+from hlsjs_p2p_wrapper_tpu.engine import CdnOnlyAgent
+from hlsjs_p2p_wrapper_tpu.player import SimPlayer, make_vod_manifest
+from hlsjs_p2p_wrapper_tpu.testing import MockCdnTransport, serve_manifest
+
+
+def make_session(bandwidth_bps=None, level_bitrates=(300_000, 800_000, 2_000_000),
+                 user_player_config=None):
+    clock = VirtualClock()
+    manifest = make_vod_manifest(level_bitrates=level_bitrates,
+                                 frag_count=40, seg_duration=4.0)
+    cdn = MockCdnTransport(clock, latency_ms=10.0, bandwidth_bps=bandwidth_bps)
+    serve_manifest(cdn, manifest)
+    wrapper = P2PWrapper(SimPlayer, CdnOnlyAgent, clock=clock)
+    player_config = {"clock": clock, "manifest": manifest,
+                     **(user_player_config or {})}
+    p2p_config = {"cdn_transport": cdn, "clock": clock}
+    player = wrapper.create_player(player_config, p2p_config)
+    player.load_source("http://cdn.example/master.m3u8")
+    player.attach_media()
+    return clock, player, wrapper, cdn
+
+
+# --- bundle facade (lib/hlsjs-p2p-bundle.js) --------------------------
+
+def test_bundle_constructor_returns_wired_player():
+    clock = VirtualClock()
+    manifest = make_vod_manifest()
+    cdn = MockCdnTransport(clock, latency_ms=10.0)
+    serve_manifest(cdn, manifest)
+    player = P2PBundle({"clock": clock, "manifest": manifest},
+                       {"cdn_transport": cdn, "clock": clock})
+    assert isinstance(player, SimPlayer)
+    assert player.config["max_buffer_size"] == 0  # forced defaults applied
+    player.load_source("http://cdn.example/master.m3u8")
+    player.attach_media()
+    clock.advance(5000)
+    assert player.media.current_time > 1.0
+
+
+def test_bundle_inherits_statics_readonly():
+    assert P2PBundle.Events is SimPlayer.Events
+    assert P2PBundle.DefaultConfig is SimPlayer.DefaultConfig
+    with pytest.raises(AttributeError):
+        P2PBundle.Events = None
+
+
+def test_bundle_overrides_is_supported():
+    assert P2PBundle.is_supported() is True
+    assert isinstance(P2PBundle.get_runtime_name(), str)
+
+
+# --- playback liveness (test/html/bundle.js:45-78) --------------------
+
+def test_playback_passes_one_second():
+    clock, player, wrapper, cdn = make_session()
+    clock.advance(5_000)
+    assert player.media.current_time > 1.0
+    assert wrapper.stats["cdn"] > 0
+
+
+def test_seek_completes_and_plays_past_target():
+    clock, player, wrapper, cdn = make_session()
+    clock.advance(5_000)
+    player.seek(30.0)
+    clock.advance(5_000)
+    assert player.media.current_time > 31.0
+
+
+# --- ABR under shaping (test/html/bundle.js:80-101) -------------------
+
+def test_abr_pins_to_lowest_level_under_64kbps():
+    clock, player, wrapper, cdn = make_session(bandwidth_bps=64_000.0)
+    clock.advance(120_000)
+    assert player.load_level == 0
+    assert player.next_load_level == 0
+
+
+def test_abr_climbs_with_ample_bandwidth():
+    clock, player, wrapper, cdn = make_session(bandwidth_bps=8_000_000.0)
+    clock.advance(120_000)
+    assert player.load_level == 2  # reached the top rendition
+    assert player.rebuffer_ms < 1_000
+
+
+def test_abr_settles_at_mid_level_for_mid_bandwidth():
+    # 1.2 Mbps: can't sustain the 2 Mbps top level, can sustain 800 kbps
+    clock, player, wrapper, cdn = make_session(bandwidth_bps=1_200_000.0)
+    clock.advance(180_000)
+    assert player.load_level == 1
+
+
+def test_playback_reaches_end_of_vod():
+    clock, player, wrapper, cdn = make_session(bandwidth_bps=8_000_000.0)
+    clock.advance(200_000)
+    assert player.ended
+    # 40 frags x 4 s = 160 s timeline fully played
+    assert player.media.current_time == pytest.approx(160.0, abs=0.5)
+
+
+def test_rebuffer_when_bandwidth_below_lowest_bitrate():
+    clock, player, wrapper, cdn = make_session(bandwidth_bps=100_000.0)
+    clock.advance(60_000)
+    # 100 kbps < 300 kbps lowest rendition → must have stalled
+    assert player.rebuffer_ms > 0
+    assert player.load_level == 0
+
+
+def test_bundle_loader_shares_player_timebase():
+    """Regression: the bundle passes no clock to the wrapper; the
+    generated loader must still resolve the *player's* clock, or load
+    durations are measured on wall time and the ABR estimate explodes."""
+    clock = VirtualClock()
+    manifest = make_vod_manifest(frag_count=10)
+    cdn = MockCdnTransport(clock, latency_ms=10.0, bandwidth_bps=64_000.0)
+    serve_manifest(cdn, manifest)
+    player = P2PBundle({"clock": clock, "manifest": manifest},
+                       {"cdn_transport": cdn, "clock": clock})
+    player.load_source("http://cdn.example/master.m3u8")
+    player.attach_media()
+    clock.advance(60_000)
+    assert player.load_level == 0  # 64 kbps can't carry 800 kbps renditions
+    assert player.abr.bw_estimator.get_estimate() < 100_000

@@ -1,0 +1,378 @@
+"""P2P loader state-machine tests.
+
+Covers the reference contract (lib/integration/p2p-loader-generator.js)
+plus the race scenarios its CHANGELOG documents as real bugs
+(CHANGELOG.md:76,95-96,146-147) — all deterministic on a VirtualClock.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core import LoaderError, VirtualClock
+from hlsjs_p2p_wrapper_tpu.core.abr import AbrController
+from hlsjs_p2p_wrapper_tpu.core.loader import (RETRY_DELAY_CEILING_MS,
+                                               LoaderState,
+                                               p2p_loader_generator)
+from hlsjs_p2p_wrapper_tpu.engine import CdnOnlyAgent, StreamTypes
+from hlsjs_p2p_wrapper_tpu.core.segment_view import SegmentView
+from hlsjs_p2p_wrapper_tpu.testing import FakePlayer
+from hlsjs_p2p_wrapper_tpu.testing.mock_cdn import MockCdnTransport
+
+
+class ScriptedAgent:
+    """Agent fake that records get_segment calls and lets tests drive
+    the callbacks by hand."""
+
+    def __init__(self):
+        self.calls = []
+        self.aborts = 0
+
+    def get_segment(self, req_info, callbacks, segment_view):
+        self.calls.append(SimpleNamespace(req_info=req_info,
+                                          callbacks=callbacks,
+                                          segment_view=segment_view))
+        agent = self
+
+        class Handle:
+            def abort(self):
+                agent.aborts += 1
+
+        return Handle()
+
+
+def make_frag(sn=30, level=0, start=300.0, byte_range=None):
+    frag = SimpleNamespace(sn=sn, level=level, start=start,
+                           byte_range_start_offset=None,
+                           byte_range_end_offset=None)
+    if byte_range:
+        frag.byte_range_start_offset, frag.byte_range_end_offset = byte_range
+    return frag
+
+
+class Harness:
+    def __init__(self, agent=None):
+        self.clock = VirtualClock()
+        self.agent = agent if agent is not None else ScriptedAgent()
+        self.player = FakePlayer(3, live=False)
+        wrapper = SimpleNamespace(peer_agent_module=self.agent,
+                                  player=self.player, clock=self.clock)
+        self.wrapper = wrapper
+        self.LoaderClass = p2p_loader_generator(wrapper)
+        self.events = {"success": [], "error": [], "timeout": [], "progress": []}
+
+    def load(self, loader=None, frag=None, timeout=20_000, max_retry=3,
+             retry_delay=500, config=None):
+        loader = loader or self.LoaderClass(config)
+        loader.load(
+            "http://cdn/seg30.ts", "arraybuffer",
+            lambda ev, stats: self.events["success"].append((ev, stats)),
+            lambda ev: self.events["error"].append(ev),
+            lambda ev, stats: self.events["timeout"].append((ev, stats)),
+            timeout, max_retry, retry_delay,
+            on_progress=lambda ev, stats: self.events["progress"].append((ev, dict(stats))),
+            frag=frag or make_frag())
+        return loader
+
+
+# --- guards (loader-generator.js:53-64) -------------------------------
+
+def test_requires_progress_callback():
+    h = Harness()
+    loader = h.LoaderClass(None)
+    with pytest.raises(LoaderError):
+        loader.load("u", "t", None, None, None, 1000, 1, 1, on_progress=None,
+                    frag=make_frag())
+
+
+def test_requires_frag():
+    h = Harness()
+    loader = h.LoaderClass(None)
+    with pytest.raises(LoaderError):
+        loader.load("u", "t", None, None, None, 1000, 1, 1,
+                    on_progress=lambda *a: None, frag=None)
+
+
+def test_requires_agent():
+    h = Harness()
+    h.wrapper.peer_agent_module = None
+    with pytest.raises(LoaderError):
+        h.load()
+
+
+def test_unfinalized_request_invariant():
+    h = Harness()
+    loader = h.load()
+    with pytest.raises(LoaderError):
+        loader._load_internal()  # second attempt without reset
+
+
+# --- request construction ---------------------------------------------
+
+def test_request_info_and_segment_view():
+    h = Harness()
+    h.load(frag=make_frag(sn=42, level=1, start=420.0))
+    call = h.agent.calls[0]
+    assert call.req_info["url"] == "http://cdn/seg30.ts"
+    assert call.req_info["headers"] == {}
+    assert call.req_info["with_credentials"] is False
+    assert isinstance(call.segment_view, SegmentView)
+    assert call.segment_view.sn == 42
+    assert call.segment_view.track_view.level == 1
+    assert call.segment_view.time == 420.0
+
+
+def test_byte_range_header_end_exclusive():
+    # loader-generator.js:142-144 — on-wire Range end is end-1
+    h = Harness()
+    h.load(frag=make_frag(byte_range=(100, 300)))
+    headers = h.agent.calls[0].req_info["headers"]
+    assert headers["Range"] == "bytes=100-299"
+
+
+def test_request_setup_harvested_into_headers():
+    h = Harness()
+    config = {"request_setup": lambda req, url: req.set_request_header("X-T", "1")}
+    h.load(config=config)
+    assert h.agent.calls[0].req_info["headers"] == {"X-T": "1"}
+
+
+# --- success / error / timeout ----------------------------------------
+
+def test_success_path_event_shim_and_stats():
+    h = Harness()
+    loader = h.load()
+    h.clock.advance(250)
+    cb = h.agent.calls[0].callbacks
+    cb["on_progress"]({"cdn_downloaded": 128_000, "p2p_downloaded": 0,
+                       "cdn_duration": 250, "p2p_duration": 0})
+    cb["on_success"](b"\x00" * 128_000)
+    (event, stats), = h.events["success"]
+    assert event["current_target"]["response"] == b"\x00" * 128_000
+    assert stats["loaded"] == 128_000
+    assert stats["trequest"] <= stats["tfirst"] <= stats["tload"]
+    assert loader.state is LoaderState.DONE
+
+
+def test_retry_exponential_backoff_and_exhaustion():
+    h = Harness()
+    h.load(max_retry=3, retry_delay=500)
+    # attempt 1 fails
+    h.agent.calls[0].callbacks["on_error"]({"status": 503})
+    assert len(h.agent.calls) == 1
+    h.clock.advance(500)  # retry 1 after 500ms
+    assert len(h.agent.calls) == 2
+    h.agent.calls[1].callbacks["on_error"]({"status": 503})
+    h.clock.advance(999)
+    assert len(h.agent.calls) == 2  # backoff doubled to 1000ms
+    h.clock.advance(1)
+    assert len(h.agent.calls) == 3
+    h.agent.calls[2].callbacks["on_error"]({"status": 503})
+    h.clock.advance(2000)
+    assert len(h.agent.calls) == 4
+    # final failure after max_retry exhausted → XHR-shaped error event
+    h.agent.calls[3].callbacks["on_error"]({"status": 503})
+    h.clock.advance(10_000)
+    assert len(h.agent.calls) == 4
+    assert h.events["error"] == [{"target": {"status": 503}}]
+
+
+def test_retry_delay_ceiling():
+    h = Harness()
+    loader = h.load(max_retry=20, retry_delay=50_000)
+    h.agent.calls[0].callbacks["on_error"]({"status": 500})
+    assert loader.retry_delay == RETRY_DELAY_CEILING_MS  # min(2*50000, 64000)
+    h.clock.advance(50_000)
+    h.agent.calls[1].callbacks["on_error"]({"status": 500})
+    assert loader.retry_delay == RETRY_DELAY_CEILING_MS
+
+
+def test_timeout_fires_when_no_response():
+    h = Harness()
+    h.load(timeout=8000)
+    h.clock.advance(7999)
+    assert h.events["timeout"] == []
+    h.clock.advance(1)
+    assert len(h.events["timeout"]) == 1
+
+
+def test_timeout_cancelled_on_success():
+    h = Harness()
+    h.load(timeout=8000)
+    h.agent.calls[0].callbacks["on_success"](b"x")
+    h.clock.advance(10_000)
+    assert h.events["timeout"] == []
+
+
+# --- abort races (CHANGELOG.md:76,95-96,146-147) ----------------------
+
+def test_abort_swallows_late_success_and_error():
+    h = Harness()
+    loader = h.load()
+    cb = h.agent.calls[0].callbacks
+    loader.abort()
+    assert h.agent.aborts == 1
+    cb["on_success"](b"late")
+    cb["on_error"]({"status": 500})
+    assert h.events["success"] == []
+    assert h.events["error"] == []
+    assert loader.state is LoaderState.ABORTED
+
+
+def test_abort_does_not_start_retry_loop():
+    # reference CHANGELOG 2.0.2: "Fix retry loop on download abort"
+    h = Harness()
+    loader = h.load(max_retry=5, retry_delay=100)
+    loader.abort()
+    h.agent.calls[0].callbacks["on_error"]({"status": 500})
+    h.clock.advance(60_000)
+    assert len(h.agent.calls) == 1  # no retry attempts ever started
+
+
+def test_retry_timer_survives_attempt_reset():
+    # the reset(cancel_retry=False) subtlety (loader-generator.js:39-50)
+    h = Harness()
+    h.load(max_retry=2, retry_delay=300)
+    h.agent.calls[0].callbacks["on_error"]({"status": 500})
+    # attempt-level reset ran; retry timer must still fire
+    h.clock.advance(300)
+    assert len(h.agent.calls) == 2
+
+
+def test_destroy_aborts():
+    h = Harness()
+    loader = h.load()
+    loader.destroy()
+    assert h.agent.aborts == 1
+
+
+# --- ABR stat shaping (loader-generator.js:167-204) -------------------
+
+def test_progress_sums_cdn_and_p2p():
+    h = Harness()
+    h.load()
+    cb = h.agent.calls[0].callbacks
+    cb["on_progress"]({"cdn_downloaded": 1000, "p2p_downloaded": 2000,
+                       "cdn_duration": 10, "p2p_duration": 20})
+    _, stats = h.events["progress"][0]
+    assert stats["loaded"] == 3000
+
+
+def test_instant_p2p_backdates_trequest_and_fakes_rtt():
+    h = Harness()
+    h.clock.advance(5000)
+    h.load()
+    # P2P bytes arrive "instantly" (cache hit): engine reports the real
+    # transfer time it measured upstream
+    cb = h.agent.calls[0].callbacks
+    cb["on_progress"]({"cdn_downloaded": 0, "p2p_downloaded": 128_000,
+                       "cdn_duration": 0, "p2p_duration": 1000})
+    _, stats = h.events["progress"][0]
+    now = h.clock.now()
+    assert stats["trequest"] == now - 1000  # back-dated by sr_time
+    assert stats["tfirst"] == stats["trequest"] + 10  # min(500, 10) fake RTT
+    # resulting bandwidth ≈ 8*128000/1s ≈ 1.024 Mbps, not infinite
+
+
+def test_cdn_only_progress_keeps_real_timing():
+    h = Harness()
+    h.load()
+    trequest = h.clock.now()
+    h.clock.advance(400)
+    cb = h.agent.calls[0].callbacks
+    cb["on_progress"]({"cdn_downloaded": 64_000, "p2p_downloaded": 0,
+                       "cdn_duration": 400, "p2p_duration": 0})
+    _, stats = h.events["progress"][0]
+    assert stats["trequest"] == trequest  # untouched
+    assert stats["tfirst"] == h.clock.now()
+
+
+def test_tfirst_set_only_on_first_progress():
+    h = Harness()
+    h.load()
+    cb = h.agent.calls[0].callbacks
+    cb["on_progress"]({"cdn_downloaded": 0, "p2p_downloaded": 64_000,
+                       "cdn_duration": 0, "p2p_duration": 500})
+    _, first = h.events["progress"][0]
+    h.clock.advance(1000)
+    cb["on_progress"]({"cdn_downloaded": 64_000, "p2p_downloaded": 64_000,
+                       "cdn_duration": 1000, "p2p_duration": 500})
+    _, second = h.events["progress"][1]
+    assert second["tfirst"] == first["tfirst"]
+    assert second["loaded"] == 128_000
+
+
+def test_small_sr_time_fake_rtt_is_half():
+    h = Harness()
+    h.clock.advance(100)
+    h.load()
+    cb = h.agent.calls[0].callbacks
+    cb["on_progress"]({"cdn_downloaded": 0, "p2p_downloaded": 1000,
+                       "cdn_duration": 0, "p2p_duration": 8})
+    _, stats = h.events["progress"][0]
+    assert stats["tfirst"] - stats["trequest"] == 4  # min(round(8/2), 10)
+
+
+# --- end-to-end: loader + CDN-only agent + ABR estimator --------------
+
+def make_agent_harness(bandwidth_bps=None, latency_ms=20.0):
+    clock = VirtualClock()
+    cdn = MockCdnTransport(clock, latency_ms=latency_ms,
+                           bandwidth_bps=bandwidth_bps)
+    player = FakePlayer(3, live=False)
+    agent = CdnOnlyAgent(None, "http://cdn/master.m3u8", None,
+                         {"cdn_transport": cdn, "clock": clock},
+                         SegmentView, StreamTypes.HLS, "v2")
+    wrapper = SimpleNamespace(peer_agent_module=agent, player=player,
+                              clock=clock)
+    return clock, cdn, agent, p2p_loader_generator(wrapper)
+
+
+def test_e2e_cdn_fetch_feeds_estimator_within_1pct():
+    """The karma contract: estimator agrees with hand-computed
+    bandwidth within 1% under shaping
+    (reference: test/html/p2p-loader-generator.js:96-100)."""
+    bandwidth = 512_000.0  # 512 kbps shaping
+    clock, cdn, agent, LoaderClass = make_agent_harness(
+        bandwidth_bps=bandwidth, latency_ms=0.0)
+    abr = AbrController()
+    done = {}
+
+    def on_success(event, stats):
+        abr.on_frag_loaded({"frag": {"level": 0}, "stats": stats})
+        done["stats"] = dict(stats)
+
+    loader = LoaderClass(None)
+    loader.load("http://cdn/seg.ts", "arraybuffer", on_success,
+                lambda ev: pytest.fail(f"error {ev}"),
+                lambda ev, stats: pytest.fail("timeout"),
+                60_000, 0, 500,
+                on_progress=lambda ev, stats: None, frag=make_frag())
+    clock.run_until_idle()
+
+    stats = done["stats"]
+    assert stats["loaded"] == 128_000
+    assert stats["trequest"] < stats["tfirst"] <= stats["tload"]
+    hand_computed = 8000.0 * stats["loaded"] / (stats["tload"] - stats["trequest"])
+    estimate = abr.bw_estimator.get_estimate()
+    assert abs(estimate - hand_computed) / hand_computed < 0.01
+    # shaped to 512 kbps → estimate must be ≈ the shaping rate
+    assert estimate == pytest.approx(bandwidth, rel=0.05)
+    assert agent.stats["cdn"] == 128_000
+
+
+def test_e2e_error_status_propagates():
+    # reference: test/html/p2p-loader-generator.js:106-137 (404 path)
+    clock, cdn, agent, LoaderClass = make_agent_harness()
+    cdn.responses["http://cdn/missing.ts"] = 404
+    errors = []
+    loader = LoaderClass(None)
+    loader.load("http://cdn/missing.ts", "arraybuffer",
+                lambda ev, stats: pytest.fail("unexpected success"),
+                lambda ev: errors.append(ev),
+                lambda ev, stats: pytest.fail("timeout"),
+                60_000, 1, 100,
+                on_progress=lambda ev, stats: None, frag=make_frag())
+    clock.run_until_idle()
+    assert errors == [{"target": {"status": 404}}]
+    assert cdn.fetch_count == 2  # initial + 1 retry

@@ -1,0 +1,100 @@
+"""PlayerInterface tests (parity with reference test/player-interface.js
+plus the buffer-policy and event-gating contract)."""
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core import (ConfigurationError, Events,
+                                        PlayerInterface, PlayerStateError,
+                                        TrackView)
+from hlsjs_p2p_wrapper_tpu.testing import FakePlayer
+
+
+def make_pi(player, on_dispose=lambda: None):
+    return PlayerInterface(player, Events, on_dispose)
+
+
+# --- is_live tri-state (player-interface.js:31-43) --------------------
+
+def test_is_live_true():
+    assert make_pi(FakePlayer(3, live=True)).is_live() is True
+
+
+def test_is_live_false():
+    assert make_pi(FakePlayer(3, live=False)).is_live() is False
+
+
+def test_is_live_before_master_playlist_raises():
+    with pytest.raises(PlayerStateError):
+        make_pi(FakePlayer(0)).is_live()
+
+
+def test_is_live_before_level_playlist_raises():
+    with pytest.raises(PlayerStateError):
+        make_pi(FakePlayer(3, live=None)).is_live()
+
+
+# --- buffer policy (player-interface.js:45-66) ------------------------
+
+def test_buffer_level_max_prefers_live_sync_duration():
+    player = FakePlayer(3, live=True)
+    player.config["live_sync_duration"] = 30
+    player.config["max_buffer_length"] = 10
+    assert make_pi(player).get_buffer_level_max() == 30
+
+
+def test_buffer_level_max_falls_back_to_max_buffer_length():
+    player = FakePlayer(3, live=False)
+    player.config["live_sync_duration"] = None
+    player.config["max_buffer_length"] = 25
+    assert make_pi(player).get_buffer_level_max() == 25
+
+
+def test_buffer_level_max_negative_raises():
+    player = FakePlayer(3, live=False)
+    player.config["live_sync_duration"] = None
+    player.config["max_buffer_length"] = -1
+    with pytest.raises(ConfigurationError):
+        make_pi(player).get_buffer_level_max()
+
+
+def test_set_buffer_margin_live_writes_player_config():
+    player = FakePlayer(3, live=True)
+    make_pi(player).set_buffer_margin_live(12)
+    assert player.config["max_buffer_size"] == 0
+    assert player.config["max_buffer_length"] == 12
+
+
+# --- track-change events (player-interface.js:15-20,68-82) ------------
+
+def test_level_switch_emits_track_change():
+    player = FakePlayer(3, live=False)
+    pi = make_pi(player)
+    got = []
+    pi.add_event_listener("onTrackChange", got.append)
+    player.emit(Events.LEVEL_SWITCH, {"level": 2})
+    assert len(got) == 1
+    assert got[0]["video"] == TrackView(level=2, url_id=0)
+
+
+def test_listener_gating_ignores_other_events():
+    pi = make_pi(FakePlayer(3))
+    pi.add_event_listener("onPeerConnect", lambda e: None)  # silently ignored
+    assert pi.listener_count("onPeerConnect") == 0
+
+
+def test_remove_event_listener():
+    player = FakePlayer(3)
+    pi = make_pi(player)
+    got = []
+    pi.add_event_listener("onTrackChange", got.append)
+    pi.remove_event_listener("onTrackChange", got.append)
+    player.emit(Events.LEVEL_SWITCH, {"level": 1})
+    assert got == []
+
+
+def test_destroying_triggers_dispose():
+    player = FakePlayer(3)
+    disposed = []
+    make_pi(player, on_dispose=lambda: disposed.append(1))
+    player.emit(Events.DESTROYING, {})
+    assert disposed == [1]

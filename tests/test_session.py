@@ -1,0 +1,210 @@
+"""Session core + wrapper facade tests (parity with reference
+test/api.js and the §2.6 lifecycle/config contract)."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu import P2PWrapper, get_version
+from hlsjs_p2p_wrapper_tpu.core import (ConfigurationError, Events,
+                                        P2PSessionManager, SessionError,
+                                        VirtualClock)
+from hlsjs_p2p_wrapper_tpu.core.segment_view import SegmentView
+from hlsjs_p2p_wrapper_tpu.engine import CdnOnlyAgent
+from hlsjs_p2p_wrapper_tpu.player import SimPlayer, make_vod_manifest
+from hlsjs_p2p_wrapper_tpu.testing import MockCdnTransport, serve_manifest
+
+
+class RecordingAgent(CdnOnlyAgent):
+    constructed = []
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        RecordingAgent.constructed.append(self)
+
+
+@pytest.fixture(autouse=True)
+def clear_constructed():
+    RecordingAgent.constructed = []
+
+
+def make_player_cls(clock, manifest, cdn):
+    class Player(SimPlayer):
+        Events = Events
+
+        def __init__(self, config=None):
+            config = dict(config or {})
+            config.setdefault("clock", clock)
+            config.setdefault("manifest", manifest)
+            super().__init__(config)
+    return Player
+
+
+def make_env(**agent_cfg):
+    clock = VirtualClock()
+    manifest = make_vod_manifest()
+    cdn = MockCdnTransport(clock, latency_ms=5.0)
+    serve_manifest(cdn, manifest)
+    player_cls = make_player_cls(clock, manifest, cdn)
+    p2p_config = {"cdn_transport": cdn, "clock": clock,
+                  "content_id": "test-content", **agent_cfg}
+    return clock, manifest, cdn, player_cls, p2p_config
+
+
+# --- DI requirements ---------------------------------------------------
+
+def test_requires_agent_di():
+    with pytest.raises(SessionError):
+        P2PSessionManager(SimPlayer, None)
+
+
+def test_version():
+    assert P2PWrapper.version() == get_version()
+    assert P2PSessionManager.version() == get_version()
+
+
+# --- config forcing/guards (wrapper-private.js:80-91,145-158) ----------
+
+def test_forced_config_defaults():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    player = sm.create_player({}, p2p_config)
+    assert player.config["max_buffer_size"] == 0
+    assert player.config["max_buffer_length"] == 30
+    assert player.config["live_sync_duration"] == 30
+    assert player.config["f_loader"] is not None
+
+
+def test_user_config_wins_over_defaults():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    player = sm.create_player({"max_buffer_length": 60}, p2p_config)
+    assert player.config["max_buffer_length"] == 60
+
+
+def test_user_f_loader_forbidden():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    with pytest.raises(ConfigurationError):
+        sm.create_player({"f_loader": object}, p2p_config)
+
+
+def test_live_sync_duration_dropped_when_count_set():
+    # CHANGELOG 3.9.1 behavior (wrapper-private.js:154-156)
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    player = sm.create_player({"live_sync_duration_count": 3}, p2p_config)
+    assert player.config["live_sync_duration"] is None  # player default kept
+
+
+def test_no_player_di_raises_on_creation():
+    sm = P2PSessionManager(None, RecordingAgent)
+    with pytest.raises(SessionError):
+        sm.new_media_engine({})
+
+
+# --- session lifecycle (wrapper-private.js:105-137,198-226) ------------
+
+def test_deferred_start_on_manifest_loading():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    player = sm.create_player({}, p2p_config)
+    assert not sm.has_session()
+    player.load_source("http://cdn.example/master.m3u8")
+    assert sm.has_session()  # MANIFEST_LOADING fired synchronously
+    agent = RecordingAgent.constructed[0]
+    assert agent.content_url == "http://cdn.example/master.m3u8"
+    assert agent.segment_view_class is SegmentView
+    assert agent.stream_type == RecordingAgent.StreamTypes.HLS
+    assert agent.integration_version == "v2"
+    assert agent.media_map is not None and agent.player_bridge is not None
+
+
+def test_single_session_invariant():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    player = sm.create_player({}, p2p_config)
+    player.load_source("http://cdn.example/master.m3u8")
+    with pytest.raises(SessionError):
+        sm.create_peer_agent(p2p_config, player, Events,
+                             "http://cdn.example/other.m3u8")
+
+
+def test_destroy_disposes_agent_and_allows_new_session():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    player = sm.create_player({}, p2p_config)
+    player.load_source("http://cdn.example/master.m3u8")
+    agent = RecordingAgent.constructed[0]
+    player.destroy()
+    assert agent.disposed
+    assert not sm.has_session()
+
+
+def test_media_element_handoff_now_or_on_attach():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    player = sm.create_player({}, p2p_config)
+    player.load_source("http://cdn.example/master.m3u8")
+    agent = RecordingAgent.constructed[0]
+    assert agent.media_element is None  # not attached yet
+    player.attach_media()
+    assert agent.media_element is player.media
+
+
+def test_create_peer_agent_requires_url():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    player = player_cls({})
+    with pytest.raises(SessionError):
+        sm.create_peer_agent(p2p_config, player, Events, None)
+
+
+def test_create_peer_agent_requires_events_enum():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    player = player_cls({})
+    with pytest.raises(SessionError):
+        sm.create_peer_agent(p2p_config, player, None, "http://u")
+
+
+def test_start_session_validates_p2p_config():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    with pytest.raises(ConfigurationError):
+        sm.start_session(player_cls({}), {}, None, "http://u")
+
+
+# --- legacy async path (wrapper-private.js:63-66) ----------------------
+
+def test_create_sr_module_folds_content_id():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    sm = P2PSessionManager(player_cls, RecordingAgent, clock=clock)
+    player = player_cls({"f_loader": None})
+    player.config["f_loader"] = sm.P2PLoader
+    player.url = "http://cdn.example/master.m3u8"
+    sm.create_sr_module(p2p_config, player, Events, content_id="cid-1")
+    agent = RecordingAgent.constructed[0]
+    assert agent.p2p_config["content_id"] == "cid-1"
+
+
+# --- facade passthrough (lib/hlsjs-p2p-wrapper.js:14-36) ---------------
+
+def test_facade_properties_before_session_raise():
+    wrapper = P2PWrapper(SimPlayer, RecordingAgent)
+    with pytest.raises(SessionError):
+        wrapper.stats
+    with pytest.raises(SessionError):
+        wrapper.p2p_download_on
+
+
+def test_facade_passthrough_after_session():
+    clock, manifest, cdn, player_cls, p2p_config = make_env()
+    wrapper = P2PWrapper(player_cls, RecordingAgent, clock=clock)
+    player = wrapper.create_player({}, p2p_config)
+    player.load_source("http://cdn.example/master.m3u8")
+    assert wrapper.stats == {"cdn": 0, "p2p": 0, "upload": 0, "peers": 0}
+    assert wrapper.p2p_download_on is True
+    wrapper.p2p_upload_on = False
+    assert RecordingAgent.constructed[0].p2p_upload_on is False
+    assert wrapper.has_session
