@@ -2,14 +2,17 @@
 
 The reference outsources segment delivery to a closed-source module
 and only calls its contract (SURVEY.md §2.10); here the engine is
-in-tree: CDN transport + CDN-only agent (this milestone), then
-tracker signaling, peer mesh, segment cache, and deadline-aware
-scheduling (full P2P agent).
+in-tree: CDN transport, wire protocol, transport/network model,
+tracker signaling, segment cache, peer mesh, deadline-aware
+scheduling, and the agents built from them.
 """
 
+from .cache import SegmentCache
 from .cdn import CdnTransport, HttpCdnTransport, slice_for_range
 from .cdn_agent import CdnOnlyAgent, StreamTypes
 from .stats import AgentStats
+from .tracker import Tracker, TrackerClient, TrackerEndpoint, swarm_id_for
+from .transport import Endpoint, LoopbackNetwork
 
 
 def default_agent_class():

@@ -1,0 +1,81 @@
+"""Segment cache.
+
+The reference's agent keeps delivered segments in a cache so they can
+be re-served to peers (the ``upload`` stat in its public surface,
+README.md:230-237); the implementation is closed source.  The
+rebuild's cache is an LRU over a byte budget, keyed by the canonical
+12-byte segment key (segment-view.js:59-61) so cache keys ARE wire
+keys — what a peer announces is exactly what it can serve.
+
+Eviction raises an ``on_evict`` callback so the owning agent can
+broadcast LOST and keep remote have-maps truthful.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, List, Optional
+
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024  # a few minutes of mid-bitrate video
+
+
+class SegmentCache:
+    """Byte-budgeted LRU of segment payloads."""
+
+    def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES,
+                 on_evict: Optional[Callable[[bytes], None]] = None):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be positive")
+        self.max_bytes = max_bytes
+        self.on_evict = on_evict
+        self._entries: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self.bytes_used = 0
+        self.hits = 0
+        self.misses = 0
+
+    def put(self, key: bytes, payload: bytes) -> None:
+        """Insert/refresh.  A payload larger than the whole budget is
+        refused silently — caching it would evict everything for one
+        unservable entry."""
+        key = bytes(key)
+        if len(payload) > self.max_bytes:
+            return
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.bytes_used -= len(old)
+        self._entries[key] = payload
+        self.bytes_used += len(payload)
+        while self.bytes_used > self.max_bytes:
+            evicted_key, evicted = self._entries.popitem(last=False)
+            self.bytes_used -= len(evicted)
+            if self.on_evict is not None:
+                self.on_evict(evicted_key)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        """Fetch + LRU-touch."""
+        payload = self._entries.get(bytes(key))
+        if payload is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(bytes(key))
+        self.hits += 1
+        return payload
+
+    def has(self, key: bytes) -> bool:
+        return bytes(key) in self._entries
+
+    def keys(self) -> List[bytes]:
+        """All cached keys, oldest first (the BITFIELD announce body)."""
+        return list(self._entries)
+
+    def remove(self, key: bytes) -> None:
+        payload = self._entries.pop(bytes(key), None)
+        if payload is not None:
+            self.bytes_used -= len(payload)
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self.bytes_used = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)

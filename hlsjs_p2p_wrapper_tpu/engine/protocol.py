@@ -1,0 +1,274 @@
+"""Swarm wire protocol.
+
+The reference's peer protocol lives inside the closed-source
+``streamroot-p2p`` module; the only part that is observable in-tree is
+its content-addressing wire format — the 12-byte
+``uint32[level, url_id, sn]`` segment key (reference:
+lib/integration/mapping/segment-view.js:9-17,59-61).  This module
+defines the rest from scratch: a compact binary framing for
+peer ⇄ peer and peer ⇄ tracker messages, built around that exact
+12-byte key so segment identity is bit-compatible with the reference's
+captures.
+
+Frame layout (little-endian throughout, like the JS ``Uint32Array``
+wire format it embeds)::
+
+    magic   u16 = 0x5350  ("SP")
+    version u8  = 1
+    type    u8
+    body    (type-specific)
+
+Strings are u16-length-prefixed UTF-8.  Segment keys are the raw
+12-byte SegmentView buffer.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+from ..core.segment_view import WIRE_SIZE, SegmentView
+
+MAGIC = 0x5350
+VERSION = 1
+_HEADER = struct.Struct("<HBB")
+
+
+class MsgType:
+    """Message type codes.  0x0x = peer ⇄ peer, 0x1x = peer ⇄ tracker."""
+
+    HELLO = 0x01      # handshake: swarm id + peer id
+    HAVE = 0x02       # "I now cache this segment"
+    BITFIELD = 0x03   # full have-map (sent after HELLO)
+    REQUEST = 0x04    # ask for a segment
+    CANCEL = 0x05     # withdraw a request
+    CHUNK = 0x06      # segment payload piece
+    DENY = 0x07       # request refused (miss / upload off / busy)
+    LOST = 0x08       # "segment evicted from my cache"
+    BYE = 0x09        # orderly departure
+    ANNOUNCE = 0x10   # tracker: join/refresh swarm membership
+    PEERS = 0x11      # tracker: current member list
+    LEAVE = 0x12      # tracker: orderly departure
+
+
+class DenyReason:
+    NOT_FOUND = 0
+    UPLOAD_OFF = 1
+    BUSY = 2
+
+
+@dataclass(frozen=True)
+class Hello:
+    swarm_id: str
+    peer_id: str
+
+
+@dataclass(frozen=True)
+class Have:
+    key: bytes  # 12-byte SegmentView buffer
+
+
+@dataclass(frozen=True)
+class Bitfield:
+    keys: Tuple[bytes, ...]
+
+
+@dataclass(frozen=True)
+class Request:
+    request_id: int
+    key: bytes
+
+
+@dataclass(frozen=True)
+class Cancel:
+    request_id: int
+
+
+@dataclass(frozen=True)
+class Chunk:
+    request_id: int
+    offset: int
+    total: int
+    payload: bytes
+
+
+@dataclass(frozen=True)
+class Deny:
+    request_id: int
+    reason: int
+
+
+@dataclass(frozen=True)
+class Lost:
+    key: bytes
+
+
+@dataclass(frozen=True)
+class Bye:
+    pass
+
+
+@dataclass(frozen=True)
+class Announce:
+    swarm_id: str
+    peer_id: str
+
+
+@dataclass(frozen=True)
+class Peers:
+    swarm_id: str
+    peer_ids: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class Leave:
+    swarm_id: str
+    peer_id: str
+
+
+class ProtocolError(ValueError):
+    """Malformed or unknown frame."""
+
+
+def _pack_str(s: str) -> bytes:
+    raw = s.encode("utf-8")
+    if len(raw) > 0xFFFF:
+        raise ProtocolError("string too long for wire format")
+    return struct.pack("<H", len(raw)) + raw
+
+
+def _unpack_str(buf: memoryview, off: int) -> Tuple[str, int]:
+    (n,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    if off + n > len(buf):
+        raise ProtocolError("truncated string field")
+    return bytes(buf[off:off + n]).decode("utf-8"), off + n
+
+
+def _check_key(key: bytes) -> bytes:
+    if len(key) != WIRE_SIZE:
+        raise ProtocolError(f"segment key must be {WIRE_SIZE} bytes")
+    return bytes(key)
+
+
+def encode(msg) -> bytes:
+    """Serialize a message dataclass to one wire frame."""
+    t = type(msg)
+    if t is Hello:
+        return _frame(MsgType.HELLO,
+                      _pack_str(msg.swarm_id) + _pack_str(msg.peer_id))
+    if t is Have:
+        return _frame(MsgType.HAVE, _check_key(msg.key))
+    if t is Bitfield:
+        body = struct.pack("<I", len(msg.keys)) + b"".join(
+            _check_key(k) for k in msg.keys)
+        return _frame(MsgType.BITFIELD, body)
+    if t is Request:
+        return _frame(MsgType.REQUEST,
+                      struct.pack("<I", msg.request_id) + _check_key(msg.key))
+    if t is Cancel:
+        return _frame(MsgType.CANCEL, struct.pack("<I", msg.request_id))
+    if t is Chunk:
+        return _frame(MsgType.CHUNK,
+                      struct.pack("<III", msg.request_id, msg.offset,
+                                  msg.total) + msg.payload)
+    if t is Deny:
+        return _frame(MsgType.DENY,
+                      struct.pack("<IB", msg.request_id, msg.reason))
+    if t is Lost:
+        return _frame(MsgType.LOST, _check_key(msg.key))
+    if t is Bye:
+        return _frame(MsgType.BYE, b"")
+    if t is Announce:
+        return _frame(MsgType.ANNOUNCE,
+                      _pack_str(msg.swarm_id) + _pack_str(msg.peer_id))
+    if t is Peers:
+        body = _pack_str(msg.swarm_id) + struct.pack("<H", len(msg.peer_ids))
+        body += b"".join(_pack_str(p) for p in msg.peer_ids)
+        return _frame(MsgType.PEERS, body)
+    if t is Leave:
+        return _frame(MsgType.LEAVE,
+                      _pack_str(msg.swarm_id) + _pack_str(msg.peer_id))
+    raise ProtocolError(f"cannot encode {t.__name__}")
+
+
+def _frame(msg_type: int, body: bytes) -> bytes:
+    return _HEADER.pack(MAGIC, VERSION, msg_type) + body
+
+
+def decode(frame: bytes):
+    """Parse one wire frame back into its message dataclass.  Every
+    malformed input raises :class:`ProtocolError` (struct underflows
+    are translated), so transport-facing dispatchers need exactly one
+    except clause."""
+    if len(frame) < _HEADER.size:
+        raise ProtocolError("frame shorter than header")
+    magic, version, msg_type = _HEADER.unpack_from(frame)
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic 0x{magic:04x}")
+    if version != VERSION:
+        raise ProtocolError(f"unsupported protocol version {version}")
+    body = memoryview(frame)[_HEADER.size:]
+    try:
+        return _decode_body(msg_type, body)
+    except struct.error as exc:
+        raise ProtocolError(f"truncated body: {exc}") from exc
+
+
+def _decode_body(msg_type: int, body: memoryview):
+    if msg_type == MsgType.HELLO:
+        swarm_id, off = _unpack_str(body, 0)
+        peer_id, _ = _unpack_str(body, off)
+        return Hello(swarm_id, peer_id)
+    if msg_type == MsgType.HAVE:
+        return Have(_check_key(bytes(body)))
+    if msg_type == MsgType.BITFIELD:
+        (count,) = struct.unpack_from("<I", body, 0)
+        # validate the declared count against the actual body BEFORE
+        # allocating: a forged count must not drive allocation size
+        if 4 + count * WIRE_SIZE != len(body):
+            raise ProtocolError("bitfield count/body size mismatch")
+        keys = tuple(bytes(body[4 + i * WIRE_SIZE:4 + (i + 1) * WIRE_SIZE])
+                     for i in range(count))
+        return Bitfield(keys)
+    if msg_type == MsgType.REQUEST:
+        (request_id,) = struct.unpack_from("<I", body, 0)
+        return Request(request_id, _check_key(bytes(body[4:])))
+    if msg_type == MsgType.CANCEL:
+        (request_id,) = struct.unpack_from("<I", body, 0)
+        return Cancel(request_id)
+    if msg_type == MsgType.CHUNK:
+        request_id, offset, total = struct.unpack_from("<III", body, 0)
+        return Chunk(request_id, offset, total, bytes(body[12:]))
+    if msg_type == MsgType.DENY:
+        request_id, reason = struct.unpack_from("<IB", body, 0)
+        return Deny(request_id, reason)
+    if msg_type == MsgType.LOST:
+        return Lost(_check_key(bytes(body)))
+    if msg_type == MsgType.BYE:
+        return Bye()
+    if msg_type == MsgType.ANNOUNCE:
+        swarm_id, off = _unpack_str(body, 0)
+        peer_id, _ = _unpack_str(body, off)
+        return Announce(swarm_id, peer_id)
+    if msg_type == MsgType.PEERS:
+        swarm_id, off = _unpack_str(body, 0)
+        (count,) = struct.unpack_from("<H", body, off)
+        off += 2
+        peer_ids = []
+        for _ in range(count):
+            p, off = _unpack_str(body, off)
+            peer_ids.append(p)
+        return Peers(swarm_id, tuple(peer_ids))
+    if msg_type == MsgType.LEAVE:
+        swarm_id, off = _unpack_str(body, 0)
+        peer_id, _ = _unpack_str(body, off)
+        return Leave(swarm_id, peer_id)
+    raise ProtocolError(f"unknown message type 0x{msg_type:02x}")
+
+
+def segment_key(segment_view: SegmentView) -> bytes:
+    """Canonical cache/wire key for a segment (the reference's 12-byte
+    ``toArrayBuffer`` form, segment-view.js:59-61)."""
+    return segment_view.to_bytes()

@@ -1,0 +1,159 @@
+"""Swarm membership tracker.
+
+The reference's swarm discovery happens through Streamroot's hosted
+tracker, reachable only from inside the closed-source agent (SURVEY.md
+§2.4 "tracker-based signaling").  The rebuild ships its own: a
+:class:`Tracker` service keyed by swarm id (derived from the content
+URL — peers watching the same content find each other), spoken to over
+the same message transport peers use, plus a :class:`TrackerClient`
+that re-announces periodically and notifies the agent of membership
+changes.
+
+Membership is leased: an entry expires ``lease_ms`` after its last
+announce, so crashed peers age out without an orderly LEAVE.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.clock import Clock
+from .protocol import Announce, Leave, Peers, ProtocolError, decode, encode
+from .transport import Endpoint
+
+TRACKER_PEER_ID = "tracker"
+DEFAULT_LEASE_MS = 30_000.0
+DEFAULT_ANNOUNCE_INTERVAL_MS = 10_000.0
+
+
+def swarm_id_for(content_url: str, p2p_config: Optional[dict] = None) -> str:
+    """Derive the swarm id peers rendezvous on.  ``content_id`` in the
+    p2p config overrides the URL — the reference's legacy
+    ``createSRModule(p2pConfig, …, contentId)`` path exists precisely
+    to let apps pin swarm identity across CDN hostnames
+    (wrapper-private.js:63-66, MIGRATION.md:32-62)."""
+    basis = (p2p_config or {}).get("content_id") or content_url
+    return hashlib.sha256(str(basis).encode()).hexdigest()[:16]
+
+
+class Tracker:
+    """Authoritative membership store, transport-agnostic core."""
+
+    def __init__(self, clock: Clock, *, lease_ms: float = DEFAULT_LEASE_MS,
+                 max_peers_returned: int = 30):
+        self.clock = clock
+        self.lease_ms = lease_ms
+        self.max_peers_returned = max_peers_returned
+        # swarm id -> peer id -> lease expiry (ms)
+        self._swarms: Dict[str, Dict[str, float]] = {}
+        self.announce_count = 0
+
+    def announce(self, swarm_id: str, peer_id: str) -> List[str]:
+        """Join/refresh; returns current co-members (excluding self),
+        most-recently-announced first, capped at
+        ``max_peers_returned``."""
+        self.announce_count += 1
+        now = self.clock.now()
+        self._expire_swarms(now)
+        swarm = self._swarms.setdefault(swarm_id, {})
+        # re-insert to refresh both lease and recency order
+        swarm.pop(peer_id, None)
+        swarm[peer_id] = now + self.lease_ms
+        others = [p for p in swarm if p != peer_id]
+        others.reverse()
+        return others[: self.max_peers_returned]
+
+    def leave(self, swarm_id: str, peer_id: str) -> None:
+        swarm = self._swarms.get(swarm_id)
+        if swarm:
+            swarm.pop(peer_id, None)
+            if not swarm:
+                del self._swarms[swarm_id]
+
+    def members(self, swarm_id: str) -> List[str]:
+        self._expire_swarms(self.clock.now())
+        return list(self._swarms.get(swarm_id, {}))
+
+    def _expire_swarms(self, now: float) -> None:
+        """Drop expired leases AND emptied swarms — a long-lived
+        tracker must not leak a dict per content ever served."""
+        for swarm_id in list(self._swarms):
+            swarm = self._swarms[swarm_id]
+            for peer_id in [p for p, exp in swarm.items() if exp <= now]:
+                del swarm[peer_id]
+            if not swarm:
+                del self._swarms[swarm_id]
+
+
+class TrackerEndpoint:
+    """Adapter exposing a :class:`Tracker` as a peer on the message
+    transport (peer id ``"tracker"``), speaking ANNOUNCE/LEAVE → PEERS."""
+
+    def __init__(self, tracker: Tracker, endpoint: Endpoint):
+        self.tracker = tracker
+        self.endpoint = endpoint
+        endpoint.on_receive = self._on_receive
+
+    def _on_receive(self, src_id: str, frame: bytes) -> None:
+        try:
+            msg = decode(frame)
+        except ProtocolError:
+            # one malformed peer must not take down the shared service
+            return
+        if isinstance(msg, Announce):
+            peers = self.tracker.announce(msg.swarm_id, msg.peer_id)
+            self.endpoint.send(src_id,
+                               encode(Peers(msg.swarm_id, tuple(peers))))
+        elif isinstance(msg, Leave):
+            self.tracker.leave(msg.swarm_id, msg.peer_id)
+
+
+class TrackerClient:
+    """Agent-side membership client: periodic re-announce over the
+    transport, membership-change callback, orderly leave."""
+
+    def __init__(self, endpoint: Endpoint, swarm_id: str, peer_id: str,
+                 clock: Clock, *,
+                 tracker_peer_id: str = TRACKER_PEER_ID,
+                 announce_interval_ms: float = DEFAULT_ANNOUNCE_INTERVAL_MS,
+                 on_peers: Optional[Callable[[Tuple[str, ...]], None]] = None):
+        self.endpoint = endpoint
+        self.swarm_id = swarm_id
+        self.peer_id = peer_id
+        self.clock = clock
+        self.tracker_peer_id = tracker_peer_id
+        self.announce_interval_ms = announce_interval_ms
+        self.on_peers = on_peers
+        self.known_peers: Tuple[str, ...] = ()
+        self._timer = None
+        self._stopped = False
+
+    def start(self) -> None:
+        self._announce()
+
+    def handle_frame(self, src_id: str, frame_msg) -> bool:
+        """Feed a decoded message; returns True if it was tracker
+        traffic (the agent's dispatch calls this first)."""
+        if src_id != self.tracker_peer_id or not isinstance(frame_msg, Peers):
+            return False
+        if frame_msg.swarm_id == self.swarm_id:
+            self.known_peers = frame_msg.peer_ids
+            if self.on_peers is not None:
+                self.on_peers(frame_msg.peer_ids)
+        return True
+
+    def _announce(self) -> None:
+        if self._stopped:
+            return
+        self.endpoint.send(self.tracker_peer_id,
+                           encode(Announce(self.swarm_id, self.peer_id)))
+        self._timer = self.clock.call_later(self.announce_interval_ms,
+                                            self._announce)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._timer is not None:
+            self._timer.cancel()
+        self.endpoint.send(self.tracker_peer_id,
+                           encode(Leave(self.swarm_id, self.peer_id)))

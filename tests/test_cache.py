@@ -1,0 +1,84 @@
+"""Segment cache: LRU over a byte budget with eviction callbacks."""
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.engine.cache import SegmentCache
+
+
+def k(i):
+    return i.to_bytes(12, "little")
+
+
+def test_put_get_roundtrip():
+    cache = SegmentCache(max_bytes=100)
+    cache.put(k(1), b"abc")
+    assert cache.get(k(1)) == b"abc"
+    assert cache.has(k(1))
+    assert len(cache) == 1
+    assert cache.bytes_used == 3
+
+
+def test_miss_returns_none_and_counts():
+    cache = SegmentCache(max_bytes=100)
+    assert cache.get(k(9)) is None
+    assert cache.misses == 1
+
+
+def test_lru_eviction_order():
+    evicted = []
+    cache = SegmentCache(max_bytes=10, on_evict=evicted.append)
+    cache.put(k(1), b"aaaa")
+    cache.put(k(2), b"bbbb")
+    cache.get(k(1))          # touch 1 → 2 is now LRU
+    cache.put(k(3), b"cccc")  # over budget → evict 2
+    assert evicted == [k(2)]
+    assert cache.has(k(1)) and cache.has(k(3)) and not cache.has(k(2))
+    assert cache.bytes_used == 8
+
+
+def test_replace_same_key_updates_bytes():
+    cache = SegmentCache(max_bytes=10)
+    cache.put(k(1), b"aaaa")
+    cache.put(k(1), b"bb")
+    assert cache.bytes_used == 2
+    assert cache.get(k(1)) == b"bb"
+
+
+def test_oversized_payload_refused():
+    cache = SegmentCache(max_bytes=10)
+    cache.put(k(1), b"x" * 11)
+    assert not cache.has(k(1))
+    assert cache.bytes_used == 0
+
+
+def test_eviction_cascades_until_under_budget():
+    evicted = []
+    cache = SegmentCache(max_bytes=10, on_evict=evicted.append)
+    for i in range(5):
+        cache.put(k(i), b"xx")
+    cache.put(k(9), b"x" * 9)
+    assert cache.bytes_used <= 10
+    assert len(evicted) == 5 - (10 - 9) // 2
+
+
+def test_remove_and_clear():
+    cache = SegmentCache(max_bytes=100)
+    cache.put(k(1), b"abc")
+    cache.put(k(2), b"def")
+    cache.remove(k(1))
+    assert not cache.has(k(1)) and cache.bytes_used == 3
+    cache.clear()
+    assert len(cache) == 0 and cache.bytes_used == 0
+
+
+def test_keys_oldest_first():
+    cache = SegmentCache(max_bytes=100)
+    cache.put(k(1), b"a")
+    cache.put(k(2), b"b")
+    cache.get(k(1))
+    assert cache.keys() == [k(2), k(1)]
+
+
+def test_invalid_budget_rejected():
+    with pytest.raises(ValueError):
+        SegmentCache(max_bytes=0)

@@ -1,0 +1,108 @@
+"""Wire protocol round-trips and framing errors."""
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core.segment_view import SegmentView
+from hlsjs_p2p_wrapper_tpu.core.track_view import TrackView
+from hlsjs_p2p_wrapper_tpu.engine import protocol as P
+
+
+def key(level=1, url_id=0, sn=42):
+    return SegmentView(sn=sn, track_view=TrackView(level=level, url_id=url_id)).to_bytes()
+
+
+ROUND_TRIPS = [
+    P.Hello("swarm-abc", "peer-1"),
+    P.Have(key()),
+    P.Bitfield((key(1, 0, 1), key(1, 0, 2), key(2, 1, 7))),
+    P.Bitfield(()),
+    P.Request(77, key()),
+    P.Cancel(77),
+    P.Chunk(77, 0, 1000, b"\x00\x01payload"),
+    P.Chunk(77, 999, 1000, b""),
+    P.Deny(77, P.DenyReason.UPLOAD_OFF),
+    P.Lost(key()),
+    P.Bye(),
+    P.Announce("swarm-abc", "peer-1"),
+    P.Peers("swarm-abc", ("a", "b", "c")),
+    P.Peers("swarm-abc", ()),
+    P.Leave("swarm-abc", "peer-1"),
+]
+
+
+@pytest.mark.parametrize("msg", ROUND_TRIPS, ids=lambda m: type(m).__name__)
+def test_round_trip(msg):
+    assert P.decode(P.encode(msg)) == msg
+
+
+def test_segment_key_is_reference_wire_format():
+    # the key embedded in frames must be the exact 12-byte
+    # uint32[level, url_id, sn] LE buffer (segment-view.js:9-17)
+    sv = SegmentView(sn=0x01020304, track_view=TrackView(level=3, url_id=1))
+    k = P.segment_key(sv)
+    assert len(k) == 12
+    assert k == (3).to_bytes(4, "little") + (1).to_bytes(4, "little") + \
+        (0x01020304).to_bytes(4, "little")
+    assert SegmentView.from_bytes(k).is_equal(sv)
+
+
+def test_bad_magic_rejected():
+    frame = bytearray(P.encode(P.Bye()))
+    frame[0] ^= 0xFF
+    with pytest.raises(P.ProtocolError):
+        P.decode(bytes(frame))
+
+
+def test_bad_version_rejected():
+    frame = bytearray(P.encode(P.Bye()))
+    frame[2] = 99
+    with pytest.raises(P.ProtocolError):
+        P.decode(bytes(frame))
+
+
+def test_unknown_type_rejected():
+    frame = bytearray(P.encode(P.Bye()))
+    frame[3] = 0x7F
+    with pytest.raises(P.ProtocolError):
+        P.decode(bytes(frame))
+
+
+def test_truncated_frame_rejected():
+    with pytest.raises(P.ProtocolError):
+        P.decode(b"\x50")
+
+
+def test_wrong_key_size_rejected():
+    with pytest.raises(P.ProtocolError):
+        P.encode(P.Have(b"short"))
+
+
+def test_chunk_payload_binary_safe():
+    payload = bytes(range(256)) * 5
+    msg = P.Chunk(1, 12, 1280, payload)
+    assert P.decode(P.encode(msg)).payload == payload
+
+
+def test_forged_bitfield_count_rejected_without_allocation():
+    # a forged u32 count must be validated against the body size before
+    # any count-sized allocation happens (memory-exhaustion guard)
+    import struct as _s
+    frame = P._frame(P.MsgType.BITFIELD, _s.pack("<I", 0xFFFFFFFF))
+    with pytest.raises(P.ProtocolError):
+        P.decode(frame)
+
+
+def test_truncated_fixed_body_raises_protocol_error():
+    # struct underflow is translated — callers need one except clause
+    for msg in (P.Request(1, key()), P.Cancel(1),
+                P.Chunk(1, 0, 10, b"abc"), P.Deny(1, 0)):
+        frame = P.encode(msg)
+        with pytest.raises(P.ProtocolError):
+            P.decode(frame[:6])
+
+
+def test_truncated_string_field_raises():
+    import struct as _s
+    body = _s.pack("<H", 10) + b"abc"  # declares 10 bytes, has 3
+    with pytest.raises(P.ProtocolError):
+        P.decode(P._frame(P.MsgType.ANNOUNCE, body))

@@ -1,0 +1,145 @@
+"""Tracker membership: leases, recency, transport adapter, client."""
+
+from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+from hlsjs_p2p_wrapper_tpu.engine.tracker import (Tracker, TrackerClient,
+                                                  TrackerEndpoint,
+                                                  swarm_id_for)
+from hlsjs_p2p_wrapper_tpu.engine.transport import LoopbackNetwork
+
+
+def test_swarm_id_groups_by_content_url():
+    a = swarm_id_for("https://cdn.example/master.m3u8")
+    b = swarm_id_for("https://cdn.example/master.m3u8")
+    c = swarm_id_for("https://cdn.example/other.m3u8")
+    assert a == b != c
+
+
+def test_content_id_overrides_url():
+    # the reference's legacy contentId exists to pin swarm identity
+    # across CDN hostnames (MIGRATION.md:32-62)
+    a = swarm_id_for("https://cdn-a.example/m.m3u8", {"content_id": "show-42"})
+    b = swarm_id_for("https://cdn-b.example/m.m3u8", {"content_id": "show-42"})
+    assert a == b
+
+
+def test_announce_returns_others_not_self():
+    clock = VirtualClock()
+    tracker = Tracker(clock)
+    assert tracker.announce("s", "p1") == []
+    assert tracker.announce("s", "p2") == ["p1"]
+    assert tracker.announce("s", "p1") == ["p2"]
+
+
+def test_swarms_are_isolated():
+    clock = VirtualClock()
+    tracker = Tracker(clock)
+    tracker.announce("s1", "p1")
+    assert tracker.announce("s2", "p2") == []
+
+
+def test_lease_expiry():
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=1000.0)
+    tracker.announce("s", "p1")
+    clock.advance(999.0)
+    assert tracker.members("s") == ["p1"]
+    clock.advance(1.0)
+    assert tracker.members("s") == []
+
+
+def test_reannounce_refreshes_lease():
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=1000.0)
+    tracker.announce("s", "p1")
+    clock.advance(900.0)
+    tracker.announce("s", "p1")
+    clock.advance(900.0)
+    assert tracker.members("s") == ["p1"]
+
+
+def test_leave_removes():
+    clock = VirtualClock()
+    tracker = Tracker(clock)
+    tracker.announce("s", "p1")
+    tracker.leave("s", "p1")
+    assert tracker.members("s") == []
+
+
+def test_peer_list_recency_order_and_cap():
+    clock = VirtualClock()
+    tracker = Tracker(clock, max_peers_returned=3)
+    for i in range(6):
+        tracker.announce("s", f"p{i}")
+    # most recent co-members first, capped
+    assert tracker.announce("s", "me") == ["p5", "p4", "p3"]
+
+
+def make_networked(clock, n_clients=2):
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    tracker = Tracker(clock)
+    TrackerEndpoint(tracker, net.register("tracker"))
+    clients = []
+    for i in range(n_clients):
+        peer_id = f"p{i}"
+        endpoint = net.register(peer_id)
+        seen = []
+        client = TrackerClient(endpoint, "swarm", peer_id, clock,
+                               on_peers=seen.append)
+        # agent-side dispatch loop stand-in
+        from hlsjs_p2p_wrapper_tpu.engine.protocol import decode
+        endpoint.on_receive = lambda src, f, c=client: c.handle_frame(src, decode(f))
+        clients.append((client, seen))
+    return net, tracker, clients
+
+
+def test_networked_announce_and_peer_discovery():
+    clock = VirtualClock()
+    net, tracker, clients = make_networked(clock)
+    (c0, seen0), (c1, seen1) = clients
+    c0.start()
+    clock.advance(20.0)
+    assert seen0[-1] == ()
+    c1.start()
+    clock.advance(20.0)
+    assert seen1[-1] == ("p0",)
+    # periodic re-announce keeps both alive and mutually visible
+    clock.advance(15_000.0)
+    assert seen0[-1] == ("p1",)
+    assert c0.known_peers == ("p1",)
+
+
+def test_client_stop_leaves_swarm():
+    clock = VirtualClock()
+    net, tracker, clients = make_networked(clock)
+    (c0, _), (c1, _) = clients
+    c0.start()
+    c1.start()
+    clock.advance(20.0)
+    c0.stop()
+    clock.advance(20.0)
+    assert tracker.members("swarm") == ["p1"]
+    # stopped client no longer re-announces
+    clock.advance(60_000.0)
+    assert "p0" not in tracker.members("swarm")
+
+
+def test_malformed_frame_does_not_crash_tracker_service():
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    tracker = Tracker(clock)
+    TrackerEndpoint(tracker, net.register("tracker"))
+    evil = net.register("evil")
+    evil.send("tracker", b"\xff\xff\xff\xff")
+    clock.advance(20.0)  # must not raise out of the clock
+    tracker.announce("s", "p1")
+    assert tracker.members("s") == ["p1"]
+
+
+def test_expired_swarms_fully_pruned():
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=100.0)
+    for i in range(50):
+        tracker.announce(f"swarm-{i}", "p")
+    clock.advance(200.0)
+    tracker.announce("fresh", "p")
+    assert list(tracker._swarms) == ["fresh"]
