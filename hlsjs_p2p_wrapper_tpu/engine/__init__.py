@@ -10,21 +10,28 @@ scheduling, and the agents built from them.
 from .cache import SegmentCache
 from .cdn import CdnTransport, HttpCdnTransport, slice_for_range
 from .cdn_agent import CdnOnlyAgent, StreamTypes
+from .mesh import PeerMesh
+from .p2p_agent import P2PAgent
+from .scheduler import Decision, SchedulingPolicy, decide
 from .stats import AgentStats
 from .tracker import Tracker, TrackerClient, TrackerEndpoint, swarm_id_for
 from .transport import Endpoint, LoopbackNetwork
 
 
+# deployment-facing name for the full engine
+PeerAgent = P2PAgent
+
+
 def default_agent_class():
     """The engine the public facade wires by default: the full P2P
-    agent once built; until then the CDN-only engine."""
-    try:
-        from .agent import PeerAgent
-        return PeerAgent
-    except ImportError:
-        return CdnOnlyAgent
+    agent (degrades to CDN-only delivery when no ``network`` is
+    configured)."""
+    return P2PAgent
 
 
 __all__ = ["CdnTransport", "HttpCdnTransport", "slice_for_range",
-           "CdnOnlyAgent", "StreamTypes", "AgentStats",
-           "default_agent_class"]
+           "CdnOnlyAgent", "StreamTypes", "AgentStats", "SegmentCache",
+           "PeerMesh", "P2PAgent", "PeerAgent", "Decision",
+           "SchedulingPolicy", "decide", "Tracker", "TrackerClient",
+           "TrackerEndpoint", "swarm_id_for", "Endpoint",
+           "LoopbackNetwork", "default_agent_class"]

@@ -1,0 +1,299 @@
+"""Peer mesh: per-neighbor protocol state over one transport endpoint.
+
+The reference's mesh lives in the closed-source agent; what is
+observable is its effect — segments arrive from peers, the ``upload``
+and ``peers`` stats move (README.md:230-237), and availability is
+addressed by the 12-byte segment key (segment-view.js:59-61).  This
+module implements that half from scratch:
+
+- handshake (HELLO + full BITFIELD), truthful incremental HAVE/LOST
+- chunked segment transfer with offset-addressed reassembly, so
+  progress is incremental and frames stay small enough to interleave
+  on a shaped uplink
+- upload serving straight out of the cache, gated by the public
+  ``p2p_upload_on`` toggle
+- per-download timeout; deny/disconnect/timeout all fail the download
+  without tearing down the link
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, Optional, Set
+
+from ..core.clock import Clock
+from . import protocol as P
+from .cache import SegmentCache
+from .transport import Endpoint
+
+CHUNK_PAYLOAD_BYTES = 16 * 1024
+DEFAULT_REQUEST_TIMEOUT_MS = 8_000.0
+
+
+class _Download:
+    """One in-flight inbound transfer."""
+
+    __slots__ = ("request_id", "key", "peer_id", "buf", "total", "received",
+                 "on_success", "on_error", "on_progress", "timer")
+
+    def __init__(self, request_id, key, peer_id, on_success, on_error,
+                 on_progress, timer):
+        self.request_id = request_id
+        self.key = key
+        self.peer_id = peer_id
+        self.buf: Optional[bytearray] = None
+        self.total: Optional[int] = None
+        self.received = 0
+        self.on_success = on_success
+        self.on_error = on_error
+        self.on_progress = on_progress
+        self.timer = timer
+
+
+class DownloadHandle:
+    """Abort handle for an inbound transfer."""
+
+    def __init__(self, mesh: "PeerMesh", request_id: int):
+        self._mesh = mesh
+        self._request_id = request_id
+
+    def abort(self) -> None:
+        self._mesh._cancel_download(self._request_id)
+
+
+class PeerState:
+    """What we know about one neighbor."""
+
+    __slots__ = ("peer_id", "have", "hello_sent", "handshaked")
+
+    def __init__(self, peer_id: str):
+        self.peer_id = peer_id
+        self.have: Set[bytes] = set()
+        self.hello_sent = False
+        self.handshaked = False
+
+
+class PeerMesh:
+    """All neighbor links of one agent, sharing one endpoint.
+
+    The owner wires ``endpoint.on_receive`` to :meth:`handle_frame`
+    (after giving tracker traffic first refusal) and provides the
+    cache to serve uploads from.
+    """
+
+    def __init__(self, endpoint: Endpoint, swarm_id: str, clock: Clock,
+                 cache: SegmentCache, *,
+                 request_timeout_ms: float = DEFAULT_REQUEST_TIMEOUT_MS,
+                 is_upload_on: Callable[[], bool] = lambda: True,
+                 chunk_bytes: int = CHUNK_PAYLOAD_BYTES):
+        self.endpoint = endpoint
+        self.swarm_id = swarm_id
+        self.clock = clock
+        self.cache = cache
+        self.request_timeout_ms = request_timeout_ms
+        self.is_upload_on = is_upload_on
+        self.chunk_bytes = chunk_bytes
+        self.peers: Dict[str, PeerState] = {}
+        self.upload_bytes = 0
+        self._downloads: Dict[int, _Download] = {}
+        self._request_ids = itertools.count(1)
+        self.closed = False
+        # availability hook: fires when a neighbor announces segments
+        # (the prefetcher's trigger); None = nobody cares
+        self.on_remote_have: Optional[Callable[[str], None]] = None
+
+    # -- membership ----------------------------------------------------
+    def connect_to(self, peer_id: str) -> None:
+        """Initiate a handshake (idempotent)."""
+        if self.closed or peer_id == self.endpoint.peer_id:
+            return
+        state = self.peers.setdefault(peer_id, PeerState(peer_id))
+        if not state.hello_sent:
+            state.hello_sent = True
+            self._send(peer_id, P.Hello(self.swarm_id, self.endpoint.peer_id))
+            self._send(peer_id, P.Bitfield(tuple(self.cache.keys())))
+
+    def on_tracker_peers(self, peer_ids) -> None:
+        for peer_id in peer_ids:
+            self.connect_to(peer_id)
+
+    def drop_peer(self, peer_id: str) -> None:
+        """Forget a neighbor; fail its in-flight downloads."""
+        self.peers.pop(peer_id, None)
+        for request_id in [r for r, d in self._downloads.items()
+                           if d.peer_id == peer_id]:
+            self._fail_download(request_id, {"status": 0})
+
+    # -- availability --------------------------------------------------
+    def holders_of(self, key: bytes) -> list:
+        """Handshaked neighbors announcing this segment, least-loaded
+        first so concurrent fetches spread across the swarm."""
+        key = bytes(key)
+        holders = [p for p in self.peers.values()
+                   if p.handshaked and key in p.have]
+        load = {p.peer_id: 0 for p in holders}
+        for d in self._downloads.values():
+            if d.peer_id in load:
+                load[d.peer_id] += 1
+        holders.sort(key=lambda p: load[p.peer_id])
+        return [p.peer_id for p in holders]
+
+    @property
+    def connected_count(self) -> int:
+        return sum(1 for p in self.peers.values() if p.handshaked)
+
+    def broadcast_have(self, key: bytes) -> None:
+        self._broadcast(P.Have(bytes(key)))
+
+    def broadcast_lost(self, key: bytes) -> None:
+        self._broadcast(P.Lost(bytes(key)))
+
+    def _broadcast(self, msg) -> None:
+        if self.closed:
+            return
+        frame = P.encode(msg)
+        for state in self.peers.values():
+            if state.handshaked:
+                self.endpoint.send(state.peer_id, frame)
+
+    # -- downloads (we → peer) -----------------------------------------
+    def request(self, peer_id: str, key: bytes, *,
+                on_success: Callable[[bytes], None],
+                on_error: Callable[[dict], None],
+                on_progress: Optional[Callable[[int], None]] = None,
+                timeout_ms: Optional[float] = None) -> DownloadHandle:
+        """Fetch a segment from a specific neighbor.  Errors are
+        HTTP-shaped ``{"status": int}`` like everything the agent
+        surfaces (loader-generator.js:103-112): 0 = transport/timeout,
+        403 = denied, 404 = peer no longer has it."""
+        request_id = next(self._request_ids)
+        timer = self.clock.call_later(
+            timeout_ms if timeout_ms is not None else self.request_timeout_ms,
+            lambda: self._fail_download(request_id, {"status": 0}))
+        self._downloads[request_id] = _Download(
+            request_id, bytes(key), peer_id, on_success, on_error,
+            on_progress, timer)
+        self._send(peer_id, P.Request(request_id, bytes(key)))
+        return DownloadHandle(self, request_id)
+
+    def _cancel_download(self, request_id: int) -> None:
+        download = self._downloads.pop(request_id, None)
+        if download is None:
+            return
+        download.timer.cancel()
+        self._send(download.peer_id, P.Cancel(request_id))
+
+    def _fail_download(self, request_id: int, error: dict) -> None:
+        download = self._downloads.pop(request_id, None)
+        if download is None:
+            return
+        download.timer.cancel()
+        download.on_error(error)
+
+    # -- frame handling ------------------------------------------------
+    def handle_frame(self, src_id: str, msg) -> None:
+        """Dispatch one decoded peer message."""
+        if self.closed:
+            return
+        if isinstance(msg, P.Hello):
+            if msg.swarm_id != self.swarm_id:
+                return  # different content; not our neighbor
+            state = self.peers.setdefault(src_id, PeerState(src_id))
+            state.handshaked = True
+            if not state.hello_sent:
+                state.hello_sent = True
+                self._send(src_id, P.Hello(self.swarm_id, self.endpoint.peer_id))
+                self._send(src_id, P.Bitfield(tuple(self.cache.keys())))
+            return
+
+        state = self.peers.get(src_id)
+        if state is None or not (state.handshaked or state.hello_sent):
+            return  # never handshaked with this peer; ignore
+
+        if isinstance(msg, P.Bitfield):
+            state.have = set(msg.keys)
+            if state.have and self.on_remote_have is not None:
+                self.on_remote_have(src_id)
+        elif isinstance(msg, P.Have):
+            state.have.add(msg.key)
+            if self.on_remote_have is not None:
+                self.on_remote_have(src_id)
+        elif isinstance(msg, P.Lost):
+            state.have.discard(msg.key)
+        elif isinstance(msg, P.Request):
+            self._serve(src_id, msg)
+        elif isinstance(msg, P.Cancel):
+            pass  # uploads are sent in one burst; nothing to stop
+        elif isinstance(msg, P.Chunk):
+            self._on_chunk(src_id, msg)
+        elif isinstance(msg, P.Deny):
+            self._on_deny(src_id, msg)
+        elif isinstance(msg, P.Bye):
+            self.drop_peer(src_id)
+
+    # -- uploads (peer → us asks) --------------------------------------
+    def _serve(self, src_id: str, msg: P.Request) -> None:
+        if not self.is_upload_on():
+            self._send(src_id, P.Deny(msg.request_id, P.DenyReason.UPLOAD_OFF))
+            return
+        payload = self.cache.get(msg.key)
+        if payload is None:
+            # our LOST may still be in flight to them — stay truthful
+            self._send(src_id, P.Deny(msg.request_id, P.DenyReason.NOT_FOUND))
+            return
+        total = len(payload)
+        if total == 0:
+            self._send(src_id, P.Chunk(msg.request_id, 0, 0, b""))
+        for offset in range(0, total, self.chunk_bytes):
+            piece = payload[offset:offset + self.chunk_bytes]
+            self._send(src_id, P.Chunk(msg.request_id, offset, total, piece))
+        self.upload_bytes += total
+
+    def _on_chunk(self, src_id: str, msg: P.Chunk) -> None:
+        download = self._downloads.get(msg.request_id)
+        if download is None or download.peer_id != src_id:
+            return  # cancelled/timed out; stray chunk
+        if download.buf is None:
+            # the remote-declared total must not drive allocation
+            # unbounded (same defense as the BITFIELD count): nothing
+            # larger than the cache budget could ever be stored
+            if msg.total > self.cache.max_bytes:
+                self._fail_download(msg.request_id, {"status": 0})
+                return
+            download.total = msg.total
+            download.buf = bytearray(msg.total)
+        if msg.offset + len(msg.payload) > download.total:
+            self._fail_download(msg.request_id, {"status": 0})
+            return
+        download.buf[msg.offset:msg.offset + len(msg.payload)] = msg.payload
+        download.received += len(msg.payload)
+        if download.on_progress is not None:
+            download.on_progress(download.received)
+        if download.received >= download.total:
+            del self._downloads[msg.request_id]
+            download.timer.cancel()
+            download.on_success(bytes(download.buf))
+
+    def _on_deny(self, src_id: str, msg: P.Deny) -> None:
+        download = self._downloads.get(msg.request_id)
+        if download is None or download.peer_id != src_id:
+            return
+        # a denying peer can't serve this key now — stop asking it
+        state = self.peers.get(src_id)
+        if state is not None:
+            state.have.discard(download.key)
+        status = 403 if msg.reason == P.DenyReason.UPLOAD_OFF else 404
+        self._fail_download(msg.request_id, {"status": status})
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self) -> None:
+        if self.closed:
+            return
+        self._broadcast(P.Bye())
+        self.closed = True
+        for request_id in list(self._downloads):
+            self._fail_download(request_id, {"status": 0})
+        self.peers.clear()
+
+    def _send(self, peer_id: str, msg) -> None:
+        self.endpoint.send(peer_id, P.encode(msg))

@@ -136,7 +136,9 @@ class LoopbackNetwork:
 
         def deliver() -> None:
             target = self._endpoints.get(dest_id)
-            if target is None or target.closed or target.on_receive is None:
+            # identity check: frames addressed to a closed endpoint must
+            # not leak into a new endpoint re-registered under its id
+            if target is not dest or target.closed or target.on_receive is None:
                 self.frames_dropped += 1
                 return
             if self._links.get((src_id, dest_id), {}).get("blocked"):

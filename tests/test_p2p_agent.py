@@ -1,0 +1,376 @@
+"""Full P2P agent: contract behavior, swarm transfer, failover,
+toggles, prefetch, lifecycle — driven on a VirtualClock."""
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+from hlsjs_p2p_wrapper_tpu.core.errors import PlayerStateError
+from hlsjs_p2p_wrapper_tpu.core.segment_view import SegmentView
+from hlsjs_p2p_wrapper_tpu.core.track_view import TrackView
+from hlsjs_p2p_wrapper_tpu.engine.p2p_agent import P2PAgent
+from hlsjs_p2p_wrapper_tpu.engine.tracker import Tracker, TrackerEndpoint
+from hlsjs_p2p_wrapper_tpu.engine.transport import LoopbackNetwork
+from hlsjs_p2p_wrapper_tpu.testing.mock_cdn import MockCdnTransport
+
+TRACK = TrackView(level=0, url_id=0)
+SEG_DURATION = 10.0
+
+
+def sv(sn):
+    return SegmentView(sn=sn, track_view=TRACK, time=sn * SEG_DURATION)
+
+
+def url(sn):
+    return f"http://cdn.example/seg{sn}.ts"
+
+
+class FakeBridge:
+    def __init__(self, buffer_max=30.0, live=False):
+        self.listeners = {}
+        self.buffer_max = buffer_max
+        self.live = live
+        self.margin_calls = []
+
+    def add_event_listener(self, name, fn):
+        self.listeners.setdefault(name, []).append(fn)
+
+    def emit_track_change(self, track_view):
+        for fn in self.listeners.get("onTrackChange", []):
+            fn({"video": track_view})
+
+    def get_buffer_level_max(self):
+        return self.buffer_max
+
+    def is_live(self):
+        if self.live is None:
+            raise PlayerStateError("manifest not parsed")
+        return self.live
+
+    def set_buffer_margin_live(self, level):
+        self.margin_calls.append(level)
+
+
+class FakeMediaMap:
+    """Timeline of segments sn in [25, 45), start = sn * 10."""
+
+    def get_segment_list(self, track_view, begin_time, duration):
+        return [sv(sn) for sn in range(25, 45)
+                if begin_time <= sn * SEG_DURATION <= begin_time + duration]
+
+
+class FakeMedia:
+    def __init__(self, current_time=0.0):
+        self.current_time = current_time
+
+
+def collector():
+    out = {"success": [], "error": [], "progress": []}
+    return out, {"on_success": out["success"].append,
+                 "on_error": out["error"].append,
+                 "on_progress": out["progress"].append}
+
+
+class Swarm:
+    """Test rig: shared clock, network, tracker, CDN."""
+
+    def __init__(self, latency_ms=5.0, cdn_bandwidth_bps=None):
+        self.clock = VirtualClock()
+        self.net = LoopbackNetwork(self.clock, default_latency_ms=latency_ms)
+        self.tracker = Tracker(self.clock)
+        TrackerEndpoint(self.tracker, self.net.register("tracker"))
+        self.cdn = MockCdnTransport(self.clock, latency_ms=20.0,
+                                    bandwidth_bps=cdn_bandwidth_bps,
+                                    default_size=50_000)
+        self.bridges = {}
+
+    def agent(self, peer_id, *, networked=True, config=None, **bridge_kwargs):
+        bridge = FakeBridge(**bridge_kwargs)
+        self.bridges[peer_id] = bridge
+        cfg = {"clock": self.clock, "cdn_transport": self.cdn,
+               "peer_id": peer_id,
+               "content_id": "content-1"}
+        if networked:
+            cfg["network"] = self.net
+        cfg.update(config or {})
+        return P2PAgent(bridge, "http://cdn.example/master.m3u8",
+                        FakeMediaMap(), cfg, SegmentView, "hls", "v2")
+
+
+def fetch(agent, sn, clock, advance=5_000.0):
+    out, callbacks = collector()
+    handle = agent.get_segment({"url": url(sn), "headers": {}}, callbacks, sv(sn))
+    clock.advance(advance)
+    return out, handle
+
+
+# -- basic delivery ---------------------------------------------------
+
+def test_cdn_delivery_without_network():
+    rig = Swarm()
+    agent = rig.agent("solo", networked=False)
+    out, _ = fetch(agent, 30, rig.clock)
+    assert len(out["success"]) == 1
+    assert len(out["success"][0]) == 50_000
+    assert agent.stats["cdn"] == 50_000
+    assert agent.stats["p2p"] == 0
+    assert agent.stats["peers"] == 0
+
+
+def test_cache_hit_serves_instantly_with_original_duration():
+    rig = Swarm()
+    agent = rig.agent("solo", networked=False)
+    fetch(agent, 30, rig.clock)
+    out, _ = fetch(agent, 30, rig.clock, advance=0.0)  # no time passes
+    assert len(out["success"]) == 1
+    progress = out["progress"][0]
+    assert progress["p2p_downloaded"] == 50_000
+    assert progress["cdn_downloaded"] == 0
+    # truthful original transfer time, not zero (ABR shaping input)
+    assert progress["p2p_duration"] > 0
+    # replay moved no bytes over the network: stats unchanged
+    assert agent.stats["p2p"] == 0
+    assert agent.stats["cdn"] == 50_000
+
+
+def test_p2p_transfer_between_two_agents():
+    rig = Swarm()
+    a = rig.agent("a")
+    b = rig.agent("b")
+    rig.clock.advance(100.0)  # discovery + handshake
+    fetch(a, 30, rig.clock)   # a pulls from CDN, announces HAVE
+    rig.clock.advance(100.0)
+    out, _ = fetch(b, 30, rig.clock)
+    assert len(out["success"]) == 1
+    assert len(out["success"][0]) == 50_000
+    assert b.stats["p2p"] == 50_000
+    assert b.stats["cdn"] == 0
+    assert a.stats["upload"] == 50_000
+    assert a.stats["peers"] == 1
+    # progress events were P2P-shaped with real durations
+    assert out["progress"][-1]["p2p_downloaded"] == 50_000
+    assert out["progress"][-1]["p2p_duration"] > 0
+
+
+def test_p2p_payload_matches_cdn_payload():
+    rig = Swarm()
+    a, b = rig.agent("a"), rig.agent("b")
+    rig.clock.advance(100.0)
+    out_a, _ = fetch(a, 31, rig.clock)
+    rig.clock.advance(100.0)
+    out_b, _ = fetch(b, 31, rig.clock)
+    assert out_a["success"][0] == out_b["success"][0]
+
+
+# -- failover ---------------------------------------------------------
+
+def test_failover_to_cdn_when_peer_unreachable():
+    rig = Swarm()
+    a, b = rig.agent("a"), rig.agent("b")
+    rig.clock.advance(100.0)
+    fetch(a, 30, rig.clock)
+    rig.clock.advance(100.0)
+    rig.net.partition("a", "b")  # peer still announced, now dark
+    out, _ = fetch(b, 30, rig.clock, advance=20_000.0)
+    assert len(out["success"]) == 1
+    assert b.stats["cdn"] == 50_000  # delivered by the CDN leg
+    assert len(out["error"]) == 0    # failover is internal
+
+
+def test_urgent_request_skips_p2p():
+    rig = Swarm()
+    a, b = rig.agent("a"), rig.agent("b")
+    rig.clock.advance(100.0)
+    fetch(a, 30, rig.clock)
+    rig.clock.advance(100.0)
+    # b's playhead is 2 s before the segment: inside urgent_margin_s
+    b.set_media_element(FakeMedia(current_time=298.0))
+    out, _ = fetch(b, 30, rig.clock)
+    assert len(out["success"]) == 1
+    assert b.stats["cdn"] == 50_000
+    assert b.stats["p2p"] == 0
+
+
+# -- toggles ----------------------------------------------------------
+
+def test_download_toggle_off_goes_cdn_and_skips_cache():
+    rig = Swarm()
+    a, b = rig.agent("a"), rig.agent("b")
+    rig.clock.advance(100.0)
+    fetch(a, 30, rig.clock)
+    rig.clock.advance(100.0)
+    b.p2p_download_on = False
+    out, _ = fetch(b, 30, rig.clock)
+    assert len(out["success"]) == 1
+    assert b.stats["p2p"] == 0
+    assert b.stats["cdn"] == 50_000
+
+
+def test_upload_toggle_off_denies_then_requester_fails_over():
+    rig = Swarm()
+    a, b = rig.agent("a"), rig.agent("b")
+    rig.clock.advance(100.0)
+    fetch(a, 30, rig.clock)
+    rig.clock.advance(100.0)
+    a.p2p_upload_on = False
+    out, _ = fetch(b, 30, rig.clock, advance=20_000.0)
+    assert len(out["success"]) == 1
+    assert a.stats["upload"] == 0
+    assert b.stats["cdn"] == 50_000
+
+
+# -- abort ------------------------------------------------------------
+
+def test_abort_suppresses_callbacks():
+    rig = Swarm(cdn_bandwidth_bps=400_000.0)  # slow CDN: ~1 s transfer
+    agent = rig.agent("solo", networked=False)
+    out, callbacks = collector()
+    handle = agent.get_segment({"url": url(30), "headers": {}}, callbacks, sv(30))
+    rig.clock.advance(150.0)
+    handle.abort()
+    rig.clock.advance(10_000.0)
+    assert out["success"] == []
+    assert out["error"] == []
+
+
+# -- prefetch ---------------------------------------------------------
+
+def test_prefetch_pulls_in_window_segments_from_peers():
+    rig = Swarm()
+    a, b = rig.agent("a"), rig.agent("b")
+    rig.clock.advance(100.0)
+    # a has segments 30 and 31 (via CDN fetches)
+    fetch(a, 30, rig.clock)
+    fetch(a, 31, rig.clock)
+    rig.clock.advance(100.0)
+    # b is playing at t=295 with a 30 s window → sn 30,31 are upcoming
+    b.set_media_element(FakeMedia(current_time=295.0))
+    rig.bridges["b"].emit_track_change(TRACK)
+    rig.clock.advance(5_000.0)  # prefetch ticks run
+    assert b.stats["p2p"] == 100_000  # both segments prefetched
+    # now the foreground request is an instant cache hit — and must
+    # NOT double-count the already-credited prefetch bytes
+    out, _ = fetch(b, 30, rig.clock, advance=0.0)
+    assert len(out["success"]) == 1
+    assert b.stats["p2p"] == 100_000
+
+
+def test_no_prefetch_when_download_off():
+    rig = Swarm()
+    a, b = rig.agent("a"), rig.agent("b")
+    rig.clock.advance(100.0)
+    fetch(a, 30, rig.clock)
+    rig.clock.advance(100.0)
+    b.p2p_download_on = False
+    b.set_media_element(FakeMedia(current_time=295.0))
+    rig.bridges["b"].emit_track_change(TRACK)
+    rig.clock.advance(5_000.0)
+    assert b.stats["p2p"] == 0
+
+
+def test_prefetch_respects_concurrency_limit():
+    rig = Swarm()
+    a = rig.agent("a")
+    b = rig.agent("b", config={"max_concurrent_prefetch": 1,
+                               "request_timeout_ms": 60_000.0})
+    rig.clock.advance(100.0)
+    for sn in (30, 31, 32):
+        fetch(a, sn, rig.clock)
+    rig.clock.advance(100.0)
+    rig.net.partition("a", "b")  # prefetches will hang, not complete
+    b.set_media_element(FakeMedia(current_time=295.0))
+    rig.bridges["b"].emit_track_change(TRACK)
+    rig.clock.advance(3_000.0)
+    assert len(b._prefetches) == 1
+
+
+# -- live steering ----------------------------------------------------
+
+def test_live_buffer_steering_applied_once():
+    rig = Swarm()
+    agent = rig.agent("solo", networked=False,
+                      config={"live_buffer_margin": 20.0}, live=True)
+    fetch(agent, 30, rig.clock)
+    fetch(agent, 31, rig.clock)
+    assert rig.bridges["solo"].margin_calls == [20.0]
+
+
+def test_live_steering_retries_until_manifest_parsed():
+    rig = Swarm()
+    agent = rig.agent("solo", networked=False,
+                      config={"live_buffer_margin": 20.0}, live=None)
+    fetch(agent, 30, rig.clock)
+    assert rig.bridges["solo"].margin_calls == []
+    rig.bridges["solo"].live = True  # manifest now parsed
+    fetch(agent, 31, rig.clock)
+    assert rig.bridges["solo"].margin_calls == [20.0]
+
+
+def test_vod_stream_not_steered():
+    rig = Swarm()
+    agent = rig.agent("solo", networked=False,
+                      config={"live_buffer_margin": 20.0}, live=False)
+    fetch(agent, 30, rig.clock)
+    assert rig.bridges["solo"].margin_calls == []
+
+
+# -- lifecycle --------------------------------------------------------
+
+def test_dispose_leaves_swarm_and_rejects_requests():
+    rig = Swarm()
+    a, b = rig.agent("a"), rig.agent("b")
+    rig.clock.advance(100.0)
+    assert "a" in rig.tracker.members(a.swarm_id)
+    a.dispose()
+    rig.clock.advance(100.0)
+    assert "a" not in rig.tracker.members(a.swarm_id)
+    assert b.stats["peers"] == 0  # b saw the Bye
+    with pytest.raises(RuntimeError):
+        a.get_segment({"url": url(30), "headers": {}},
+                      collector()[1], sv(30))
+    rig.clock.advance(60_000.0)  # no timers left firing into disposed state
+
+
+def test_dispose_is_idempotent():
+    rig = Swarm()
+    a = rig.agent("a")
+    a.dispose()
+    a.dispose()
+
+
+def test_cdn_error_propagates_http_shaped():
+    rig = Swarm()
+    rig.cdn.responses[url(30)] = 404
+    agent = rig.agent("solo", networked=False)
+    out, _ = fetch(agent, 30, rig.clock)
+    assert out["error"] == [{"status": 404}]
+    assert out["success"] == []
+
+
+def test_eviction_broadcasts_lost():
+    rig = Swarm()
+    a = rig.agent("a", config={"cache_max_bytes": 60_000})  # fits one segment
+    b = rig.agent("b")
+    rig.clock.advance(100.0)
+    fetch(a, 30, rig.clock)
+    rig.clock.advance(100.0)
+    assert b.mesh.holders_of(sv(30).to_bytes()) == ["a"]
+    fetch(a, 31, rig.clock)  # evicts sn=30
+    rig.clock.advance(100.0)
+    assert b.mesh.holders_of(sv(30).to_bytes()) == []
+    assert b.mesh.holders_of(sv(31).to_bytes()) == ["a"]
+
+
+def test_dispose_mid_p2p_transfer_does_not_start_cdn_leg():
+    rig = Swarm()
+    a, b = rig.agent("a"), rig.agent("b")
+    rig.clock.advance(100.0)
+    fetch(a, 30, rig.clock)
+    rig.clock.advance(100.0)
+    cdn_fetches_before = rig.cdn.fetch_count
+    out, callbacks = collector()
+    b.get_segment({"url": url(30), "headers": {}}, callbacks, sv(30))
+    rig.clock.advance(1.0)  # P2P request in flight
+    b.dispose()             # closes mesh → fails the download
+    rig.clock.advance(30_000.0)
+    assert rig.cdn.fetch_count == cdn_fetches_before  # no zombie CDN leg
+    assert out["success"] == []

@@ -1,0 +1,52 @@
+"""Source-selection policy: pure-function decisions."""
+
+from hlsjs_p2p_wrapper_tpu.engine.scheduler import (SchedulingPolicy, decide)
+
+POLICY = SchedulingPolicy()
+
+
+def test_no_holders_goes_cdn():
+    d = decide(POLICY, margin_s=30.0, holder_count=0, download_on=True)
+    assert not d.use_p2p
+
+
+def test_download_off_goes_cdn():
+    d = decide(POLICY, margin_s=30.0, holder_count=5, download_on=False)
+    assert not d.use_p2p
+
+
+def test_urgent_margin_goes_cdn():
+    d = decide(POLICY, margin_s=3.9, holder_count=5, download_on=True)
+    assert not d.use_p2p
+
+
+def test_comfortable_margin_uses_p2p_with_proportional_budget():
+    d = decide(POLICY, margin_s=8.0, holder_count=1, download_on=True)
+    assert d.use_p2p
+    assert d.p2p_budget_ms == 8.0 * 1000.0 * POLICY.p2p_budget_fraction
+
+
+def test_budget_capped():
+    d = decide(POLICY, margin_s=100.0, holder_count=1, download_on=True)
+    assert d.p2p_budget_ms == POLICY.p2p_budget_cap_ms
+
+
+def test_budget_floored():
+    policy = SchedulingPolicy(urgent_margin_s=0.0)
+    d = decide(policy, margin_s=0.5, holder_count=1, download_on=True)
+    assert d.p2p_budget_ms == policy.p2p_budget_floor_ms
+
+
+def test_unknown_margin_treated_as_comfortable():
+    d = decide(POLICY, margin_s=None, holder_count=1, download_on=True)
+    assert d.use_p2p
+    assert d.p2p_budget_ms == POLICY.p2p_budget_cap_ms
+
+
+def test_from_config_overrides():
+    policy = SchedulingPolicy.from_config({"urgent_margin_s": 10.0,
+                                           "p2p_budget_cap_ms": 1234.0})
+    assert policy.urgent_margin_s == 10.0
+    assert policy.p2p_budget_cap_ms == 1234.0
+    d = decide(policy, margin_s=9.0, holder_count=3, download_on=True)
+    assert not d.use_p2p

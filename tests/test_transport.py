@@ -156,3 +156,21 @@ def test_loss_returns_true_like_udp():
     b.on_receive = lambda src, f: (_ for _ in ()).throw(AssertionError)
     assert a.send("b", b"x")  # silent loss: sender can't tell
     clock.advance(100.0)
+
+
+def test_stale_frames_not_delivered_to_reregistered_peer_id():
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=50.0)
+    a = net.register("a")
+    b1 = net.register("b")
+    got = []
+    a.send("b", b"for-first-incarnation")
+    clock.advance(10.0)
+    b1.close()
+    b2 = net.register("b")  # same id, new incarnation
+    b2.on_receive = lambda src, f: got.append(f)
+    clock.advance(100.0)
+    assert got == []  # stale in-flight frame must not cross incarnations
+    a.send("b", b"fresh")
+    clock.advance(100.0)
+    assert got == [b"fresh"]
