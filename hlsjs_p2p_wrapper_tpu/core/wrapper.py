@@ -64,4 +64,11 @@ class P2PWrapper:
     def has_session(self) -> bool:
         return self._wrapper.has_session()
 
+    @property
+    def peer_agent(self):
+        """The live agent instance, or None before a session starts —
+        for harnesses/diagnostics that need engine internals without
+        reaching through the session manager."""
+        return self._wrapper.peer_agent_module
+
     version = staticmethod(get_version)

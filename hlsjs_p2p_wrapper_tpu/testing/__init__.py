@@ -2,6 +2,8 @@
 
 from .fixtures import DEFAULT_CONFIG, FakePlayer, make_fragments
 from .mock_cdn import MockCdnTransport, serve_manifest, synthetic_payload
+from .swarm import SwarmHarness, SwarmPeer
 
 __all__ = ["DEFAULT_CONFIG", "FakePlayer", "make_fragments",
-           "MockCdnTransport", "serve_manifest", "synthetic_payload"]
+           "MockCdnTransport", "serve_manifest", "synthetic_payload",
+           "SwarmHarness", "SwarmPeer"]
