@@ -1,2 +1,12 @@
-"""TPU-side numeric ops (JAX): batched ABR estimation, swarm
-scheduling scores."""
+"""TPU-side numeric ops (JAX): batched ABR estimation and the
+device-resident swarm simulator."""
+
+from .ewma import EwmaState, get_estimate, init_state, scan_samples, update
+from .swarm_sim import (SwarmConfig, SwarmState, init_swarm, offload_ratio,
+                        rebuffer_ratio, ring_adjacency, run_swarm,
+                        staggered_joins, swarm_step)
+
+__all__ = ["EwmaState", "get_estimate", "init_state", "scan_samples",
+           "update", "SwarmConfig", "SwarmState", "init_swarm",
+           "offload_ratio", "rebuffer_ratio", "ring_adjacency",
+           "run_swarm", "staggered_joins", "swarm_step"]

@@ -1,0 +1,8 @@
+"""Multi-chip scaling: device meshes + canonical shardings for the
+swarm simulator (peers = data axis, segments = optional second axis)."""
+
+from .mesh import (PEER_AXIS, SEGMENT_AXIS, input_shardings, make_mesh,
+                   shard_swarm, sharded_run, state_shardings)
+
+__all__ = ["PEER_AXIS", "SEGMENT_AXIS", "input_shardings", "make_mesh",
+           "shard_swarm", "sharded_run", "state_shardings"]

@@ -1,0 +1,98 @@
+"""Device mesh + sharding for the swarm simulator.
+
+Scaling model ("How to Scale Your Model" recipe): pick a mesh,
+annotate shardings, let XLA insert the collectives.  The simulator's
+natural data axis is **peers** — every per-peer field shards over it
+("dp"-style), and the cache map's segment axis can shard over a second
+**segments** axis ("sp"-style) for very long timelines.  The one
+cross-peer op, the availability einsum ``adj[i,j] x avail[j,l,s]``,
+contracts the full peer axis: under a sharded ``j``, XLA lowers it to
+a reduce-scatter/all-gather over ICI — the simulator's only
+collective, riding the fast fabric by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.swarm_sim import SwarmConfig, SwarmState
+
+PEER_AXIS = "peers"
+SEGMENT_AXIS = "segments"
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              segment_shards: int = 1) -> Mesh:
+    """Build a ``(peers, segments)`` mesh over the given (default: all)
+    devices.  ``segment_shards`` splits devices between the two axes;
+    1 = shard peers only (the right default — peer state dominates)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % segment_shards:
+        raise ValueError(f"{n} devices not divisible into "
+                         f"{segment_shards} segment shards")
+    grid = np.array(devices).reshape(n // segment_shards, segment_shards)
+    return Mesh(grid, (PEER_AXIS, SEGMENT_AXIS))
+
+
+def state_shardings(mesh: Mesh) -> SwarmState:
+    """A ``SwarmState``-shaped pytree of NamedShardings: per-peer
+    vectors shard over the peer axis; the cache map shards peers x
+    segments; estimator state follows its [P] leaves."""
+    from ..ops.ewma import EwmaState
+    peer_vec = NamedSharding(mesh, P(PEER_AXIS))
+    scalar = NamedSharding(mesh, P())
+    avail = NamedSharding(mesh, P(PEER_AXIS, None, SEGMENT_AXIS))
+    return SwarmState(
+        t_s=scalar,
+        playhead_s=peer_vec, buffer_s=peer_vec, rebuffer_s=peer_vec,
+        level=peer_vec,
+        ewma=EwmaState(peer_vec, peer_vec, peer_vec, peer_vec),
+        avail=avail, cdn_bytes=peer_vec, p2p_bytes=peer_vec,
+        dl_active=peer_vec, dl_is_p2p=peer_vec, dl_seg=peer_vec,
+        dl_level=peer_vec, dl_done_bytes=peer_vec,
+        dl_total_bytes=peer_vec, dl_elapsed_ms=peer_vec)
+
+
+def input_shardings(mesh: Mesh):
+    """(bitrates, adjacency, cdn_bps) shardings: the bitrate ladder is
+    tiny and replicated; adjacency shards its ROW (requester) axis so
+    each device owns its peers' neighbor lists; per-peer CDN rates
+    shard like every peer vector."""
+    return (NamedSharding(mesh, P()),
+            NamedSharding(mesh, P(PEER_AXIS, None)),
+            NamedSharding(mesh, P(PEER_AXIS)))
+
+
+def shard_swarm(mesh: Mesh, bitrates, adjacency, cdn_bps, join_s,
+                state: SwarmState):
+    """Place scenario + state onto the mesh with the canonical
+    shardings; returns device arrays ready for ``run_swarm``."""
+    bit_s, adj_s, cdn_s = input_shardings(mesh)
+    return (jax.device_put(bitrates, bit_s),
+            jax.device_put(adjacency, adj_s),
+            jax.device_put(cdn_bps, cdn_s),
+            jax.device_put(join_s, cdn_s),
+            jax.tree_util.tree_map(jax.device_put, state,
+                                   state_shardings(mesh)))
+
+
+def sharded_run(mesh: Mesh, config: SwarmConfig, bitrates, adjacency,
+                cdn_bps, state: SwarmState, n_steps: int, join_s=None):
+    """jit ``run_swarm`` with explicit input shardings over the mesh.
+    XLA inserts the ICI collectives for the availability einsum; all
+    other ops stay local to their shard."""
+    import jax.numpy as jnp
+
+    from ..ops.swarm_sim import run_swarm
+    if join_s is None:
+        join_s = jnp.zeros((config.n_peers,), jnp.float32)
+    bitrates, adjacency, cdn_bps, join_s, state = shard_swarm(
+        mesh, bitrates, adjacency, cdn_bps, join_s, state)
+    with mesh:
+        return run_swarm(config, bitrates, adjacency, cdn_bps, state,
+                         n_steps, join_s)

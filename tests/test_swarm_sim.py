@@ -1,0 +1,150 @@
+"""Device-side swarm simulator: dynamics sanity, offload behavior,
+determinism, and sharded multi-device execution (8 virtual CPU
+devices via conftest)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (SwarmConfig, init_swarm,
+                                                 offload_ratio,
+                                                 rebuffer_ratio,
+                                                 ring_adjacency, run_swarm)
+from hlsjs_p2p_wrapper_tpu.parallel import make_mesh, sharded_run
+
+BITRATES = jnp.array([300_000.0, 800_000.0, 2_000_000.0])
+
+
+def scenario(n_peers=32, n_segments=64, *, cdn_bps=8_000_000.0, degree=8,
+             stagger_s=60.0, **cfg_kwargs):
+    """Staggered-arrival audience (join times spread over
+    ``stagger_s``): a fully synchronized swarm has nothing to share."""
+    config = SwarmConfig(n_peers=n_peers, n_segments=n_segments,
+                         n_levels=3, **cfg_kwargs)
+    adjacency = ring_adjacency(n_peers, degree=degree)
+    cdn = jnp.full((n_peers,), cdn_bps)
+    join = jnp.linspace(0.0, stagger_s, n_peers)
+    return config, BITRATES, adjacency, cdn, join, init_swarm(config)
+
+
+def steps_for(config, seconds):
+    return int(seconds * 1000.0 / config.dt_ms)
+
+
+def test_isolated_peers_all_cdn_no_offload():
+    config, bitrates, _, cdn, join, state = scenario()
+    no_adj = jnp.zeros((config.n_peers, config.n_peers))
+    final, _ = run_swarm(config, bitrates, no_adj, cdn, state,
+                         steps_for(config, 120.0), join)
+    assert float(offload_ratio(final)) == 0.0
+    assert float(jnp.sum(final.cdn_bytes)) > 0
+
+
+def test_connected_swarm_offloads():
+    config, bitrates, adjacency, cdn, join, state = scenario()
+    final, series = run_swarm(config, bitrates, adjacency, cdn, state,
+                              steps_for(config, 120.0), join)
+    ratio = float(offload_ratio(final))
+    assert ratio > 0.3
+    # offload grows as caches warm
+    assert float(series[-1]) > float(series[steps_for(config, 10.0)])
+
+
+def test_playback_progresses_and_fast_cdn_no_rebuffer():
+    config, bitrates, adjacency, cdn, join, state = scenario(
+        cdn_bps=20_000_000.0, stagger_s=10.0)
+    final, _ = run_swarm(config, bitrates, adjacency, cdn, state,
+                         steps_for(config, 60.0), join)
+    assert float(jnp.min(final.playhead_s)) > 40.0
+    assert float(rebuffer_ratio(final, 60.0)) < 0.05
+
+
+def test_slow_cdn_rebuffers_and_pins_low_level():
+    config, bitrates, _, _, join, state = scenario(stagger_s=10.0)
+    no_adj = jnp.zeros((config.n_peers, config.n_peers))
+    slow_cdn = jnp.full((config.n_peers,), 250_000.0)  # < lowest bitrate
+    final, _ = run_swarm(config, bitrates, no_adj, slow_cdn, state,
+                         steps_for(config, 120.0), join)
+    assert float(jnp.sum(final.rebuffer_s)) > 0.0
+    assert int(jnp.max(final.level)) == 0  # ABR pinned to the floor
+    # reference analogue: 64 kbps shaping pins loadLevel to 0
+    # (test/html/bundle.js:80-101)
+
+
+def test_abr_steps_up_on_fast_network():
+    config, bitrates, adjacency, cdn, join, state = scenario(
+        cdn_bps=30_000_000.0, stagger_s=10.0)
+    final, _ = run_swarm(config, bitrates, adjacency, cdn, state,
+                         steps_for(config, 60.0), join)
+    # 30 Mbps >> 2 Mbps top bitrate: everyone should reach the top level
+    assert int(jnp.min(final.level)) == 2
+
+
+def test_buffer_bounded_by_max():
+    config, bitrates, adjacency, cdn, join, state = scenario(
+        cdn_bps=50_000_000.0, max_buffer_s=30.0, stagger_s=10.0)
+    final, _ = run_swarm(config, bitrates, adjacency, cdn, state,
+                         steps_for(config, 60.0), join)
+    # one in-flight segment may land after the cap check
+    assert float(jnp.max(final.buffer_s)) <= 30.0 + config.seg_duration_s
+
+
+def test_deterministic():
+    def once():
+        config, bitrates, adjacency, cdn, join, state = scenario()
+        final, _ = run_swarm(config, bitrates, adjacency, cdn, state,
+                             100, join)
+        return jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x).tobytes(), final)
+
+    assert once() == once()
+
+
+def test_byte_accounting_consistent():
+    config, bitrates, adjacency, cdn, join, state = scenario()
+    final, _ = run_swarm(config, bitrates, adjacency, cdn, state,
+                         steps_for(config, 60.0), join)
+    total = float(jnp.sum(final.cdn_bytes) + jnp.sum(final.p2p_bytes))
+    # every completed segment contributed its exact ladder size
+    seg_bytes = BITRATES * config.seg_duration_s / 8.0
+    completions = float(jnp.sum(final.avail * 1.0))
+    expected_min = completions * float(seg_bytes[0])
+    expected_max = completions * float(seg_bytes[-1])
+    assert expected_min <= total <= expected_max
+
+
+# -- multi-device sharding (8 virtual CPU devices) ---------------------
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_sharded_run_matches_single_device():
+    config, bitrates, adjacency, cdn, join, state = scenario(n_peers=64)
+    n = steps_for(config, 30.0)
+    single, _ = run_swarm(config, bitrates, adjacency, cdn, state, n, join)
+    mesh = make_mesh()
+    sharded, _ = sharded_run(mesh, config, bitrates, adjacency, cdn,
+                             state, n, join)
+    for a, b in zip(jax.tree_util.tree_leaves(single),
+                    jax.tree_util.tree_leaves(sharded)):
+        assert jnp.allclose(jnp.asarray(a), jnp.asarray(b), atol=1e-4), \
+            "sharded execution diverged from single-device"
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_sharded_run_with_segment_axis():
+    config, bitrates, adjacency, cdn, join, state = scenario(n_peers=32,
+                                                             n_segments=64)
+    mesh = make_mesh(segment_shards=2)  # 4-way peers x 2-way segments
+    final, _ = sharded_run(mesh, config, bitrates, adjacency, cdn,
+                           state, 50, join)
+    assert float(jnp.sum(final.cdn_bytes + final.p2p_bytes)) > 0
+
+
+def test_rebuffer_ratio_join_aware():
+    config, bitrates, _, _, join, state = scenario()
+    # hand-build a state where one late joiner stalled its whole watch
+    stalled = state._replace(rebuffer_s=state.rebuffer_s.at[-1].set(10.0))
+    join = jnp.zeros((config.n_peers,)).at[-1].set(50.0)
+    diluted = float(rebuffer_ratio(stalled, 60.0))
+    aware = float(rebuffer_ratio(stalled, 60.0, join))
+    # the late peer watched only 10 s: join-aware ratio must be larger
+    assert aware > diluted
