@@ -25,11 +25,19 @@ public stats ``{cdn, p2p, upload, peers}`` and the
 - ``live_buffer_margin``: if set and the stream is live, the agent
   steers the player's buffer target via ``set_buffer_margin_live``
   (player-interface.js:63-66)
+- ``live_edge_spread_ms`` (default 2000): live swarms are nearly
+  synchronized — every viewer wants each new segment the moment it
+  appears, so everyone races to the CDN before any HAVE can
+  propagate.  Each peer therefore waits a stable per-peer fraction of
+  this spread before falling back to the CDN for a segment no peer
+  has yet; low-rank peers seed, the rest catch the HAVE and ride P2P.
+  Skipped when playback is urgent or no peers are connected.
 - scheduling knobs: see :class:`~.scheduler.SchedulingPolicy`
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import uuid
 from typing import Callable, Dict, Optional
@@ -131,6 +139,11 @@ class P2PAgent:
 
         self._current_track = None
         self._live_steered = False
+        self._is_live: Optional[bool] = None  # unknown until manifest
+        # stable edge-fetch rank in [0, 1): who seeds fresh live
+        # segments from the CDN, and who waits for the swarm
+        digest = hashlib.sha256(self.peer_id.encode()).digest()
+        self._edge_rank = int.from_bytes(digest[:4], "little") / 2**32
         self._prefetches: Dict[bytes, object] = {}
         self._prefetch_timer = None
 
@@ -202,8 +215,8 @@ class P2PAgent:
         # 2. source selection
         holders = self.mesh.holders_of(key) if (
             self.mesh is not None and self.p2p_download_on) else []
-        decision = decide(self.policy,
-                          margin_s=self._playback_margin_s(segment_view),
+        margin_s = self._playback_margin_s(segment_view)
+        decision = decide(self.policy, margin_s=margin_s,
                           holder_count=len(holders),
                           download_on=self.p2p_download_on)
 
@@ -212,8 +225,50 @@ class P2PAgent:
                                 callbacks, decision.p2p_budget_ms,
                                 segment_view)
         else:
-            self._start_cdn_leg(request, key, req_info, callbacks)
+            wait_ms = self._edge_wait_ms(holders, margin_s)
+            if wait_ms > 0:
+                self._start_edge_wait(request, key, req_info, callbacks,
+                                      segment_view, wait_ms)
+            else:
+                self._start_cdn_leg(request, key, req_info, callbacks)
         return request
+
+    # -- live edge stagger ---------------------------------------------
+    def _edge_wait_ms(self, holders, margin_s) -> float:
+        """How long to hold the CDN trigger for a fresh live segment no
+        peer serves yet.  0 = fetch now (non-live, urgent, alone, rank
+        says we're a seeder, or toggled off)."""
+        if (holders or not self.p2p_download_on or self.mesh is None
+                or self.mesh.connected_count == 0
+                or not self._check_live()):
+            return 0.0
+        if margin_s is not None and margin_s < self.policy.urgent_margin_s:
+            return 0.0
+        spread = self.p2p_config.get("live_edge_spread_ms", 2_000.0)
+        return self._edge_rank * spread
+
+    def _start_edge_wait(self, request: _GetSegmentRequest, key: bytes,
+                         req_info: Dict, callbacks: Dict,
+                         segment_view, wait_ms: float) -> None:
+        def re_evaluate() -> None:
+            if request.aborted or request.done or self.disposed:
+                return
+            request.failover_timer = None
+            holders = self.mesh.holders_of(key) if self.p2p_download_on \
+                else []
+            if holders:
+                margin_s = self._playback_margin_s(segment_view)
+                decision = decide(self.policy, margin_s=margin_s,
+                                  holder_count=len(holders),
+                                  download_on=True)
+                if decision.use_p2p:
+                    self._start_p2p_leg(request, key, holders[0], req_info,
+                                        callbacks, decision.p2p_budget_ms,
+                                        segment_view)
+                    return
+            self._start_cdn_leg(request, key, req_info, callbacks)
+
+        request.failover_timer = self.clock.call_later(wait_ms, re_evaluate)
 
     def _start_p2p_leg(self, request: _GetSegmentRequest, key: bytes,
                        peer_id: str, req_info: Dict, callbacks: Dict,
@@ -386,6 +441,15 @@ class P2PAgent:
         if self.media_element is None or segment_view.time is None:
             return None
         return segment_view.time - self.media_element.current_time
+
+    def _check_live(self) -> bool:
+        """Cached liveness; False until the manifest can answer."""
+        if self._is_live is None:
+            try:
+                self._is_live = bool(self.player_bridge.is_live())
+            except Exception:  # noqa: BLE001 — manifest not parsed yet
+                return False
+        return self._is_live
 
     def _maybe_steer_live_buffer(self) -> None:
         """Live swarm health: widen/pin the player's buffer target once

@@ -70,3 +70,69 @@ def make_vod_manifest(level_bitrates=(300_000, 800_000, 2_000_000),
 def segment_size_bytes(level: LevelSpec, frag: Frag) -> int:
     """Payload size implied by the level bitrate."""
     return max(1, int(level.bitrate * frag.duration / 8))
+
+
+def make_live_manifest(level_bitrates=(300_000, 800_000, 2_000_000),
+                       window_count: int = 6, seg_duration: float = 4.0,
+                       base_url: str = "http://cdn.example",
+                       first_sn: int = 100) -> Manifest:
+    """A live manifest: a sliding window of ``window_count`` segments
+    ending at the live edge.  Pair with :class:`LiveFeeder` to make
+    the window advance (the reference reads live state from
+    ``level.details.live`` — player-interface.js:36-39)."""
+    manifest = make_vod_manifest(level_bitrates=level_bitrates,
+                                 frag_count=window_count,
+                                 seg_duration=seg_duration,
+                                 base_url=base_url, first_sn=first_sn,
+                                 live=True)
+    return manifest
+
+
+class LiveFeeder:
+    """Advances a live manifest's sliding window in (virtual) real
+    time: every ``seg_duration`` seconds a new fragment appears at the
+    live edge of EVERY level and the oldest slides out.  Fragment
+    lists are mutated in place, so players/maps holding references see
+    updates — exactly how hls.js level.details refreshes on live
+    playlist reloads."""
+
+    def __init__(self, manifest: Manifest, clock):
+        if not manifest.live:
+            raise ValueError("LiveFeeder needs a live manifest")
+        self.manifest = manifest
+        self.clock = clock
+        frags = manifest.levels[0].fragments
+        self.seg_duration = frags[0].duration
+        self.window_count = len(frags)
+        # URL prefixes derive from the manifest's own fragments, so
+        # appended live-edge segments stay on the manifest's CDN host
+        self._prefixes = [level.fragments[-1].url.rsplit("/seg", 1)[0]
+                          for level in manifest.levels]
+        self._timer = None
+        self.stopped = False
+
+    def start(self) -> None:
+        self._arm()
+
+    def _arm(self) -> None:
+        self._timer = self.clock.call_later(self.seg_duration * 1000.0,
+                                            self._advance)
+
+    def _advance(self) -> None:
+        if self.stopped:
+            return
+        for li, level in enumerate(self.manifest.levels):
+            last = level.fragments[-1]
+            sn = last.sn + 1
+            level.fragments.append(
+                Frag(sn=sn, start=sn * self.seg_duration,
+                     duration=self.seg_duration,
+                     url=f"{self._prefixes[li]}/seg{sn}.ts", level=li))
+            while len(level.fragments) > self.window_count:
+                level.fragments.pop(0)
+        self._arm()
+
+    def stop(self) -> None:
+        self.stopped = True
+        if self._timer is not None:
+            self._timer.cancel()

@@ -58,8 +58,11 @@ class Level:
         self.bitrate = spec.bitrate
         self.url = list(spec.urls)
         self.url_id = 0
+        # fragments are shared with the manifest (NOT copied): live
+        # timelines mutate in place and every reader — player,
+        # MediaMap, agent prefetcher — must see the sliding window
         self.details = SimpleNamespace(
-            live=live, fragments=list(spec.fragments),
+            live=live, fragments=spec.fragments,
             totalduration=sum(f.duration for f in spec.fragments))
 
 
@@ -147,6 +150,10 @@ class SimPlayer(EventEmitter):
         # media is set before the event fires: MEDIA_ATTACHING handlers
         # read `player.media` (reference: wrapper-private.js:178-180)
         self.media = media or MediaElementSim()
+        if self.is_live and self._levels is not None:
+            # manifest parsed before attach: join at the live position
+            self.media.current_time = max(self.media.current_time,
+                                          getattr(self, "_live_start_t", 0.0))
         self.emit(Events.MEDIA_ATTACHING, {})
         self._ensure_ticking()
 
@@ -180,8 +187,21 @@ class SimPlayer(EventEmitter):
         manifest = self._manifest
         self._levels = [Level(i, spec, manifest.live)
                         for i, spec in enumerate(manifest.levels)]
-        self.next_sn = manifest.levels[0].fragments[0].sn \
-            if manifest.levels[0].fragments else None
+        frags = manifest.levels[0].fragments
+        if manifest.live and frags:
+            # start behind the live edge by the sync target
+            # (the forced default liveSyncDuration=30 s is usually
+            # clamped by the window — wrapper-private.js:87-89)
+            start_t = max(frags[0].start,
+                          frags[-1].start + frags[-1].duration
+                          - self._live_sync_s())
+            self.next_sn = self._sn_for_time_in(frags, start_t)
+            if self.media is not None:
+                self.media.current_time = start_t
+            self.buffer_end = start_t
+            self._live_start_t = start_t
+        else:
+            self.next_sn = frags[0].sn if frags else None
         self.emit(Events.MANIFEST_PARSED,
                   {"levels": self._levels, "live": manifest.live})
         for i in range(len(self._levels)):
@@ -219,10 +239,26 @@ class SimPlayer(EventEmitter):
         return self._levels[level_index].details.fragments
 
     def _sn_for_time(self, t: float) -> Optional[int]:
-        for frag in self._frags(self.current_level):
+        return self._sn_for_time_in(self._frags(self.current_level), t)
+
+    @staticmethod
+    def _sn_for_time_in(frags, t: float) -> Optional[int]:
+        for frag in frags:
             if frag.start + frag.duration > t:
                 return frag.sn
         return None
+
+    def _live_sync_s(self) -> float:
+        sync = self.config.get("live_sync_duration")
+        if sync is None:
+            count = self.config.get("live_sync_duration_count") or 3
+            seg = self._frags(0)[0].duration if self._frags(0) else 4.0
+            sync = count * seg
+        return float(sync)
+
+    @property
+    def is_live(self) -> bool:
+        return bool(self._manifest is not None and self._manifest.live)
 
     def _frag_by_sn(self, level_index: int, sn: int):
         for frag in self._frags(level_index):
@@ -232,8 +268,17 @@ class SimPlayer(EventEmitter):
 
     def _maybe_fetch(self) -> None:
         if (self._levels is None or self._loading or self.ended
-                or self.media is None or self.next_sn is None):
+                or self.media is None):
             return
+        if self.next_sn is None:
+            # a live seek to/past the edge lands on no fragment yet;
+            # resync once the window catches up — a VOD player here is
+            # simply past the end
+            frags = self._frags(self.current_level)
+            if self.is_live and frags:
+                self._resync_to_live_edge(frags)
+            if self.next_sn is None:
+                return
         if self.buffer_length >= self.config["max_buffer_length"]:
             return
 
@@ -244,6 +289,13 @@ class SimPlayer(EventEmitter):
 
         frag = self._frag_by_sn(self.current_level, self.next_sn)
         if frag is None:
+            if self.is_live:
+                frags = self._frags(self.current_level)
+                if frags and self.next_sn < frags[0].sn:
+                    # fell out of the sliding window: resync behind
+                    # the live edge, like a real player's liveSync jump
+                    self._resync_to_live_edge(frags)
+                return  # at the live edge: wait for new segments
             self.ended = True
             return
 
@@ -302,6 +354,15 @@ class SimPlayer(EventEmitter):
         self.emit(Events.ERROR, {"type": "networkError",
                                  "details": "fragLoadTimeOut", "fatal": False,
                                  "frag": frag})
+
+    def _resync_to_live_edge(self, frags) -> None:
+        start_t = max(frags[0].start,
+                      frags[-1].start + frags[-1].duration
+                      - self._live_sync_s())
+        self.next_sn = self._sn_for_time_in(frags, start_t)
+        if self.media is not None:
+            self.media.current_time = max(self.media.current_time, start_t)
+        self.buffer_end = max(self.buffer_end, start_t)
 
     def _abort_inflight(self) -> None:
         if self._loader is not None:

@@ -127,15 +127,42 @@ def serve_manifest(cdn: MockCdnTransport, manifest) -> None:
     """Serve every fragment URL of a manifest from the mock CDN with
     bitrate-implied payload sizes, synthesized lazily on first fetch
     (a 3-level x 60-frag manifest would otherwise precompute ~90 MB
-    up front)."""
+    up front).  Live manifests resolve by URL pattern so fragments
+    that appear at the live edge later are served too."""
     from ..player.manifest import segment_size_bytes
 
-    sizes = {frag.url: segment_size_bytes(level, frag)
-             for level in manifest.levels for frag in level.fragments}
+    if manifest.live:
+        # bounded by what the origin would actually have: segments
+        # from the first window ever published up to the current live
+        # edge, on the manifest's own URLs (a slid-out segment still
+        # serves, as real origins briefly do)
+        prefixes = [level.fragments[-1].url.rsplit("/seg", 1)[0]
+                    for level in manifest.levels]
+        first_sn_ever = manifest.levels[0].fragments[0].sn
 
-    def resolve(url, headers):
-        if url in sizes:
-            return 200, synthetic_payload(url, sizes[url])
-        return 404, b""
+        def resolve(url, headers):
+            for li, level in enumerate(manifest.levels):
+                prefix = f"{prefixes[li]}/seg"
+                if url.startswith(prefix) and url.endswith(".ts"):
+                    try:
+                        sn = int(url[len(prefix):-3])
+                    except ValueError:
+                        return 404, b""
+                    frags = level.fragments
+                    if first_sn_ever <= sn <= frags[-1].sn:
+                        frag = next((f for f in frags if f.sn == sn),
+                                    frags[0])
+                        return 200, synthetic_payload(
+                            url, segment_size_bytes(level, frag))
+                    return 404, b""
+            return 404, b""
+    else:
+        sizes = {frag.url: segment_size_bytes(level, frag)
+                 for level in manifest.levels for frag in level.fragments}
+
+        def resolve(url, headers):
+            if url in sizes:
+                return 200, synthetic_payload(url, sizes[url])
+            return 404, b""
 
     cdn.resolver = resolve

@@ -21,7 +21,7 @@ from ..core.wrapper import P2PWrapper
 from ..engine.p2p_agent import P2PAgent
 from ..engine.tracker import Tracker, TrackerEndpoint
 from ..engine.transport import LoopbackNetwork
-from ..player.manifest import make_vod_manifest
+from ..player.manifest import LiveFeeder, make_live_manifest, make_vod_manifest
 from ..player.sim import SimPlayer
 from .mock_cdn import MockCdnTransport, serve_manifest
 
@@ -83,11 +83,20 @@ class SwarmHarness:
                  cdn_bandwidth_bps: Optional[float] = None,
                  cdn_latency_ms: float = 15.0,
                  p2p_latency_ms: float = 8.0,
-                 loss_rate: float = 0.0, seed: int = 0):
+                 loss_rate: float = 0.0, seed: int = 0,
+                 live: bool = False):
         self.clock = VirtualClock()
-        self.manifest = make_vod_manifest(level_bitrates=level_bitrates,
-                                          frag_count=frag_count,
-                                          seg_duration=seg_duration)
+        if live:
+            self.manifest = make_live_manifest(level_bitrates=level_bitrates,
+                                               window_count=frag_count,
+                                               seg_duration=seg_duration)
+            self.feeder = LiveFeeder(self.manifest, self.clock)
+            self.feeder.start()
+        else:
+            self.manifest = make_vod_manifest(level_bitrates=level_bitrates,
+                                              frag_count=frag_count,
+                                              seg_duration=seg_duration)
+            self.feeder = None
         self.cdn = MockCdnTransport(self.clock, latency_ms=cdn_latency_ms,
                                     bandwidth_bps=cdn_bandwidth_bps)
         serve_manifest(self.cdn, self.manifest)

@@ -1,0 +1,183 @@
+"""Live streaming: sliding-window timelines, live-edge playback,
+resync, and live swarms with buffer steering — through the real
+wrapper/session/loader stack."""
+
+from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+from hlsjs_p2p_wrapper_tpu.player.manifest import (LiveFeeder,
+                                                   make_live_manifest)
+from hlsjs_p2p_wrapper_tpu.testing.swarm import SwarmHarness
+
+
+def test_live_feeder_slides_window():
+    clock = VirtualClock()
+    manifest = make_live_manifest(window_count=6, seg_duration=4.0,
+                                  first_sn=100)
+    feeder = LiveFeeder(manifest, clock)
+    feeder.start()
+    frags = manifest.levels[0].fragments
+    assert [f.sn for f in frags] == list(range(100, 106))
+    clock.advance(8_000.0)  # two segment durations
+    assert [f.sn for f in frags] == list(range(102, 108))
+    assert len(frags) == 6
+    # all levels slide together
+    assert [f.sn for f in manifest.levels[2].fragments] == \
+        [f.sn for f in frags]
+    feeder.stop()
+    clock.advance(8_000.0)
+    assert [f.sn for f in frags] == list(range(102, 108))
+
+
+def test_live_player_starts_near_edge_and_follows():
+    swarm = SwarmHarness(cdn_bandwidth_bps=20_000_000.0, live=True,
+                         frag_count=8)
+    peer = swarm.add_peer("viewer")
+    swarm.run(2_000.0)
+    edge = swarm.manifest.levels[0].fragments[-1]
+    edge_t = edge.start + edge.duration
+    # joined behind the live edge by the 30 s sync target (the forced
+    # liveSyncDuration default), not at t=0
+    assert edge_t - 35.0 < peer.position_s < edge_t
+    pos_0 = peer.position_s
+    swarm.run(60_000.0)
+    # follows the edge: advanced about as much as wall time
+    assert peer.position_s - pos_0 > 50.0
+    assert not peer.player.ended  # live never "ends"
+    # still inside the (much advanced) window
+    frags = swarm.manifest.levels[0].fragments
+    assert peer.position_s >= frags[0].start - 8.0
+
+
+def test_live_detection_through_real_bridge():
+    swarm = SwarmHarness(cdn_bandwidth_bps=20_000_000.0, live=True,
+                         frag_count=8)
+    peer = swarm.add_peer("viewer")
+    swarm.run(1_000.0)
+    assert peer.agent.player_bridge.is_live() is True
+
+
+def test_vod_not_live_through_real_bridge():
+    swarm = SwarmHarness(cdn_bandwidth_bps=20_000_000.0)
+    peer = swarm.add_peer("viewer")
+    swarm.run(1_000.0)
+    assert peer.agent.player_bridge.is_live() is False
+
+
+def test_live_buffer_steering_mutates_player_config():
+    swarm = SwarmHarness(cdn_bandwidth_bps=20_000_000.0, live=True,
+                         frag_count=8)
+    peer = swarm.add_peer("viewer",
+                          p2p_config={"live_buffer_margin": 12.0})
+    swarm.run(5_000.0)
+    # agent steered the player's buffer policy
+    # (player-interface.js:63-66 semantics)
+    assert peer.player.config["max_buffer_length"] == 12.0
+    assert peer.player.config["max_buffer_size"] == 0
+
+
+def test_vod_stream_not_steered_through_real_stack():
+    swarm = SwarmHarness(cdn_bandwidth_bps=20_000_000.0)
+    peer = swarm.add_peer("viewer",
+                          p2p_config={"live_buffer_margin": 12.0})
+    before = peer.player.config["max_buffer_length"]
+    swarm.run(5_000.0)
+    assert peer.player.config["max_buffer_length"] == before
+
+
+def test_live_swarm_offloads():
+    swarm = SwarmHarness(cdn_bandwidth_bps=20_000_000.0, live=True,
+                         frag_count=10)
+    swarm.add_peer("first")
+    swarm.run(15_000.0)
+    follower = swarm.add_peer("second")
+    swarm.run(90_000.0)
+    # both ride the same live window; overlap should offload
+    assert follower.stats["p2p"] > 0
+    assert swarm.offload_ratio > 0.1
+    assert follower.rebuffer_ms < 5_000.0
+
+
+def test_live_resync_after_long_stall():
+    swarm = SwarmHarness(cdn_bandwidth_bps=20_000_000.0, live=True,
+                         frag_count=6)
+    peer = swarm.add_peer("viewer")
+    swarm.run(5_000.0)
+    # choke the CDN so the player falls out of the sliding window
+    swarm.cdn.bandwidth_bps = 1_000.0
+    swarm.run(60_000.0)
+    swarm.cdn.bandwidth_bps = 20_000_000.0
+    swarm.run(30_000.0)
+    frags = swarm.manifest.levels[0].fragments
+    # recovered: playing inside the current window again
+    assert peer.position_s >= frags[0].start - 8.0
+    assert not peer.player.ended
+
+
+def test_live_edge_stagger_drives_high_offload():
+    def run(spread_ms):
+        swarm = SwarmHarness(cdn_bandwidth_bps=30_000_000.0, live=True,
+                             frag_count=10)
+        for i in range(5):
+            swarm.add_peer(f"v{i}",
+                           p2p_config={"live_edge_spread_ms": spread_ms})
+            swarm.run(5_000.0)
+        swarm.run(200_000.0)
+        return swarm.offload_ratio
+
+    staggered = run(2_000.0)
+    synchronized = run(0.0)
+    # the stagger is what makes live swarms share instead of all
+    # racing the CDN for each fresh segment
+    assert staggered > 0.5
+    assert staggered > synchronized + 0.2
+
+
+def test_live_seek_past_edge_recovers():
+    swarm = SwarmHarness(cdn_bandwidth_bps=20_000_000.0, live=True,
+                         frag_count=8)
+    peer = swarm.add_peer("viewer")
+    swarm.run(5_000.0)
+    frags = swarm.manifest.levels[0].fragments
+    edge_t = frags[-1].start + frags[-1].duration
+    peer.player.seek(edge_t + 1.0)  # beyond any existing fragment
+    loaded_before = peer.player.frags_loaded
+    swarm.run(60_000.0)  # window advances well past the seek target
+    assert peer.player.frags_loaded > loaded_before  # resumed fetching
+    assert not peer.player.ended
+    new_frags = swarm.manifest.levels[0].fragments
+    assert peer.position_s >= new_frags[0].start - 8.0
+
+
+def test_live_feeder_preserves_custom_base_url():
+    clock = VirtualClock()
+    manifest = make_live_manifest(window_count=4, base_url="http://my.cdn")
+    feeder = LiveFeeder(manifest, clock)
+    feeder.start()
+    clock.advance(20_000.0)
+    for level in manifest.levels:
+        for frag in level.fragments:
+            assert frag.url.startswith("http://my.cdn/"), frag.url
+
+
+def test_live_mock_cdn_404s_unpublished_segments():
+    from hlsjs_p2p_wrapper_tpu.testing.mock_cdn import (MockCdnTransport,
+                                                        serve_manifest)
+    clock = VirtualClock()
+    manifest = make_live_manifest(window_count=4, first_sn=100)
+    cdn = MockCdnTransport(clock, latency_ms=1.0)
+    serve_manifest(cdn, manifest)
+    results = {}
+
+    def fetch(url, tag):
+        cdn.fetch({"url": url, "headers": {}},
+                  {"on_progress": lambda e: None,
+                   "on_success": lambda d, t=tag: results.__setitem__(t, 200),
+                   "on_error": lambda e, t=tag: results.__setitem__(t, e["status"])})
+
+    base = manifest.levels[0].fragments[0].url.rsplit("/seg", 1)[0]
+    fetch(f"{base}/seg101.ts", "in_window")
+    fetch(f"{base}/seg99.ts", "before_first")      # never published
+    fetch(f"{base}/seg999.ts", "beyond_edge")       # not yet published
+    fetch("http://other.host/0/seg101.ts", "wrong_host")
+    clock.advance(100.0)
+    assert results == {"in_window": 200, "before_first": 404,
+                       "beyond_edge": 404, "wrong_host": 404}
