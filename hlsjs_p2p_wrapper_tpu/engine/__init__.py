@@ -11,6 +11,7 @@ from .cache import SegmentCache
 from .cdn import CdnTransport, HttpCdnTransport, slice_for_range
 from .cdn_agent import CdnOnlyAgent, StreamTypes
 from .mesh import PeerMesh
+from .net import NetLoop, TcpEndpoint, TcpNetwork
 from .p2p_agent import P2PAgent
 from .scheduler import Decision, SchedulingPolicy, decide
 from .stats import AgentStats
@@ -34,4 +35,5 @@ __all__ = ["CdnTransport", "HttpCdnTransport", "slice_for_range",
            "PeerMesh", "P2PAgent", "PeerAgent", "Decision",
            "SchedulingPolicy", "decide", "Tracker", "TrackerClient",
            "TrackerEndpoint", "swarm_id_for", "Endpoint",
-           "LoopbackNetwork", "default_agent_class"]
+           "LoopbackNetwork", "NetLoop", "TcpEndpoint", "TcpNetwork",
+           "default_agent_class"]

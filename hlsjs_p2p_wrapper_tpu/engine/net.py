@@ -1,0 +1,361 @@
+"""Real-socket peer transport (deployments).
+
+The reference's production transport is WebRTC data channels inside
+the closed-source agent (SURVEY.md §2.4); this module is the
+rebuild's deployable equivalent: TCP with u32-length-prefixed frames,
+carrying exactly the same wire protocol (`engine/protocol.py`) the
+loopback model carries in tests — one engine, two fabrics.
+
+Design points:
+
+- **One event loop per network** (:class:`NetLoop`): socket reader
+  threads never touch engine state; they post frames onto a single
+  dispatcher thread that also implements the :class:`~..core.clock.
+  Clock` protocol.  An agent constructed with ``clock=network.loop``
+  is single-threaded by construction — the same discipline the
+  VirtualClock gives tests, on real time.
+- **Addresses are identities**: a peer's id IS ``"host:port"`` of its
+  listener, assigned at ``register()`` time (the WebRTC analogue is
+  ICE credentials).  Outbound connections send a one-shot peer-id
+  preamble so the receiver can tag inbound frames with their source.
+- Connections are created on first send and reused both ways.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..core.clock import TimerHandle
+
+log = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<I")
+MAX_FRAME_BYTES = 64 * 1024 * 1024  # matches the cache-budget defense
+
+
+class NetLoop:
+    """Single-threaded dispatcher + Clock implementation: timers and
+    inbound frames all execute on one thread."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._queue: list = []
+        self._stopped = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="p2p-netloop")
+        self._thread.start()
+
+    # -- Clock protocol ------------------------------------------------
+    def now(self) -> float:
+        return time.monotonic() * 1000.0
+
+    def call_later(self, delay_ms: float, fn: Callable[[], None]) -> TimerHandle:
+        handle = TimerHandle()
+        due = self.now() + max(float(delay_ms), 0.0)
+        with self._cond:
+            heapq.heappush(self._heap, (due, next(self._seq), fn, handle))
+            self._cond.notify()
+        return handle
+
+    # -- dispatch ------------------------------------------------------
+    def post(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` on the loop thread as soon as possible."""
+        with self._cond:
+            self._queue.append(fn)
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if self._stopped:
+                    return
+                now = self.now()
+                timeout = None
+                if self._queue:
+                    timeout = 0.0
+                elif self._heap:
+                    timeout = max(0.0, (self._heap[0][0] - now) / 1000.0)
+                if timeout != 0.0:
+                    self._cond.wait(timeout)
+                if self._stopped:
+                    return
+                batch, self._queue = self._queue, []
+                now = self.now()
+                while self._heap and self._heap[0][0] <= now:
+                    _, _, fn, handle = heapq.heappop(self._heap)
+                    if not handle.cancelled:
+                        handle._fired = True
+                        batch.append(fn)
+            for fn in batch:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001
+                    log.exception("unhandled error on net loop")
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify()
+
+
+class _Connection:
+    """One TCP link, reused for both directions.
+
+    Writes never block the caller: frames go onto a per-connection
+    queue drained by a writer thread, which also performs the
+    (blocking) connect + preamble for outbound links — the NetLoop
+    dispatcher must never stall on socket I/O."""
+
+    MAX_QUEUED_FRAMES = 4096
+
+    def __init__(self, endpoint: "TcpEndpoint", remote_id: str,
+                 sock: Optional[socket.socket] = None):
+        self.endpoint = endpoint
+        self.remote_id = remote_id
+        self.sock = sock  # None → outbound; writer thread connects
+        self.closed = False
+        self._queue: list = []
+        self._cond = threading.Condition()
+        self._writer = threading.Thread(target=self._write_loop, daemon=True,
+                                        name=f"p2p-writer-{remote_id}")
+
+    def start(self) -> None:
+        """Begin I/O.  Called AFTER the endpoint has registered this
+        connection — a fast connect failure must not race the
+        registration and resurrect a pruned entry."""
+        self._writer.start()
+        if self.sock is not None:
+            threading.Thread(target=self.endpoint._reader_loop, args=(self,),
+                             daemon=True).start()
+
+    def enqueue(self, frame: bytes) -> bool:
+        with self._cond:
+            if self.closed or len(self._queue) >= self.MAX_QUEUED_FRAMES:
+                return False
+            self._queue.append(frame)
+            self._cond.notify()
+            return True
+
+    def _write_loop(self) -> None:
+        if self.sock is None:
+            sock = self._connect_with_preamble()
+            if sock is None:
+                self.close()
+                return
+            self.sock = sock
+            threading.Thread(target=self.endpoint._reader_loop, args=(self,),
+                             daemon=True).start()
+        while True:
+            with self._cond:
+                while not self._queue and not self.closed:
+                    self._cond.wait()
+                if self.closed:
+                    return
+                frame = self._queue.pop(0)
+            try:
+                self.sock.sendall(_LEN.pack(len(frame)) + frame)
+                self.endpoint.bytes_sent += len(frame)
+            except OSError:
+                self.close()
+                return
+
+    def _connect_with_preamble(self) -> Optional[socket.socket]:
+        try:
+            host, port_s = self.remote_id.rsplit(":", 1)
+            sock = socket.create_connection((host, int(port_s)), timeout=5.0)
+            sock.settimeout(None)  # connect timeout must not poison recv
+            raw = self.endpoint.peer_id.encode()
+            sock.sendall(_LEN.pack(len(raw)) + raw)
+            return sock
+        except (OSError, ValueError):
+            return None
+
+    def close(self) -> None:
+        with self._cond:
+            if self.closed:
+                return
+            self.closed = True
+            self._queue.clear()
+            self._cond.notify_all()
+        if self.sock is not None:
+            try:
+                # shutdown, not just close: close() while the reader
+                # thread is blocked in recv neither wakes it nor sends
+                # FIN (the in-flight syscall pins the open file);
+                # shutdown delivers EOF to both sides immediately
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        self.endpoint._forget(self)
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None  # connection torn down under us
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _read_frame(sock: socket.socket) -> Optional[bytes]:
+    header = _read_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME_BYTES:
+        return None  # poisoned stream; drop the connection
+    return _read_exact(sock, length)
+
+
+class TcpEndpoint:
+    """Socket-backed endpoint with the same surface the engine uses on
+    the loopback fabric: ``peer_id``, ``send(dest_id, frame)``,
+    ``on_receive``, ``close()``."""
+
+    def __init__(self, network: "TcpNetwork", host: str):
+        self.network = network
+        self.loop = network.loop
+        self.on_receive: Optional[Callable[[str, bytes], None]] = None
+        self.closed = False
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self._conns: Dict[str, _Connection] = {}
+        self._conn_lock = threading.Lock()
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, 0))
+        self._listener.listen(16)
+        self.peer_id = f"{host}:{self._listener.getsockname()[1]}"
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"p2p-accept-{self.peer_id}").start()
+
+    # -- outbound ------------------------------------------------------
+    def send(self, dest_id: str, frame: bytes) -> bool:
+        """Queue a frame; never blocks.  True means queued — like the
+        loopback fabric, delivery is not acknowledged and receivers
+        rely on protocol timeouts."""
+        if self.closed:
+            return False
+        started = None
+        with self._conn_lock:
+            conn = self._conns.get(dest_id)
+            if conn is None or conn.closed:
+                conn = started = _Connection(self, dest_id)
+                self._conns[dest_id] = conn
+        queued = conn.enqueue(frame)
+        if started is not None:
+            started.start()
+        return queued
+
+    def _forget(self, conn: "_Connection") -> None:
+        """Prune a dead connection so reconnects get a fresh link."""
+        with self._conn_lock:
+            if self._conns.get(conn.remote_id) is conn:
+                del self._conns[conn.remote_id]
+
+    # -- inbound -------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self.closed:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handshake_inbound, args=(sock,),
+                             daemon=True).start()
+
+    def _handshake_inbound(self, sock: socket.socket) -> None:
+        preamble = _read_frame(sock)
+        if preamble is None:
+            sock.close()
+            return
+        try:
+            remote_id = preamble.decode("utf-8")
+        except UnicodeDecodeError:
+            sock.close()
+            return
+        conn = _Connection(self, remote_id, sock)
+        with self._conn_lock:
+            # reuse: an inbound link doubles as our outbound to them;
+            # a stale dead entry must not shadow the fresh link
+            existing = self._conns.get(remote_id)
+            if existing is None or existing.closed:
+                self._conns[remote_id] = conn
+        conn.start()
+
+    def _reader_loop(self, conn: _Connection) -> None:
+        while not self.closed and not conn.closed:
+            frame = _read_frame(conn.sock)
+            if frame is None:
+                conn.close()
+                return
+            self.bytes_received += len(frame)
+            src = conn.remote_id
+
+            def deliver(frame=frame, src=src) -> None:
+                if not self.closed and self.on_receive is not None:
+                    self.on_receive(src, frame)
+
+            self.loop.post(deliver)
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:  # outside the lock: close() calls _forget()
+            conn.close()
+        self.network._forget_endpoint(self)
+
+
+class TcpNetwork:
+    """Factory matching the engine's network contract
+    (``register(peer_id, uplink_bps) -> endpoint``).  The requested
+    peer id is ignored — on a real fabric the listener address IS the
+    identity; callers must adopt ``endpoint.peer_id``."""
+
+    def __init__(self, host: str = "127.0.0.1",
+                 loop: Optional[NetLoop] = None):
+        self.host = host
+        self.loop = loop or NetLoop()
+        self._endpoints: list = []
+
+    def register(self, peer_id: Optional[str] = None,
+                 uplink_bps: Optional[float] = None) -> TcpEndpoint:
+        # uplink shaping is the OS/network's job on a real fabric
+        endpoint = TcpEndpoint(self, self.host)
+        self._endpoints.append(endpoint)
+        return endpoint
+
+    def _forget_endpoint(self, endpoint: TcpEndpoint) -> None:
+        """Closed endpoints must not accumulate for the network's
+        lifetime (agents come and go on one shared fabric)."""
+        if endpoint in self._endpoints:
+            self._endpoints.remove(endpoint)
+
+    def close(self) -> None:
+        for endpoint in list(self._endpoints):
+            endpoint.close()
+        self.loop.stop()

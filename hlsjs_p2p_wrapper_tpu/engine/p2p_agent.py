@@ -140,10 +140,6 @@ class P2PAgent:
         self._current_track = None
         self._live_steered = False
         self._is_live: Optional[bool] = None  # unknown until manifest
-        # stable edge-fetch rank in [0, 1): who seeds fresh live
-        # segments from the CDN, and who waits for the swarm
-        digest = hashlib.sha256(self.peer_id.encode()).digest()
-        self._edge_rank = int.from_bytes(digest[:4], "little") / 2**32
         self._prefetches: Dict[bytes, object] = {}
         self._prefetch_timer = None
 
@@ -151,6 +147,9 @@ class P2PAgent:
         if network is not None:
             self.endpoint = network.register(
                 self.peer_id, uplink_bps=cfg.get("uplink_bps"))
+            # real fabrics assign identity at bind time (TcpNetwork:
+            # the listener address IS the peer id); adopt it
+            self.peer_id = self.endpoint.peer_id
             self.mesh = PeerMesh(
                 self.endpoint, self.swarm_id, self.clock, self.cache,
                 request_timeout_ms=cfg.get("request_timeout_ms",
@@ -170,6 +169,14 @@ class P2PAgent:
             self.endpoint = None
             self.mesh = None
             self.tracker_client = None
+
+        # stable edge-fetch rank in [0, 1): who seeds fresh live
+        # segments from the CDN, and who waits for the swarm.  Hashed
+        # from the ADOPTED id — real fabrics assign identity at
+        # register time, and a config-supplied id they ignore would
+        # give every viewer the same rank (thundering herd).
+        digest = hashlib.sha256(self.peer_id.encode()).digest()
+        self._edge_rank = int.from_bytes(digest[:4], "little") / 2**32
 
         player_bridge.add_event_listener("onTrackChange", self._on_track_change)
 

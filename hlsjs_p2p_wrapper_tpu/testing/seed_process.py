@@ -1,0 +1,99 @@
+"""Standalone seeder process for cross-process swarm tests/demos.
+
+Run: ``python -m hlsjs_p2p_wrapper_tpu.testing.seed_process
+<tracker_host:port> <content_id> <sn> <size>``
+
+Joins the swarm over real TCP, fetches one segment from a synthetic
+instant CDN (caching + announcing it), prints ``READY`` on stdout, and
+serves peers until stdin closes — the minimal living proof that two
+OS processes exchange segments through this framework's real-socket
+transport.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+
+class InstantCdn:
+    """Deterministic origin: sn-derived payload, served synchronously."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def fetch(self, req_info, callbacks):
+        seed = req_info["url"].encode()
+        payload = bytes((seed[i % len(seed)] + i) % 256
+                        for i in range(self.size))
+        callbacks["on_progress"]({"cdn_downloaded": len(payload)})
+        callbacks["on_success"](payload)
+
+        class Handle:
+            def abort(self):
+                pass
+
+        return Handle()
+
+
+class NullBridge:
+    def add_event_listener(self, name, fn):
+        pass
+
+    def get_buffer_level_max(self):
+        return 30.0
+
+    def is_live(self):
+        return False
+
+
+class NullMediaMap:
+    def get_segment_list(self, track_view, begin_time, duration):
+        return []
+
+
+def main() -> int:
+    tracker_addr, content_id, sn_s, size_s = sys.argv[1:5]
+    sn, size = int(sn_s), int(size_s)
+
+    from ..core.segment_view import SegmentView
+    from ..core.track_view import TrackView
+    from ..engine.net import TcpNetwork
+    from ..engine.p2p_agent import P2PAgent
+
+    network = TcpNetwork()
+    agent = P2PAgent(
+        NullBridge(), "http://cdn.example/master.m3u8", NullMediaMap(),
+        {"network": network, "clock": network.loop,
+         "cdn_transport": InstantCdn(size),
+         "tracker_peer_id": tracker_addr, "content_id": content_id,
+         "announce_interval_ms": 200.0},
+        SegmentView, "hls", "v2")
+
+    done = threading.Event()
+    outcome = {}
+    segment_view = SegmentView(sn=sn,
+                               track_view=TrackView(level=0, url_id=0),
+                               time=sn * 10.0)
+    # callbacks run on the NetLoop thread: record + signal (sys.exit
+    # there would only kill the loop thread and swallow the message)
+    agent.get_segment(
+        {"url": f"http://cdn.example/seg{sn}.ts", "headers": {}},
+        {"on_success": lambda d: (outcome.__setitem__("ok", True),
+                                  done.set()),
+         "on_error": lambda e: (outcome.__setitem__("error", e),
+                                done.set()),
+         "on_progress": lambda e: None}, segment_view)
+    if not done.wait(10.0) or "error" in outcome:
+        print(f"SEED-FAILED {outcome.get('error', 'timeout')}", flush=True)
+        return 1
+
+    print(f"READY {agent.peer_id}", flush=True)
+    sys.stdin.read()  # serve until the parent closes our stdin
+    agent.dispose()
+    network.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
