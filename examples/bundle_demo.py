@@ -1,0 +1,39 @@
+"""Bundle integration style (reference: example/bundle/index.html —
+``new Hls(hlsjsConfig, p2pConfig)``): the bundle IS the player
+constructor; one call returns a fully wired player.
+
+Run: ``python examples/bundle_demo.py``
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.config import CONTENT_URL, make_scenario, p2p_config  # noqa: E402
+from hlsjs_p2p_wrapper_tpu import P2PBundle  # noqa: E402
+
+
+def main():
+    clock, manifest, cdn, network = make_scenario()
+
+    player = P2PBundle(
+        {"clock": clock, "manifest": manifest},
+        p2p_config(clock, cdn, network, "bundle-demo-peer"))
+    player.load_source(CONTENT_URL)
+    player.attach_media()
+
+    for _ in range(6):
+        clock.advance(10_000.0)
+        print(f"t={clock.now()/1000:5.0f}s  position={player.media.current_time:6.1f}s  "
+              f"level={player.current_level}  buffer={player.buffer_length:4.1f}s  "
+              f"rebuffer={player.rebuffer_ms:.0f}ms")
+
+    print(f"\nplayed through {player.media.current_time:.1f}s of "
+          f"{manifest.duration:.0f}s, {player.frags_loaded} fragments, "
+          f"{player.bytes_loaded/1e6:.1f} MB")
+    player.destroy()
+
+
+if __name__ == "__main__":
+    main()

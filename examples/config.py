@@ -1,0 +1,31 @@
+"""Shared demo scenario (the reference's example/config.js analogue):
+one place for the stream, CDN shaping, and P2P knobs every demo uses."""
+
+from hlsjs_p2p_wrapper_tpu.core import VirtualClock
+from hlsjs_p2p_wrapper_tpu.engine import (LoopbackNetwork, Tracker,
+                                          TrackerEndpoint)
+from hlsjs_p2p_wrapper_tpu.player import make_vod_manifest
+from hlsjs_p2p_wrapper_tpu.testing import MockCdnTransport, serve_manifest
+
+CONTENT_URL = "http://demo.cdn/master.m3u8"
+LEVEL_BITRATES = (300_000, 800_000, 2_000_000)
+
+
+def make_scenario(cdn_bandwidth_bps=8_000_000.0):
+    """A deterministic world: virtual clock, 3-level VOD stream, shaped
+    mock CDN, loopback swarm network with a tracker."""
+    clock = VirtualClock()
+    manifest = make_vod_manifest(level_bitrates=LEVEL_BITRATES,
+                                 frag_count=40, seg_duration=4.0)
+    cdn = MockCdnTransport(clock, latency_ms=15.0,
+                           bandwidth_bps=cdn_bandwidth_bps)
+    serve_manifest(cdn, manifest)
+    network = LoopbackNetwork(clock, default_latency_ms=8.0)
+    TrackerEndpoint(Tracker(clock), network.register("tracker"))
+    return clock, manifest, cdn, network
+
+
+def p2p_config(clock, cdn, network, peer_id):
+    return {"clock": clock, "cdn_transport": cdn, "network": network,
+            "peer_id": peer_id, "content_id": "demo-content",
+            "announce_interval_ms": 2_000.0}

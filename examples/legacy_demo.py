@@ -1,0 +1,46 @@
+"""Legacy integration style (reference: example/legacy/index.html +
+MIGRATION.md — app owns the player, installs ``wrapper.P2PLoader``
+itself, then calls ``createSRModule`` once the manifest is loading).
+
+Run: ``python examples/legacy_demo.py``
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.config import CONTENT_URL, make_scenario, p2p_config  # noqa: E402
+from hlsjs_p2p_wrapper_tpu import P2PWrapper  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.core import Events  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.player import SimPlayer  # noqa: E402
+
+
+def main():
+    clock, manifest, cdn, network = make_scenario()
+    wrapper = P2PWrapper(clock=clock)  # no player class: app owns it
+
+    # the app constructs the player itself and must apply the buffer
+    # config + fragment loader on its own (reference README.md:188-215)
+    player = SimPlayer({"clock": clock, "manifest": manifest,
+                        "f_loader": wrapper.P2PLoader,
+                        "max_buffer_size": 0, "max_buffer_length": 30})
+
+    def on_manifest_loading(_data):
+        wrapper.create_sr_module(
+            p2p_config(clock, cdn, network, "legacy-demo-peer"),
+            player, Events, content_id="legacy-content-42")
+
+    player.on(Events.MANIFEST_LOADING, on_manifest_loading)
+    player.load_source(CONTENT_URL)
+    player.attach_media()
+
+    clock.advance(40_000.0)
+    print(f"position={player.media.current_time:.1f}s  "
+          f"stats={wrapper.stats}  has_session={wrapper.has_session}")
+    player.destroy()
+    print(f"after destroy: has_session={wrapper.has_session}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""DI-wrapper integration style (reference: example/custom/index.html —
+``new HlsjsP2PWrapper(Hls)`` then ``wrapper.createPlayer(...)``): you
+bring the player class; the wrapper wires the P2P engine into it and
+exposes stats/toggles.
+
+Run: ``python examples/wrapper_demo.py``
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.config import CONTENT_URL, make_scenario, p2p_config  # noqa: E402
+from hlsjs_p2p_wrapper_tpu import P2PWrapper  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.player import SimPlayer  # noqa: E402
+
+
+def main():
+    clock, manifest, cdn, network = make_scenario()
+
+    # two viewers so the wrapper stats show actual P2P traffic
+    players = []
+    wrappers = []
+    for name in ("viewer-a", "viewer-b"):
+        wrapper = P2PWrapper(SimPlayer, clock=clock)  # DI of the player class
+        player = wrapper.create_player(
+            {"clock": clock, "manifest": manifest},
+            p2p_config(clock, cdn, network, name))
+        player.load_source(CONTENT_URL)
+        player.attach_media()
+        wrappers.append(wrapper)
+        players.append(player)
+        clock.advance(15_000.0)  # stagger the joins
+
+    clock.advance(60_000.0)
+
+    for name, wrapper in zip(("viewer-a", "viewer-b"), wrappers):
+        stats = wrapper.stats  # {cdn, p2p, upload, peers}
+        total = stats["cdn"] + stats["p2p"]
+        print(f"{name}: {stats}  offload={stats['p2p']/total:.1%}")
+
+    # public toggles (reference: wrapper.p2pDownloadOn/p2pUploadOn)
+    wrappers[1].p2p_download_on = False
+    print(f"viewer-b download toggle -> {wrappers[1].p2p_download_on}")
+    for player in players:
+        player.destroy()
+
+
+if __name__ == "__main__":
+    main()

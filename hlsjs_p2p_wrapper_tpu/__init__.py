@@ -12,12 +12,13 @@ scheduling, swarm simulation) expressed as JAX ops that run on TPU.
 Layout:
   core/      content addressing, loader state machine, session, facades
   engine/    the in-tree P2P delivery engine (tracker, mesh, cache,
-             scheduler, CDN fallback, stats)
-  ops/       JAX/TPU numeric ops (EWMA estimator, scheduler scoring)
-  models/    learned-ABR policy model (flagship model for TPU training)
-  parallel/  SPMD swarm simulator over jax.sharding meshes
-  testing/   first-class fakes (sim player, mock CDN) — the reference's
-             test mocks promoted to supported tooling
+             scheduler, CDN transports, loopback + TCP fabrics)
+  player/    deterministic hls.js-shaped sim player (VOD + live)
+  ops/       JAX/TPU numeric ops (batched EWMA estimation, the
+             device-resident swarm+ABR simulator)
+  parallel/  jax.sharding meshes + canonical shardings for the sim
+  testing/   first-class fakes + the multi-player SwarmHarness — the
+             reference's test mocks promoted to supported tooling
 """
 
 from .core import P2PBundle, P2PWrapper

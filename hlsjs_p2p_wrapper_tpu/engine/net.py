@@ -151,7 +151,20 @@ class _Connection:
             if sock is None:
                 self.close()
                 return
-            self.sock = sock
+            with self._cond:
+                # close() may have raced the connect: it saw sock=None
+                # and closed nothing, so this thread owns the cleanup
+                if self.closed:
+                    closed_during_connect = True
+                else:
+                    closed_during_connect = False
+                    self.sock = sock
+            if closed_during_connect:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
             threading.Thread(target=self.endpoint._reader_loop, args=(self,),
                              daemon=True).start()
         while True:
@@ -215,12 +228,13 @@ def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return bytes(buf)
 
 
-def _read_frame(sock: socket.socket) -> Optional[bytes]:
+def _read_frame(sock: socket.socket,
+                max_bytes: int = MAX_FRAME_BYTES) -> Optional[bytes]:
     header = _read_exact(sock, _LEN.size)
     if header is None:
         return None
     (length,) = _LEN.unpack(header)
-    if length > MAX_FRAME_BYTES:
+    if length > max_bytes:
         return None  # poisoned stream; drop the connection
     return _read_exact(sock, length)
 
@@ -282,8 +296,13 @@ class TcpEndpoint:
             threading.Thread(target=self._handshake_inbound, args=(sock,),
                              daemon=True).start()
 
+    #: a peer-id preamble is a short host:port string — an
+    #: unauthenticated connection must not get to buffer a full-size
+    #: frame before identity validation
+    MAX_PREAMBLE_BYTES = 512
+
     def _handshake_inbound(self, sock: socket.socket) -> None:
-        preamble = _read_frame(sock)
+        preamble = _read_frame(sock, max_bytes=self.MAX_PREAMBLE_BYTES)
         if preamble is None:
             sock.close()
             return
@@ -317,14 +336,16 @@ class TcpEndpoint:
             self.loop.post(deliver)
 
     def close(self) -> None:
-        self.closed = True
+        with self._conn_lock:
+            if self.closed:
+                return  # idempotent: dispose() and network.close() race
+            self.closed = True
+            conns = list(self._conns.values())
+            self._conns.clear()
         try:
             self._listener.close()
         except OSError:
             pass
-        with self._conn_lock:
-            conns = list(self._conns.values())
-            self._conns.clear()
         for conn in conns:  # outside the lock: close() calls _forget()
             conn.close()
         self.network._forget_endpoint(self)
@@ -339,23 +360,34 @@ class TcpNetwork:
     def __init__(self, host: str = "127.0.0.1",
                  loop: Optional[NetLoop] = None):
         self.host = host
+        self._owns_loop = loop is None
         self.loop = loop or NetLoop()
         self._endpoints: list = []
+        self._endpoints_lock = threading.Lock()
 
     def register(self, peer_id: Optional[str] = None,
                  uplink_bps: Optional[float] = None) -> TcpEndpoint:
         # uplink shaping is the OS/network's job on a real fabric
         endpoint = TcpEndpoint(self, self.host)
-        self._endpoints.append(endpoint)
+        with self._endpoints_lock:
+            self._endpoints.append(endpoint)
         return endpoint
 
     def _forget_endpoint(self, endpoint: TcpEndpoint) -> None:
         """Closed endpoints must not accumulate for the network's
         lifetime (agents come and go on one shared fabric)."""
-        if endpoint in self._endpoints:
-            self._endpoints.remove(endpoint)
+        with self._endpoints_lock:
+            try:
+                self._endpoints.remove(endpoint)
+            except ValueError:
+                pass  # concurrent close already removed it
 
     def close(self) -> None:
-        for endpoint in list(self._endpoints):
+        with self._endpoints_lock:
+            endpoints = list(self._endpoints)
+        for endpoint in endpoints:
             endpoint.close()
-        self.loop.stop()
+        # a caller-injected loop may serve other networks — only stop
+        # what we created
+        if self._owns_loop:
+            self.loop.stop()

@@ -16,24 +16,26 @@ import sys
 import threading
 
 
+class _NullHandle:
+    def abort(self):
+        pass
+
+
 class InstantCdn:
-    """Deterministic origin: sn-derived payload, served synchronously."""
+    """Deterministic origin: URL-derived payload (the canonical
+    ``synthetic_payload``), served synchronously on the caller thread."""
 
     def __init__(self, size: int):
         self.size = size
+        self.fetch_count = 0
 
     def fetch(self, req_info, callbacks):
-        seed = req_info["url"].encode()
-        payload = bytes((seed[i % len(seed)] + i) % 256
-                        for i in range(self.size))
+        from .mock_cdn import synthetic_payload
+        self.fetch_count += 1
+        payload = synthetic_payload(req_info["url"], self.size)
         callbacks["on_progress"]({"cdn_downloaded": len(payload)})
         callbacks["on_success"](payload)
-
-        class Handle:
-            def abort(self):
-                pass
-
-        return Handle()
+        return _NullHandle()
 
 
 class NullBridge:

@@ -11,6 +11,9 @@ from hlsjs_p2p_wrapper_tpu.core.track_view import TrackView
 from hlsjs_p2p_wrapper_tpu.engine.net import NetLoop, TcpNetwork
 from hlsjs_p2p_wrapper_tpu.engine.p2p_agent import P2PAgent
 from hlsjs_p2p_wrapper_tpu.engine.tracker import Tracker, TrackerEndpoint
+from hlsjs_p2p_wrapper_tpu.testing.seed_process import (InstantCdn,
+                                                        NullBridge,
+                                                        NullMediaMap)
 
 
 def wait_for(predicate, timeout_s=8.0, interval_s=0.02):
@@ -116,42 +119,6 @@ def test_deliveries_serialized_on_loop_thread(net):
     assert len(threads) == 1  # single dispatcher thread
 
 
-class _Bridge:
-    def add_event_listener(self, name, fn):
-        pass
-
-    def get_buffer_level_max(self):
-        return 30.0
-
-    def is_live(self):
-        return False
-
-
-class _MediaMap:
-    def get_segment_list(self, track_view, begin_time, duration):
-        return []
-
-
-class _InstantCdn:
-    """Serves synthetic bytes immediately on the caller thread."""
-
-    def __init__(self, size=100_000):
-        self.size = size
-        self.fetch_count = 0
-
-    def fetch(self, req_info, callbacks):
-        self.fetch_count += 1
-        payload = b"\xCD" * self.size
-        callbacks["on_progress"]({"cdn_downloaded": len(payload)})
-        callbacks["on_success"](payload)
-
-        class H:
-            def abort(self):
-                pass
-
-        return H()
-
-
 def sv(sn):
     return SegmentView(sn=sn, track_view=TrackView(level=0, url_id=0),
                        time=sn * 10.0)
@@ -165,9 +132,9 @@ def test_agent_swarm_over_real_sockets(net):
 
     def make_agent():
         return P2PAgent(
-            _Bridge(), "http://cdn.example/master.m3u8", _MediaMap(),
+            NullBridge(), "http://cdn.example/master.m3u8", NullMediaMap(),
             {"network": net, "clock": net.loop,
-             "cdn_transport": _InstantCdn(),
+             "cdn_transport": InstantCdn(100_000),
              "tracker_peer_id": tracker_endpoint.peer_id,
              "content_id": "tcp-demo",
              "announce_interval_ms": 200.0,
@@ -238,9 +205,6 @@ def test_cross_process_swarm():
         assert ready.startswith("READY "), ready
         seeder_id = ready.split()[1]
 
-        from hlsjs_p2p_wrapper_tpu.testing.seed_process import (InstantCdn,
-                                                                NullBridge,
-                                                                NullMediaMap)
         follower = P2PAgent(
             NullBridge(), "http://cdn.example/master.m3u8", NullMediaMap(),
             {"network": net, "clock": net.loop,
@@ -264,12 +228,11 @@ def test_cross_process_swarm():
                  "on_error": lambda e: pytest.fail(f"xproc error {e}"),
                  "on_progress": lambda e: None}, sv(sn))
             assert wait_for(got.is_set, timeout_s=15.0)
-            # deterministic sn-derived payload proves it came intact
+            # deterministic URL-derived payload proves it came intact
             # from the OTHER PROCESS (follower's CDN was never asked)
-            seed = f"http://cdn.example/seg{sn}.ts".encode()
-            expected = bytes((seed[i % len(seed)] + i) % 256
-                             for i in range(size))
-            assert results["data"] == expected
+            from hlsjs_p2p_wrapper_tpu.testing.mock_cdn import synthetic_payload
+            assert results["data"] == synthetic_payload(
+                f"http://cdn.example/seg{sn}.ts", size)
             assert follower.stats["p2p"] == size
             assert follower.stats["cdn"] == 0
         finally:
