@@ -1,0 +1,30 @@
+# Developer entry points (the reference's npm-script surface:
+# test / karma / lint / build — package.json:15-27)
+
+PY ?= python
+
+.PHONY: test lint bench examples dryrun check all
+
+test:
+	$(PY) -m pytest tests/ -q
+
+lint:
+	$(PY) tools/lint.py
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); \
+	            import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+examples:
+	$(PY) examples/bundle_demo.py
+	$(PY) examples/wrapper_demo.py
+	$(PY) examples/legacy_demo.py
+	$(PY) examples/swarm_demo.py
+
+check: lint test dryrun
+
+all: check bench

@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Callable
 
 from .errors import ConfigurationError, PlayerStateError
-from .events import EventEmitter, Events
+from .events import EventEmitter
 from .track_view import TrackView
 
 

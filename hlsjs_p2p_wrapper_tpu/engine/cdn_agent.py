@@ -11,7 +11,7 @@ production fallback when WebRTC is unavailable.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict
 
 from ..core.clock import Clock, SystemClock
 from .cdn import CdnTransport, HttpCdnTransport

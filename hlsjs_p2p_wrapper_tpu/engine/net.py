@@ -252,6 +252,7 @@ class TcpEndpoint:
         self.bytes_sent = 0
         self.bytes_received = 0
         self._conns: Dict[str, _Connection] = {}
+        self._extra_conns: list = []  # crossed-dial inbound links
         self._conn_lock = threading.Lock()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -267,10 +268,12 @@ class TcpEndpoint:
         """Queue a frame; never blocks.  True means queued — like the
         loopback fabric, delivery is not acknowledged and receivers
         rely on protocol timeouts."""
-        if self.closed:
-            return False
         started = None
         with self._conn_lock:
+            # closed-check inside the lock: a send racing close() must
+            # not register a fresh connection on a dead endpoint
+            if self.closed:
+                return False
             conn = self._conns.get(dest_id)
             if conn is None or conn.closed:
                 conn = started = _Connection(self, dest_id)
@@ -285,6 +288,8 @@ class TcpEndpoint:
         with self._conn_lock:
             if self._conns.get(conn.remote_id) is conn:
                 del self._conns[conn.remote_id]
+            elif conn in self._extra_conns:
+                self._extra_conns.remove(conn)
 
     # -- inbound -------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -318,6 +323,12 @@ class TcpEndpoint:
             existing = self._conns.get(remote_id)
             if existing is None or existing.closed:
                 self._conns[remote_id] = conn
+            else:
+                # crossed dial: both sides connected simultaneously.
+                # This inbound IS the remote's working outbound — keep
+                # reading from it, but track it separately so close()
+                # still reaps it (untracked = socket+thread leak)
+                self._extra_conns.append(conn)
         conn.start()
 
     def _reader_loop(self, conn: _Connection) -> None:
@@ -340,8 +351,9 @@ class TcpEndpoint:
             if self.closed:
                 return  # idempotent: dispose() and network.close() race
             self.closed = True
-            conns = list(self._conns.values())
+            conns = list(self._conns.values()) + list(self._extra_conns)
             self._conns.clear()
+            self._extra_conns.clear()
         try:
             self._listener.close()
         except OSError:

@@ -25,8 +25,8 @@ Strings are u16-length-prefixed UTF-8.  Segment keys are the raw
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass, field
-from typing import List, Tuple
+from dataclasses import dataclass
+from typing import Tuple
 
 from ..core.segment_view import WIRE_SIZE, SegmentView
 

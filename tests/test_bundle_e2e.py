@@ -5,7 +5,7 @@ wrapper with the CDN-only engine, seek, and ABR under shaping."""
 import pytest
 
 from hlsjs_p2p_wrapper_tpu import P2PBundle, P2PWrapper
-from hlsjs_p2p_wrapper_tpu.core import Events, VirtualClock
+from hlsjs_p2p_wrapper_tpu.core import VirtualClock
 from hlsjs_p2p_wrapper_tpu.engine import CdnOnlyAgent
 from hlsjs_p2p_wrapper_tpu.player import SimPlayer, make_vod_manifest
 from hlsjs_p2p_wrapper_tpu.testing import MockCdnTransport, serve_manifest

