@@ -14,10 +14,10 @@ lint:
 bench:
 	$(PY) bench.py
 
+# dryrun_multichip self-provisions the virtual 8-CPU mesh (subprocess
+# with JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count).
 dryrun:
-	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	  $(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); \
-	            import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
 
 examples:
 	$(PY) examples/bundle_demo.py
