@@ -6,20 +6,29 @@ and ``peers`` stats move (README.md:230-237), and availability is
 addressed by the 12-byte segment key (segment-view.js:59-61).  This
 module implements that half from scratch:
 
-- handshake (HELLO + full BITFIELD), truthful incremental HAVE/LOST
-- chunked segment transfer with offset-addressed reassembly, so
-  progress is incremental and frames stay small enough to interleave
-  on a shaped uplink
+- handshake (HELLO + full BITFIELD), truthful incremental HAVE/LOST,
+  with HELLO re-sent on later tracker rounds if the first one was
+  lost (a lossy fabric must not leave a pair permanently strangers)
+- chunked segment transfer with strictly sequential reassembly —
+  chunks must arrive in offset order with no gaps or overlaps (both
+  fabrics are FIFO per link), so a completed download is covered
+  end-to-end, never hole-filled
+- content integrity: announcements carry ``(size, sha256)``; the
+  downloader records them at request time and verifies the
+  reassembled payload, dropping any peer whose bytes don't match
+  what it announced (content-poisoning defense)
 - upload serving straight out of the cache, gated by the public
-  ``p2p_upload_on`` toggle
+  ``p2p_upload_on`` toggle; the ``upload`` stat counts only frames
+  the transport accepted
 - per-download timeout; deny/disconnect/timeout all fail the download
   without tearing down the link
 """
 
 from __future__ import annotations
 
+import hashlib
 import itertools
-from typing import Callable, Dict, Optional, Set
+from typing import Callable, Dict, Optional, Tuple
 
 from ..core.clock import Clock
 from . import protocol as P
@@ -28,16 +37,24 @@ from .transport import Endpoint
 
 CHUNK_PAYLOAD_BYTES = 16 * 1024
 DEFAULT_REQUEST_TIMEOUT_MS = 8_000.0
+#: if a HELLO went unanswered this long, the next tracker round
+#: re-sends it (frame loss must not be permanent)
+HANDSHAKE_RETRY_MS = 5_000.0
+#: how long a peer that served bytes contradicting its own
+#: announcement stays banned.  Finite, so one corrupted transfer
+#: (bit-rot, not malice) doesn't permanently shrink a small swarm.
+DEFAULT_BAN_MS = 600_000.0
 
 
 class _Download:
     """One in-flight inbound transfer."""
 
     __slots__ = ("request_id", "key", "peer_id", "buf", "total", "received",
-                 "on_success", "on_error", "on_progress", "timer")
+                 "on_success", "on_error", "on_progress", "timer",
+                 "expected_size", "expected_digest")
 
     def __init__(self, request_id, key, peer_id, on_success, on_error,
-                 on_progress, timer):
+                 on_progress, timer, expected_size=None, expected_digest=None):
         self.request_id = request_id
         self.key = key
         self.peer_id = peer_id
@@ -48,6 +65,10 @@ class _Download:
         self.on_error = on_error
         self.on_progress = on_progress
         self.timer = timer
+        # what the serving peer ANNOUNCED for this key — the payload
+        # must match or the peer is dropped as misbehaving
+        self.expected_size: Optional[int] = expected_size
+        self.expected_digest: Optional[bytes] = expected_digest
 
 
 class DownloadHandle:
@@ -64,12 +85,14 @@ class DownloadHandle:
 class PeerState:
     """What we know about one neighbor."""
 
-    __slots__ = ("peer_id", "have", "hello_sent", "handshaked")
+    __slots__ = ("peer_id", "have", "hello_sent", "hello_at", "handshaked")
 
     def __init__(self, peer_id: str):
         self.peer_id = peer_id
-        self.have: Set[bytes] = set()
+        # key -> (announced size, announced sha256)
+        self.have: Dict[bytes, Tuple[int, bytes]] = {}
         self.hello_sent = False
+        self.hello_at = 0.0
         self.handshaked = False
 
 
@@ -85,7 +108,8 @@ class PeerMesh:
                  cache: SegmentCache, *,
                  request_timeout_ms: float = DEFAULT_REQUEST_TIMEOUT_MS,
                  is_upload_on: Callable[[], bool] = lambda: True,
-                 chunk_bytes: int = CHUNK_PAYLOAD_BYTES):
+                 chunk_bytes: int = CHUNK_PAYLOAD_BYTES,
+                 ban_ms: float = DEFAULT_BAN_MS):
         self.endpoint = endpoint
         self.swarm_id = swarm_id
         self.clock = clock
@@ -93,7 +117,12 @@ class PeerMesh:
         self.request_timeout_ms = request_timeout_ms
         self.is_upload_on = is_upload_on
         self.chunk_bytes = chunk_bytes
+        self.ban_ms = ban_ms
         self.peers: Dict[str, PeerState] = {}
+        # peer id -> ban expiry (ms); the tracker keeps re-listing a
+        # punished peer every round, so dropping without remembering
+        # would re-trust the poisoner seconds later
+        self._banned: Dict[str, float] = {}
         self.upload_bytes = 0
         self._downloads: Dict[int, _Download] = {}
         self._request_ids = itertools.count(1)
@@ -104,14 +133,23 @@ class PeerMesh:
 
     # -- membership ----------------------------------------------------
     def connect_to(self, peer_id: str) -> None:
-        """Initiate a handshake (idempotent)."""
-        if self.closed or peer_id == self.endpoint.peer_id:
+        """Initiate a handshake (idempotent while one is pending; an
+        unanswered HELLO is retried after :data:`HANDSHAKE_RETRY_MS`
+        so one lost frame can't leave the pair strangers forever —
+        the tracker keeps re-listing the peer either way)."""
+        if self.closed or peer_id == self.endpoint.peer_id \
+                or self._is_banned(peer_id):
             return
         state = self.peers.setdefault(peer_id, PeerState(peer_id))
-        if not state.hello_sent:
-            state.hello_sent = True
-            self._send(peer_id, P.Hello(self.swarm_id, self.endpoint.peer_id))
-            self._send(peer_id, P.Bitfield(tuple(self.cache.keys())))
+        if state.handshaked:
+            return
+        now = self.clock.now()
+        if state.hello_sent and now - state.hello_at < HANDSHAKE_RETRY_MS:
+            return
+        state.hello_sent = True
+        state.hello_at = now
+        self._send(peer_id, P.Hello(self.swarm_id, self.endpoint.peer_id))
+        self._send(peer_id, P.Bitfield(tuple(self.cache.entries())))
 
     def on_tracker_peers(self, peer_ids) -> None:
         for peer_id in peer_ids:
@@ -143,7 +181,11 @@ class PeerMesh:
         return sum(1 for p in self.peers.values() if p.handshaked)
 
     def broadcast_have(self, key: bytes) -> None:
-        self._broadcast(P.Have(bytes(key)))
+        meta = self.cache.meta(key)
+        if meta is None:
+            return  # evicted since; announcing it would be a lie
+        size, digest = meta
+        self._broadcast(P.Have(bytes(key), size, digest))
 
     def broadcast_lost(self, key: bytes) -> None:
         self._broadcast(P.Lost(bytes(key)))
@@ -170,9 +212,14 @@ class PeerMesh:
         timer = self.clock.call_later(
             timeout_ms if timeout_ms is not None else self.request_timeout_ms,
             lambda: self._fail_download(request_id, {"status": 0}))
+        # snapshot what this peer ANNOUNCED for the key; the payload is
+        # verified against it (content-poisoning defense)
+        state = self.peers.get(peer_id)
+        announced = state.have.get(bytes(key)) if state is not None else None
+        size, digest = announced if announced is not None else (None, None)
         self._downloads[request_id] = _Download(
             request_id, bytes(key), peer_id, on_success, on_error,
-            on_progress, timer)
+            on_progress, timer, expected_size=size, expected_digest=digest)
         self._send(peer_id, P.Request(request_id, bytes(key)))
         return DownloadHandle(self, request_id)
 
@@ -193,17 +240,22 @@ class PeerMesh:
     # -- frame handling ------------------------------------------------
     def handle_frame(self, src_id: str, msg) -> None:
         """Dispatch one decoded peer message."""
-        if self.closed:
+        if self.closed or self._is_banned(src_id):
             return
         if isinstance(msg, P.Hello):
             if msg.swarm_id != self.swarm_id:
                 return  # different content; not our neighbor
             state = self.peers.setdefault(src_id, PeerState(src_id))
+            # a HELLO from a peer we ALREADY handshaked is a retry:
+            # our earlier reply was lost, so reply again — otherwise
+            # one lost reply leaves the pair strangers forever
+            retried = state.handshaked
             state.handshaked = True
-            if not state.hello_sent:
+            if not state.hello_sent or retried:
                 state.hello_sent = True
+                state.hello_at = self.clock.now()
                 self._send(src_id, P.Hello(self.swarm_id, self.endpoint.peer_id))
-                self._send(src_id, P.Bitfield(tuple(self.cache.keys())))
+                self._send(src_id, P.Bitfield(tuple(self.cache.entries())))
             return
 
         state = self.peers.get(src_id)
@@ -211,15 +263,16 @@ class PeerMesh:
             return  # never handshaked with this peer; ignore
 
         if isinstance(msg, P.Bitfield):
-            state.have = set(msg.keys)
+            state.have = {key: (size, digest)
+                          for key, size, digest in msg.entries}
             if state.have and self.on_remote_have is not None:
                 self.on_remote_have(src_id)
         elif isinstance(msg, P.Have):
-            state.have.add(msg.key)
+            state.have[msg.key] = (msg.size, msg.digest)
             if self.on_remote_have is not None:
                 self.on_remote_have(src_id)
         elif isinstance(msg, P.Lost):
-            state.have.discard(msg.key)
+            state.have.pop(msg.key, None)
         elif isinstance(msg, P.Request):
             self._serve(src_id, msg)
         elif isinstance(msg, P.Cancel):
@@ -246,8 +299,14 @@ class PeerMesh:
             self._send(src_id, P.Chunk(msg.request_id, 0, 0, b""))
         for offset in range(0, total, self.chunk_bytes):
             piece = payload[offset:offset + self.chunk_bytes]
-            self._send(src_id, P.Chunk(msg.request_id, offset, total, piece))
-        self.upload_bytes += total
+            if not self._send(src_id,
+                              P.Chunk(msg.request_id, offset, total, piece)):
+                # refused frame = a gap the downloader's sequential
+                # check will fail on anyway — stop wasting the uplink
+                break
+            # count only what the transport accepted — `upload` is a
+            # conservation metric, not an intent metric
+            self.upload_bytes += len(piece)
 
     def _on_chunk(self, src_id: str, msg: P.Chunk) -> None:
         download = self._downloads.get(msg.request_id)
@@ -260,9 +319,21 @@ class PeerMesh:
             if msg.total > self.cache.max_bytes:
                 self._fail_download(msg.request_id, {"status": 0})
                 return
+            # the peer announced a size at request time; a different
+            # total is already a lie — don't even allocate
+            if (download.expected_size is not None
+                    and msg.total != download.expected_size):
+                self._punish(src_id, msg.request_id)
+                return
             download.total = msg.total
             download.buf = bytearray(msg.total)
-        if msg.offset + len(msg.payload) > download.total:
+        # strictly sequential reassembly: both fabrics are FIFO per
+        # link, so honest serves arrive in offset order.  Gaps,
+        # overlaps, and duplicates all fail here — a "complete"
+        # download can never contain zero-filled holes or
+        # double-counted bytes
+        if msg.offset != download.received or \
+                msg.offset + len(msg.payload) > download.total:
             self._fail_download(msg.request_id, {"status": 0})
             return
         download.buf[msg.offset:msg.offset + len(msg.payload)] = msg.payload
@@ -270,9 +341,37 @@ class PeerMesh:
         if download.on_progress is not None:
             download.on_progress(download.received)
         if download.received >= download.total:
+            payload = bytes(download.buf)
+            if (download.expected_digest is not None
+                    and hashlib.sha256(payload).digest()
+                    != download.expected_digest):
+                # served bytes don't match what the peer announced:
+                # poisoned or corrupt — drop the peer entirely
+                self._punish(src_id, msg.request_id)
+                return
             del self._downloads[msg.request_id]
             download.timer.cancel()
-            download.on_success(bytes(download.buf))
+            download.on_success(payload)
+
+    def _punish(self, src_id: str, request_id: int) -> None:
+        """A peer served something it never announced (size or digest
+        mismatch): fail the download and cut the peer loose — its
+        other announcements can't be trusted either.  The ban is
+        remembered (``ban_ms``): the tracker re-lists the peer every
+        round, and re-handshaking seconds later would re-trust the
+        poisoner at the cost of one wasted download per round."""
+        self._fail_download(request_id, {"status": 0})
+        self._banned[src_id] = self.clock.now() + self.ban_ms
+        self.drop_peer(src_id)
+
+    def _is_banned(self, peer_id: str) -> bool:
+        expiry = self._banned.get(peer_id)
+        if expiry is None:
+            return False
+        if self.clock.now() >= expiry:
+            del self._banned[peer_id]
+            return False
+        return True
 
     def _on_deny(self, src_id: str, msg: P.Deny) -> None:
         download = self._downloads.get(msg.request_id)
@@ -281,7 +380,7 @@ class PeerMesh:
         # a denying peer can't serve this key now — stop asking it
         state = self.peers.get(src_id)
         if state is not None:
-            state.have.discard(download.key)
+            state.have.pop(download.key, None)
         status = 403 if msg.reason == P.DenyReason.UPLOAD_OFF else 404
         self._fail_download(msg.request_id, {"status": status})
 
@@ -295,5 +394,5 @@ class PeerMesh:
             self._fail_download(request_id, {"status": 0})
         self.peers.clear()
 
-    def _send(self, peer_id: str, msg) -> None:
-        self.endpoint.send(peer_id, P.encode(msg))
+    def _send(self, peer_id: str, msg) -> bool:
+        return self.endpoint.send(peer_id, P.encode(msg))

@@ -19,6 +19,27 @@ Design points:
   ICE credentials).  Outbound connections send a one-shot peer-id
   preamble so the receiver can tag inbound frames with their source.
 - Connections are created on first send and reused both ways.
+
+Trust model (explicit, because the reference's closed agent was the
+trust boundary and WebRTC gave it DTLS for free):
+
+- **Outbound links are address-verified**: we dialed ``host:port``,
+  so frames read back on that socket genuinely come from whoever
+  owns that listener.
+- **Inbound identity is self-declared** in the preamble.  Two
+  defenses bound the lie: the claimed host must resolve to the
+  socket's observed remote address (``getpeername``; disable via
+  ``verify_inbound_host=False`` for NAT/multi-homed fabrics) — a
+  peer can only impersonate listeners on its OWN address — and ids in
+  ``reject_inbound_ids`` (the agent registers its tracker id there)
+  may never be claimed inbound at all, since tracker-tagged frames
+  steer mesh membership.  The tracker never usefully dials peers
+  (PEERS replies reuse the announce connection), so rejecting
+  inbound claims of its id costs nothing.
+- Same-host peers (one machine, many ports) can still claim each
+  other's ids; full mutual authentication needs a cryptographic
+  handshake (TLS / Noise) — out of scope for this fabric, use a
+  fronting proxy or kernel-level isolation in hostile deployments.
 """
 
 from __future__ import annotations
@@ -251,6 +272,9 @@ class TcpEndpoint:
         self.closed = False
         self.bytes_sent = 0
         self.bytes_received = 0
+        #: ids an inbound preamble may never claim (module docstring:
+        #: trust model).  The agent adds its tracker id here.
+        self.reject_inbound_ids: set = set()
         self._conns: Dict[str, _Connection] = {}
         self._extra_conns: list = []  # crossed-dial inbound links
         self._conn_lock = threading.Lock()
@@ -316,6 +340,24 @@ class TcpEndpoint:
         except UnicodeDecodeError:
             sock.close()
             return
+        # identity binding (module docstring: trust model): the
+        # claimed listener must live on the address this socket
+        # actually comes from, and protected ids (the tracker's) may
+        # not be claimed inbound at all
+        claimed_host = remote_id.rsplit(":", 1)[0]
+        try:
+            observed_host = sock.getpeername()[0]
+        except OSError:
+            sock.close()
+            return
+        if remote_id in self.reject_inbound_ids or (
+                self.network.verify_inbound_host
+                and not self.network._host_matches(claimed_host,
+                                                   observed_host)):
+            log.warning("rejecting inbound connection claiming %r from %s",
+                        remote_id, observed_host)
+            sock.close()
+            return
         conn = _Connection(self, remote_id, sock)
         with self._conn_lock:
             # reuse: an inbound link doubles as our outbound to them;
@@ -370,12 +412,40 @@ class TcpNetwork:
     identity; callers must adopt ``endpoint.peer_id``."""
 
     def __init__(self, host: str = "127.0.0.1",
-                 loop: Optional[NetLoop] = None):
+                 loop: Optional[NetLoop] = None,
+                 verify_inbound_host: bool = True):
         self.host = host
         self._owns_loop = loop is None
         self.loop = loop or NetLoop()
+        #: reject inbound preambles whose claimed host doesn't resolve
+        #: to the socket's observed remote address (module docstring:
+        #: trust model).  Disable for NAT/multi-homed deployments where
+        #: a peer's outbound source address legitimately differs from
+        #: its listener address.
+        self.verify_inbound_host = verify_inbound_host
+        self._resolve_cache: Dict[str, frozenset] = {}
+        self._resolve_lock = threading.Lock()
         self._endpoints: list = []
         self._endpoints_lock = threading.Lock()
+
+    def _host_matches(self, claimed_host: str, observed_host: str) -> bool:
+        """Does the claimed listener host resolve to the observed
+        remote address?  Runs on a per-handshake thread, so the
+        (cached) blocking DNS lookup never stalls the dispatch loop.
+        Unresolvable claims are rejected."""
+        if claimed_host == observed_host:
+            return True
+        with self._resolve_lock:
+            addrs = self._resolve_cache.get(claimed_host)
+        if addrs is None:
+            try:
+                infos = socket.getaddrinfo(claimed_host, None)
+                addrs = frozenset(info[4][0] for info in infos)
+            except OSError:
+                addrs = frozenset()
+            with self._resolve_lock:
+                self._resolve_cache[claimed_host] = addrs
+        return observed_host in addrs
 
     def register(self, peer_id: Optional[str] = None,
                  uplink_bps: Optional[float] = None) -> TcpEndpoint:

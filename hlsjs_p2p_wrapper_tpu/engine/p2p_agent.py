@@ -111,7 +111,14 @@ class P2PAgent:
         self.integration_version = integration_version
 
         cfg = self.p2p_config
-        self.clock: Clock = cfg.get("clock") or SystemClock()
+        # single-threaded by construction: if the network brings its
+        # own dispatch loop (TcpNetwork's NetLoop implements Clock),
+        # timers default onto THAT thread — a SystemClock default here
+        # would fire timeouts on threading.Timer threads racing the
+        # NetLoop's frame handling over unlocked engine state
+        self.clock: Clock = (cfg.get("clock")
+                             or getattr(cfg.get("network"), "loop", None)
+                             or SystemClock())
         self.cdn_transport: CdnTransport = (cfg.get("cdn_transport")
                                             or HttpCdnTransport())
         self.policy = SchedulingPolicy.from_config(cfg)
@@ -162,6 +169,13 @@ class P2PAgent:
                 announce_interval_ms=cfg.get("announce_interval_ms",
                                              DEFAULT_ANNOUNCE_INTERVAL_MS),
                 on_peers=lambda peers: self.mesh.on_tracker_peers(peers))
+            # frames claiming to be FROM the tracker are trusted
+            # (TrackerClient matches on src id); on a fabric where
+            # inbound identity is self-declared, forbid peers from
+            # claiming it (engine/net.py trust model)
+            reject = getattr(self.endpoint, "reject_inbound_ids", None)
+            if reject is not None:
+                reject.add(self.tracker_client.tracker_peer_id)
             self.endpoint.on_receive = self._on_frame
             self.tracker_client.start()
             self._arm_prefetch_timer()

@@ -39,7 +39,7 @@ class MsgType:
     """Message type codes.  0x0x = peer ⇄ peer, 0x1x = peer ⇄ tracker."""
 
     HELLO = 0x01      # handshake: swarm id + peer id
-    HAVE = 0x02       # "I now cache this segment"
+    HAVE = 0x02       # "I now cache this segment" (+ size + sha256)
     BITFIELD = 0x03   # full have-map (sent after HELLO)
     REQUEST = 0x04    # ask for a segment
     CANCEL = 0x05     # withdraw a request
@@ -64,14 +64,25 @@ class Hello:
     peer_id: str
 
 
+#: bytes of SHA-256 carried per announced segment.  Announcements bind
+#: a peer to the exact payload it will serve: the downloader records
+#: (size, digest) at request time and verifies the reassembled bytes,
+#: so a peer cannot serve arbitrary content for a requested key
+#: (content-poisoning defense — the closed reference agent was the
+#: trust boundary; this rebuild carries its own).
+DIGEST_SIZE = 32
+
+
 @dataclass(frozen=True)
 class Have:
-    key: bytes  # 12-byte SegmentView buffer
+    key: bytes     # 12-byte SegmentView buffer
+    size: int      # payload length in bytes
+    digest: bytes  # sha256(payload)
 
 
 @dataclass(frozen=True)
 class Bitfield:
-    keys: Tuple[bytes, ...]
+    entries: Tuple[Tuple[bytes, int, bytes], ...]  # (key, size, digest)
 
 
 @dataclass(frozen=True)
@@ -152,6 +163,27 @@ def _check_key(key: bytes) -> bytes:
     return bytes(key)
 
 
+def _check_digest(digest: bytes) -> bytes:
+    if len(digest) != DIGEST_SIZE:
+        raise ProtocolError(f"digest must be {DIGEST_SIZE} bytes")
+    return bytes(digest)
+
+
+_ENTRY_SIZE = WIRE_SIZE + 4 + DIGEST_SIZE  # key + u32 size + digest
+
+
+def _pack_entry(key: bytes, size: int, digest: bytes) -> bytes:
+    return (_check_key(key) + struct.pack("<I", size)
+            + _check_digest(digest))
+
+
+def _unpack_entry(body: memoryview, off: int) -> Tuple[bytes, int, bytes]:
+    key = bytes(body[off:off + WIRE_SIZE])
+    (size,) = struct.unpack_from("<I", body, off + WIRE_SIZE)
+    digest = bytes(body[off + WIRE_SIZE + 4:off + _ENTRY_SIZE])
+    return _check_key(key), size, _check_digest(digest)
+
+
 def encode(msg) -> bytes:
     """Serialize a message dataclass to one wire frame."""
     t = type(msg)
@@ -159,10 +191,11 @@ def encode(msg) -> bytes:
         return _frame(MsgType.HELLO,
                       _pack_str(msg.swarm_id) + _pack_str(msg.peer_id))
     if t is Have:
-        return _frame(MsgType.HAVE, _check_key(msg.key))
+        return _frame(MsgType.HAVE,
+                      _pack_entry(msg.key, msg.size, msg.digest))
     if t is Bitfield:
-        body = struct.pack("<I", len(msg.keys)) + b"".join(
-            _check_key(k) for k in msg.keys)
+        body = struct.pack("<I", len(msg.entries)) + b"".join(
+            _pack_entry(*entry) for entry in msg.entries)
         return _frame(MsgType.BITFIELD, body)
     if t is Request:
         return _frame(MsgType.REQUEST,
@@ -222,16 +255,18 @@ def _decode_body(msg_type: int, body: memoryview):
         peer_id, _ = _unpack_str(body, off)
         return Hello(swarm_id, peer_id)
     if msg_type == MsgType.HAVE:
-        return Have(_check_key(bytes(body)))
+        if len(body) != _ENTRY_SIZE:
+            raise ProtocolError("have body size mismatch")
+        return Have(*_unpack_entry(body, 0))
     if msg_type == MsgType.BITFIELD:
         (count,) = struct.unpack_from("<I", body, 0)
         # validate the declared count against the actual body BEFORE
         # allocating: a forged count must not drive allocation size
-        if 4 + count * WIRE_SIZE != len(body):
+        if 4 + count * _ENTRY_SIZE != len(body):
             raise ProtocolError("bitfield count/body size mismatch")
-        keys = tuple(bytes(body[4 + i * WIRE_SIZE:4 + (i + 1) * WIRE_SIZE])
-                     for i in range(count))
-        return Bitfield(keys)
+        entries = tuple(_unpack_entry(body, 4 + i * _ENTRY_SIZE)
+                        for i in range(count))
+        return Bitfield(entries)
     if msg_type == MsgType.REQUEST:
         (request_id,) = struct.unpack_from("<I", body, 0)
         return Request(request_id, _check_key(bytes(body[4:])))

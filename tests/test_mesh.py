@@ -1,6 +1,8 @@
 """Peer mesh: handshake, availability, chunked transfer, uploads,
 denies, timeouts — two meshes on one deterministic network."""
 
+import hashlib
+
 import pytest
 
 from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
@@ -107,6 +109,7 @@ def test_remote_have_hook_fires(duo):
     cache_b.put(key(1), b"x")
     mesh_a.connect_to("b")
     clock.advance(50.0)        # bitfield
+    cache_b.put(key(2), b"y")  # broadcast_have announces only cached keys
     mesh_b.broadcast_have(key(2))
     clock.advance(50.0)        # incremental have
     assert seen == ["b", "b"]
@@ -204,7 +207,8 @@ def test_load_balancing_prefers_less_loaded_holder(duo):
 def test_frames_from_strangers_ignored(duo):
     clock, net, (mesh_a, _), _ = duo
     stranger = net.register("stranger")
-    stranger.send("a", P.encode(P.Have(key(1))))
+    stranger.send("a", P.encode(
+        P.Have(key(1), 1, hashlib.sha256(b"x").digest())))
     stranger.send("a", P.encode(P.Request(1, key(1))))
     clock.advance(50.0)
     assert mesh_a.holders_of(key(1)) == []
@@ -229,6 +233,146 @@ def test_empty_payload_transfer(duo):
                    on_error=lambda e: pytest.fail(f"{e}"))
     clock.advance(50.0)
     assert got == [b""]
+
+
+def test_poisoned_payload_rejected_and_peer_dropped(duo):
+    """A peer announcing digest(X) but serving Y must not complete the
+    download, and its other announcements become untrusted (the
+    content-poisoning defense — a poisoned payload must never reach
+    _store/broadcast_have and propagate swarm-wide)."""
+    clock, net, (mesh_a, _), (mesh_b, cache_b) = duo
+    real = b"genuine segment bytes"
+    cache_b.put(key(1), real)
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    # b silently swaps the cached bytes AFTER announcing: digest in
+    # a's have-map no longer matches what b will serve
+    cache_b._entries[key(1)] = (b"poisoned!!! bytes mismatch",
+                                cache_b._entries[key(1)][1])
+    errors = []
+    mesh_a.request("b", key(1), on_success=lambda d: pytest.fail("poisoned"),
+                   on_error=errors.append)
+    clock.advance(200.0)
+    assert errors == [{"status": 0}]
+    assert mesh_a.connected_count == 0  # peer dropped entirely
+    assert mesh_a.holders_of(key(1)) == []
+
+
+def test_forged_total_mismatching_announced_size_rejected(duo):
+    clock, net, (mesh_a, _), (mesh_b, cache_b) = duo
+    cache_b.put(key(1), b"four")
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    errors = []
+    handle = mesh_a.request("b", key(1),
+                            on_success=lambda d: pytest.fail("served"),
+                            on_error=errors.append)
+    # forge a chunk whose total contradicts the announced size (4)
+    evil = P.encode(P.Chunk(handle._request_id, 0, 999, b"x"))
+    mesh_b.endpoint.send("a", evil)
+    clock.advance(6.0)  # evil frame (t=5) lands before b's serve (t=10)
+    assert errors == [{"status": 0}]
+    assert mesh_a.connected_count == 0
+
+
+def test_duplicate_chunk_rejected_not_double_counted(duo):
+    """Out-of-order/duplicate chunks fail the download instead of
+    completing it with holes: received-byte counting alone would let
+    two copies of chunk 0 satisfy a 2-chunk transfer."""
+    clock, net, (mesh_a, _), (mesh_b, cache_b) = duo
+    payload = b"z" * 20_000  # 2 chunks
+    cache_b.put(key(1), payload)
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    errors, got = [], []
+    handle = mesh_a.request("b", key(1), on_success=got.append,
+                            on_error=errors.append)
+    dup = P.encode(P.Chunk(handle._request_id, 0, len(payload),
+                           payload[:16 * 1024]))
+    mesh_b.endpoint.send("a", dup)
+    mesh_b.endpoint.send("a", dup)  # duplicate of chunk 0
+    clock.advance(200.0)
+    assert got == []
+    assert errors == [{"status": 0}]
+
+
+def test_handshake_recovers_when_hello_reply_lost(duo):
+    """Asymmetric loss: A's HELLO arrives but B's reply is lost.  A's
+    retried HELLO must make B reply AGAIN (a duplicate HELLO from an
+    already-handshaked peer means our reply never landed)."""
+    clock, net, (mesh_a, _), (mesh_b, _) = duo
+    net.set_link("b", "a", loss_rate=1.0)   # b→a direction drops all
+    net._links[("a", "b")]["loss_rate"] = 0.0  # a→b stays clean
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    assert mesh_b.connected_count == 1      # b saw a's HELLO
+    assert mesh_a.connected_count == 0      # but b's reply vanished
+    net.set_link("b", "a", loss_rate=0.0)   # link heals
+    clock.advance(6_000.0)                  # retry grace elapses
+    mesh_a.connect_to("b")                  # next tracker round
+    clock.advance(50.0)
+    assert mesh_a.connected_count == 1
+
+
+def test_punished_peer_stays_banned_across_tracker_rounds(duo):
+    clock, net, (mesh_a, _), (mesh_b, cache_b) = duo
+    real = b"genuine"
+    cache_b.put(key(1), real)
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    cache_b._entries[key(1)] = (b"poison!", cache_b._entries[key(1)][1])
+    errors = []
+    mesh_a.request("b", key(1), on_success=lambda d: pytest.fail("poisoned"),
+                   on_error=errors.append)
+    clock.advance(200.0)
+    assert errors == [{"status": 0}]
+    # the tracker re-lists b on its next round — a must NOT re-trust it
+    mesh_a.connect_to("b")
+    clock.advance(6_000.0)
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    assert mesh_a.connected_count == 0
+    # ...until the ban expires (finite: corruption isn't always malice)
+    clock.advance(700_000.0)
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    assert mesh_a.connected_count == 1
+
+
+def test_handshake_retries_after_lost_hello(duo):
+    clock, net, (mesh_a, _), (mesh_b, _) = duo
+    net.partition("a", "b")           # first HELLO vanishes
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    assert mesh_a.connected_count == 0
+    net.partition("a", "b", blocked=False)
+    mesh_a.connect_to("b")            # within grace: no resend yet
+    clock.advance(50.0)
+    assert mesh_a.connected_count == 0
+    clock.advance(6_000.0)            # grace (5 s) elapses
+    mesh_a.connect_to("b")            # tracker round re-offers the peer
+    clock.advance(50.0)
+    assert mesh_a.connected_count == 1
+    assert mesh_b.connected_count == 1
+
+
+def test_upload_bytes_counts_only_accepted_sends(duo):
+    clock, net, (mesh_a, _), (mesh_b, cache_b) = duo
+    payload = b"u" * 40_000  # 3 chunks
+    cache_b.put(key(1), payload)
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    # b's transport refuses every CHUNK frame (full queue / dead link):
+    # the `upload` stat must not count bytes that never left
+    orig_send = mesh_b.endpoint.send
+    mesh_b.endpoint.send = lambda dest, frame: (
+        False if frame[3] == P.MsgType.CHUNK else orig_send(dest, frame))
+    errors = []
+    mesh_a.request("b", key(1), on_success=lambda d: pytest.fail("served"),
+                   on_error=errors.append, timeout_ms=1_000.0)
+    clock.advance(2_000.0)
+    assert errors == [{"status": 0}]
+    assert mesh_b.upload_bytes == 0  # nothing actually left b
 
 
 def test_forged_chunk_total_bounded_by_cache_budget(duo):

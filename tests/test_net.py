@@ -119,9 +119,94 @@ def test_deliveries_serialized_on_loop_thread(net):
     assert len(threads) == 1  # single dispatcher thread
 
 
+def _dial_with_preamble(peer_id: str, claimed_id: bytes):
+    import socket
+    import struct
+    host, port = peer_id.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=2.0)
+    sock.sendall(struct.pack("<I", len(claimed_id)) + claimed_id)
+    return sock
+
+
+def test_inbound_preamble_host_mismatch_rejected(net):
+    """An inbound connection may only claim listener ids on its own
+    observed address (engine/net.py trust model)."""
+    b = net.register()
+    got = []
+    b.on_receive = lambda src, f: got.append((src, f))
+    sock = _dial_with_preamble(b.peer_id, b"10.9.9.9:1234")
+    try:
+        import struct
+        sock.sendall(struct.pack("<I", 4) + b"evil")
+    except OSError:
+        pass  # server already closed on us — that IS the rejection
+    time.sleep(0.3)
+    assert got == []
+    assert "10.9.9.9:1234" not in b._conns
+    sock.close()
+
+
+def test_hostname_bound_network_accepts_resolved_inbound():
+    """A network bound to a hostname (peer ids claim "localhost:...")
+    must still accept inbound links whose observed address is what the
+    hostname resolves to — string equality alone would reject every
+    connection on such a fabric."""
+    network = TcpNetwork(host="localhost")
+    try:
+        a, b = network.register(), network.register()
+        got = []
+        done = threading.Event()
+        b.on_receive = lambda src, f: (got.append((src, f)), done.set())
+        assert a.send(b.peer_id, b"via-hostname")
+        assert wait_for(done.is_set)
+        assert got == [(a.peer_id, b"via-hostname")]
+    finally:
+        network.close()
+
+
+def test_inbound_claim_of_protected_id_rejected(net):
+    """Frames tagged with the tracker's id steer mesh membership, so
+    no inbound connection may self-declare it — even from the same
+    host (the forged-PEERS injection from the round-1 advisory)."""
+    b = net.register()
+    protected = "127.0.0.1:59999"
+    b.reject_inbound_ids.add(protected)
+    got = []
+    b.on_receive = lambda src, f: got.append((src, f))
+    sock = _dial_with_preamble(b.peer_id, protected.encode())
+    try:
+        import struct
+        sock.sendall(struct.pack("<I", 6) + b"forged")
+    except OSError:
+        pass
+    time.sleep(0.3)
+    assert got == []
+    assert protected not in b._conns
+    sock.close()
+
+
 def sv(sn):
     return SegmentView(sn=sn, track_view=TrackView(level=0, url_id=0),
                        time=sn * 10.0)
+
+
+def test_agent_defaults_clock_to_netloop_and_protects_tracker_id(net):
+    """With a TcpNetwork and no explicit clock, the agent must adopt
+    the network's dispatch loop as its clock (timers and frames on one
+    thread) and forbid inbound claims of the tracker id."""
+    tracker_endpoint = net.register()
+    TrackerEndpoint(Tracker(net.loop), tracker_endpoint)
+    agent = P2PAgent(
+        NullBridge(), "http://cdn.example/master.m3u8", NullMediaMap(),
+        {"network": net, "cdn_transport": InstantCdn(10),
+         "tracker_peer_id": tracker_endpoint.peer_id,
+         "content_id": "clock-default-demo"},
+        SegmentView, "hls", "v2")
+    try:
+        assert agent.clock is net.loop
+        assert tracker_endpoint.peer_id in agent.endpoint.reject_inbound_ids
+    finally:
+        agent.dispose()
 
 
 def test_agent_swarm_over_real_sockets(net):

@@ -1,5 +1,7 @@
 """Wire protocol round-trips and framing errors."""
 
+import hashlib
+
 import pytest
 
 from hlsjs_p2p_wrapper_tpu.core.segment_view import SegmentView
@@ -11,10 +13,16 @@ def key(level=1, url_id=0, sn=42):
     return SegmentView(sn=sn, track_view=TrackView(level=level, url_id=url_id)).to_bytes()
 
 
+def digest(payload=b"x"):
+    return hashlib.sha256(payload).digest()
+
+
 ROUND_TRIPS = [
     P.Hello("swarm-abc", "peer-1"),
-    P.Have(key()),
-    P.Bitfield((key(1, 0, 1), key(1, 0, 2), key(2, 1, 7))),
+    P.Have(key(), 3, digest(b"abc")),
+    P.Bitfield(((key(1, 0, 1), 10, digest(b"a")),
+                (key(1, 0, 2), 20, digest(b"b")),
+                (key(2, 1, 7), 0, digest(b"")))),
     P.Bitfield(()),
     P.Request(77, key()),
     P.Cancel(77),
@@ -74,7 +82,12 @@ def test_truncated_frame_rejected():
 
 def test_wrong_key_size_rejected():
     with pytest.raises(P.ProtocolError):
-        P.encode(P.Have(b"short"))
+        P.encode(P.Have(b"short", 1, digest()))
+
+
+def test_wrong_digest_size_rejected():
+    with pytest.raises(P.ProtocolError):
+        P.encode(P.Have(key(), 1, b"not-32-bytes"))
 
 
 def test_chunk_payload_binary_safe():
