@@ -248,12 +248,18 @@ class PeerMesh:
             state = self.peers.setdefault(src_id, PeerState(src_id))
             # a HELLO from a peer we ALREADY handshaked is a retry:
             # our earlier reply was lost, so reply again — otherwise
-            # one lost reply leaves the pair strangers forever
-            retried = state.handshaked
+            # one lost reply leaves the pair strangers forever.  The
+            # re-reply is rate-limited by the same grace as the
+            # initiator's retries: without it, two crossed late
+            # replies ignite an infinite HELLO+BITFIELD ping-pong
+            # between two healthy, already-handshaked peers.
+            now = self.clock.now()
+            retried = (state.handshaked
+                       and now - state.hello_at >= HANDSHAKE_RETRY_MS)
             state.handshaked = True
             if not state.hello_sent or retried:
                 state.hello_sent = True
-                state.hello_at = self.clock.now()
+                state.hello_at = now
                 self._send(src_id, P.Hello(self.swarm_id, self.endpoint.peer_id))
                 self._send(src_id, P.Bitfield(tuple(self.cache.entries())))
             return

@@ -442,7 +442,10 @@ class TcpNetwork:
                 infos = socket.getaddrinfo(claimed_host, None)
                 addrs = frozenset(info[4][0] for info in infos)
             except OSError:
-                addrs = frozenset()
+                # do NOT cache failures: one transient resolver hiccup
+                # must not permanently reject every inbound connection
+                # claiming this host for the process lifetime
+                return False
             with self._resolve_lock:
                 self._resolve_cache[claimed_host] = addrs
         return observed_host in addrs

@@ -242,9 +242,8 @@ class P2PAgent:
                           download_on=self.p2p_download_on)
 
         if decision.use_p2p:
-            self._start_p2p_leg(request, key, holders[0], req_info,
-                                callbacks, decision.p2p_budget_ms,
-                                segment_view)
+            self._start_p2p_leg(request, key, req_info, callbacks,
+                                decision.p2p_budget_ms, segment_view)
         else:
             wait_ms = self._edge_wait_ms(holders, margin_s)
             if wait_ms > 0:
@@ -283,20 +282,28 @@ class P2PAgent:
                                   holder_count=len(holders),
                                   download_on=True)
                 if decision.use_p2p:
-                    self._start_p2p_leg(request, key, holders[0], req_info,
-                                        callbacks, decision.p2p_budget_ms,
-                                        segment_view)
+                    self._start_p2p_leg(request, key, req_info, callbacks,
+                                        decision.p2p_budget_ms, segment_view)
                     return
             self._start_cdn_leg(request, key, req_info, callbacks)
 
         request.failover_timer = self.clock.call_later(wait_ms, re_evaluate)
 
     def _start_p2p_leg(self, request: _GetSegmentRequest, key: bytes,
-                       peer_id: str, req_info: Dict, callbacks: Dict,
+                       req_info: Dict, callbacks: Dict,
                        budget_ms: float, segment_view) -> None:
+        """Walk the holders within ONE time budget: best holder first,
+        then — on deny/timeout — the next untried (least-loaded)
+        holder with the remaining budget split across the attempts
+        left, up to ``policy.max_p2p_attempts``.  CDN only when
+        holders or budget are exhausted — a dead best-holder must not
+        spend the whole budget when another peer has the bytes."""
         t_start = self.clock.now()
+        deadline = t_start + budget_ms
+        max_attempts = max(1, self.policy.max_p2p_attempts)
+        tried: set = set()
 
-        def fail_over(_err=None) -> None:
+        def to_cdn(_err=None) -> None:
             # dispose() closes the mesh, which fails in-flight P2P
             # downloads through this path — it must not resurrect the
             # request as a CDN fetch into a torn-down player
@@ -329,13 +336,32 @@ class P2PAgent:
             self._store(key, payload, duration)
             callbacks["on_success"](payload)
 
-        request.p2p_handle = self.mesh.request(
-            peer_id, key, on_success=on_success, on_error=fail_over,
-            on_progress=on_progress, timeout_ms=budget_ms)
-        # belt over suspenders: the mesh timeout already enforces the
-        # budget; this timer survives even if the mesh entry leaks
+        def try_next(_err=None) -> None:
+            if request.aborted or request.done or self.disposed:
+                return
+            request.p2p_handle = None
+            remaining_ms = deadline - self.clock.now()
+            attempts_left = max_attempts - len(tried)
+            # re-query live: HAVEs that arrived mid-leg are candidates
+            # too; a denying holder already pruned itself from have
+            candidates = [p for p in self.mesh.holders_of(key)
+                          if p not in tried]
+            if not candidates or attempts_left <= 0 or remaining_ms <= 0:
+                to_cdn()
+                return
+            peer_id = candidates[0]
+            tried.add(peer_id)
+            per_try_ms = remaining_ms / min(attempts_left, len(candidates))
+            request.p2p_handle = self.mesh.request(
+                peer_id, key, on_success=on_success, on_error=try_next,
+                on_progress=on_progress, timeout_ms=per_try_ms)
+
+        # belt over suspenders: per-attempt mesh timeouts already keep
+        # inside the budget; this timer survives even if a mesh entry
+        # leaks, enforcing the whole-leg deadline
         request.failover_timer = self.clock.call_later(budget_ms + 50.0,
-                                                       fail_over)
+                                                       to_cdn)
+        try_next()
 
     def _start_cdn_leg(self, request: _GetSegmentRequest, key: bytes,
                        req_info: Dict, callbacks: Dict) -> None:

@@ -7,10 +7,14 @@ from the CDN?  The policy here is explicit and unit-testable:
 - A request with little playback margin (the fragment starts soon
   relative to the playhead) must not gamble on peers — straight to
   CDN.  P2P still contributes via cache hits.
-- With margin, try the best peer first under a strict time budget (a
-  fraction of the margin, capped), then fail over to CDN.  The budget
-  guarantees worst-case added latency is bounded and proportional to
-  how much slack playback actually has.
+- With margin, try peers under ONE strict time budget (a fraction of
+  the margin, capped): the best holder first, then — on deny/timeout,
+  while budget remains — the next-least-loaded holders, up to
+  ``max_p2p_attempts``.  CDN only when holders or budget are
+  exhausted, so one dead best-holder doesn't waste the whole budget
+  when another peer has the bytes.  The budget guarantees worst-case
+  added latency is bounded and proportional to how much slack
+  playback actually has.
 - No holders → CDN immediately.
 
 All decisions are pure functions of (margin, holders, toggles) so the
@@ -26,6 +30,7 @@ DEFAULT_URGENT_MARGIN_S = 4.0
 DEFAULT_P2P_BUDGET_FRACTION = 0.5
 DEFAULT_P2P_BUDGET_CAP_MS = 6_000.0
 DEFAULT_P2P_BUDGET_FLOOR_MS = 500.0
+DEFAULT_MAX_P2P_ATTEMPTS = 3
 
 
 @dataclass(frozen=True)
@@ -36,6 +41,9 @@ class SchedulingPolicy:
     p2p_budget_fraction: float = DEFAULT_P2P_BUDGET_FRACTION
     p2p_budget_cap_ms: float = DEFAULT_P2P_BUDGET_CAP_MS
     p2p_budget_floor_ms: float = DEFAULT_P2P_BUDGET_FLOOR_MS
+    #: how many distinct holders one foreground request may try
+    #: within its budget before conceding to the CDN
+    max_p2p_attempts: int = DEFAULT_MAX_P2P_ATTEMPTS
 
     @classmethod
     def from_config(cls, p2p_config: dict) -> "SchedulingPolicy":
@@ -47,7 +55,9 @@ class SchedulingPolicy:
             p2p_budget_cap_ms=cfg.get("p2p_budget_cap_ms",
                                       DEFAULT_P2P_BUDGET_CAP_MS),
             p2p_budget_floor_ms=cfg.get("p2p_budget_floor_ms",
-                                        DEFAULT_P2P_BUDGET_FLOOR_MS))
+                                        DEFAULT_P2P_BUDGET_FLOOR_MS),
+            max_p2p_attempts=cfg.get("max_p2p_attempts",
+                                     DEFAULT_MAX_P2P_ATTEMPTS))
 
 
 @dataclass(frozen=True)

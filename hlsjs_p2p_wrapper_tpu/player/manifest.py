@@ -24,6 +24,16 @@ class Frag:
     level: int = 0
     byte_range_start_offset: Optional[int] = None
     byte_range_end_offset: Optional[int] = None
+    #: per-redundant-stream URLs, indexed by ``url_id`` (hls.js
+    #: redundant/backup streams — media-map.js:60-73).  ``None`` means
+    #: the level has a single stream and ``url`` is it.
+    urls: Optional[List[str]] = None
+
+    def url_for(self, url_id: int) -> str:
+        """This fragment's URL on the given redundant stream."""
+        if self.urls and 0 <= url_id < len(self.urls):
+            return self.urls[url_id]
+        return self.url
 
 
 @dataclass
@@ -59,10 +69,16 @@ def make_vod_manifest(level_bitrates=(300_000, 800_000, 2_000_000),
         urls = [f"{base_url}/{li}/0/playlist.m3u8"]
         if redundant:
             urls.append(f"{base_url}/{li}/1/playlist.m3u8")
-        frags = [Frag(sn=first_sn + i, start=(first_sn + i) * seg_duration,
-                      duration=seg_duration,
-                      url=f"{base_url}/{li}/seg{first_sn + i}.ts", level=li)
-                 for i in range(frag_count)]
+        frags = []
+        for i in range(frag_count):
+            sn = first_sn + i
+            per_stream = ([f"{base_url}/{li}/{u}/seg{sn}.ts"
+                           for u in range(len(urls))] if redundant else None)
+            frags.append(
+                Frag(sn=sn, start=sn * seg_duration, duration=seg_duration,
+                     url=(per_stream[0] if per_stream
+                          else f"{base_url}/{li}/seg{sn}.ts"),
+                     level=li, urls=per_stream))
         levels.append(LevelSpec(bitrate=bitrate, urls=urls, fragments=frags))
     return Manifest(levels=levels, live=live)
 

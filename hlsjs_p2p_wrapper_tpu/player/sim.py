@@ -109,6 +109,7 @@ class SimPlayer(EventEmitter):
         self._loading = False
         self._loader = None
         self._tick_timer = None
+        self._redundant_rotations = 0  # backup-URL switches per frag run
 
     # -- public surface (hls.js-shaped) --------------------------------
     @property
@@ -307,8 +308,9 @@ class SimPlayer(EventEmitter):
         self._loader = loader_cls(self.config)
         self.emit(Events.FRAG_LOADING, {"frag": frag})
         self.abr.on_frag_loading({"frag": frag})
+        level = self._levels[self.current_level]
         self._loader.load(
-            frag.url, "arraybuffer",
+            frag.url_for(level.url_id), "arraybuffer",
             lambda event, stats, f=frag: self._on_frag_loaded(f, event, stats),
             lambda event, f=frag: self._on_frag_error(f, event),
             lambda event, stats, f=frag: self._on_frag_timeout(f, event),
@@ -323,6 +325,7 @@ class SimPlayer(EventEmitter):
             return
         self._loading = False
         self._loader = None
+        self._redundant_rotations = 0  # this stream is healthy again
         payload = event["current_target"]["response"]
         stats["tbuffered"] = self.clock.now()
         stats["length"] = len(payload) if payload is not None else stats.get(
@@ -342,6 +345,22 @@ class SimPlayer(EventEmitter):
         self._loading = False
         self._loader = None
         self.last_error = event
+        # redundant-stream failover (hls.js behavior the reference's
+        # v3.8.0 fix depends on — media-map.js:60-73, CHANGELOG.md:
+        # 20-22): rotate the level to its backup URL and refetch the
+        # same sn before giving up.  url_id is part of track identity,
+        # so the rotation is announced as a track change.
+        level = self._levels[frag.level] if self._levels else None
+        if (level is not None and len(level.url) > 1
+                and self._redundant_rotations < len(level.url) - 1):
+            self._redundant_rotations += 1
+            level.url_id = (level.url_id + 1) % len(level.url)
+            self.emit(Events.ERROR, {"type": "networkError",
+                                     "details": "fragLoadError",
+                                     "fatal": False, "frag": frag,
+                                     "event": event})
+            self.emit(Events.LEVEL_SWITCH, {"level": frag.level})
+            return  # next tick refetches this sn from the backup
         self.emit(Events.ERROR, {"type": "networkError",
                                  "details": "fragLoadError", "fatal": True,
                                  "frag": frag, "event": event})

@@ -157,8 +157,13 @@ def serve_manifest(cdn: MockCdnTransport, manifest) -> None:
                     return 404, b""
             return 404, b""
     else:
-        sizes = {frag.url: segment_size_bytes(level, frag)
-                 for level in manifest.levels for frag in level.fragments}
+        sizes = {}
+        for level in manifest.levels:
+            for frag in level.fragments:
+                sizes[frag.url] = segment_size_bytes(level, frag)
+                for backup_url in frag.urls or ():
+                    # redundant streams: every url_id's copy is served
+                    sizes[backup_url] = segment_size_bytes(level, frag)
 
         def resolve(url, headers):
             if url in sizes:

@@ -84,7 +84,7 @@ class SwarmHarness:
                  cdn_latency_ms: float = 15.0,
                  p2p_latency_ms: float = 8.0,
                  loss_rate: float = 0.0, seed: int = 0,
-                 live: bool = False):
+                 live: bool = False, redundant: bool = False):
         self.clock = VirtualClock()
         if live:
             self.manifest = make_live_manifest(level_bitrates=level_bitrates,
@@ -95,7 +95,8 @@ class SwarmHarness:
         else:
             self.manifest = make_vod_manifest(level_bitrates=level_bitrates,
                                               frag_count=frag_count,
-                                              seg_duration=seg_duration)
+                                              seg_duration=seg_duration,
+                                              redundant=redundant)
             self.feeder = None
         self.cdn = MockCdnTransport(self.clock, latency_ms=cdn_latency_ms,
                                     bandwidth_bps=cdn_bandwidth_bps)

@@ -176,6 +176,72 @@ def test_failover_to_cdn_when_peer_unreachable():
     assert len(out["error"]) == 0    # failover is internal
 
 
+def test_multi_holder_failover_second_peer_serves():
+    """VERDICT #4: a dead best-holder must not spend the whole budget —
+    the next holder gets the remaining budget and the segment still
+    arrives as P2P, not CDN."""
+    rig = Swarm()
+    a = rig.agent("a")
+    b = rig.agent("b")
+    c = rig.agent("c")
+    rig.clock.advance(100.0)
+    fetch(a, 30, rig.clock)       # a seeds from CDN
+    fetch(b, 30, rig.clock)       # b pulls via P2P → two holders
+    rig.clock.advance(100.0)
+    assert set(c.mesh.holders_of(sv(30).to_bytes())) == {"a", "b"}
+
+    best = c.mesh.holders_of(sv(30).to_bytes())[0]
+    other = "b" if best == "a" else "a"
+    holders = {"a": a, "b": b}
+    upload_before = {p: holders[p].stats["upload"] for p in holders}
+    rig.net.partition("c", best)  # best holder is dead to c
+    out, _ = fetch(c, 30, rig.clock, advance=20_000.0)
+    assert len(out["success"]) == 1
+    assert len(out["success"][0]) == 50_000
+    assert c.stats["p2p"] == 50_000, c.stats   # arrived via the OTHER holder
+    assert c.stats["cdn"] == 0, c.stats
+    assert holders[other].stats["upload"] == upload_before[other] + 50_000
+    assert holders[best].stats["upload"] == upload_before[best]
+    assert c.mesh._downloads == {}
+
+
+def test_all_holders_dead_falls_back_to_cdn_within_budget():
+    rig = Swarm()
+    a = rig.agent("a")
+    b = rig.agent("b")
+    c = rig.agent("c")
+    rig.clock.advance(100.0)
+    fetch(a, 30, rig.clock)
+    fetch(b, 30, rig.clock)
+    rig.clock.advance(100.0)
+    rig.net.partition("c", "a")
+    rig.net.partition("c", "b")
+    out, _ = fetch(c, 30, rig.clock, advance=30_000.0)
+    assert len(out["success"]) == 1
+    assert c.stats["cdn"] == 50_000
+    assert c.stats["p2p"] == 0
+
+
+def test_denied_holder_fails_over_within_leg_immediately():
+    """A deny (403) must advance to the next holder without waiting
+    for the attempt timeout."""
+    rig = Swarm()
+    a = rig.agent("a")
+    b = rig.agent("b")
+    c = rig.agent("c")
+    rig.clock.advance(100.0)
+    fetch(a, 30, rig.clock)
+    fetch(b, 30, rig.clock)
+    rig.clock.advance(100.0)
+    best = c.mesh.holders_of(sv(30).to_bytes())[0]
+    holders = {"a": a, "b": b}
+    holders[best].p2p_upload_on = False  # best holder denies
+    out, _ = fetch(c, 30, rig.clock, advance=1_000.0)  # well under budget
+    assert len(out["success"]) == 1
+    assert c.stats["p2p"] == 50_000
+    assert c.stats["cdn"] == 0
+
+
 def test_urgent_request_skips_p2p():
     rig = Swarm()
     a, b = rig.agent("a"), rig.agent("b")
