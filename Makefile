@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test lint bench examples dryrun check all
+.PHONY: test lint bench sweep sweep-live examples dryrun check all
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -13,6 +13,12 @@ lint:
 
 bench:
 	$(PY) bench.py
+
+sweep:
+	$(PY) tools/sweep.py
+
+sweep-live:
+	$(PY) tools/sweep.py --live
 
 # dryrun_multichip self-provisions the virtual 8-CPU mesh (subprocess
 # with JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count).

@@ -1,4 +1,4 @@
-"""Benchmark: device-side swarm simulation throughput.
+"""Benchmark: device-side swarm simulation throughput + utilization.
 
 The reference publishes no benchmark numbers (BASELINE.md) and cannot
 simulate swarms at all — its multi-instance story is "open several
@@ -8,8 +8,15 @@ of the batched swarm+ABR simulator (ops/swarm_sim.py) on the
 accelerator, versus the same model stepped by NumPy on the host
 (``vs_baseline`` = accelerator / host speedup).
 
+Utilization is reported against the analytic cost model
+(``step_flops`` / ``step_hbm_bytes``): the step is a gather/reduce
+pipeline over ``[P, P]`` eligibility — HBM-bandwidth-bound by
+design (see ops/swarm_sim.py module docstring for why that beats the
+round-1 ``O(P²·L·S)`` einsum formulation) — so ``hbm_util`` is the
+roofline that matters and ``mfu`` is honestly tiny.
+
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
 
 import json
@@ -27,9 +34,32 @@ from hlsjs_p2p_wrapper_tpu.core.abr import (  # noqa: E402
     DEFAULT_ESTIMATE_BPS, MIN_SAMPLE_DURATION_MS)
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
     BANDWIDTH_SAFETY, SwarmConfig, init_swarm, offload_ratio, ring_adjacency,
-    run_swarm, staggered_joins)
+    run_swarm, staggered_joins, step_flops, step_hbm_bytes)
 
 BITRATES = [300_000.0, 800_000.0, 2_000_000.0]
+
+#: nominal per-chip peaks for utilization reporting: (bf16 FLOP/s,
+#: HBM bytes/s).  Fuzzy-matched against jax device_kind; unknown
+#: kinds report throughput only.
+CHIP_PEAKS = {
+    "v2": (45e12, 700e9),
+    "v3": (123e12, 900e9),
+    "v4": (275e12, 1228e9),
+    "v5 lite": (197e12, 819e9),
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v6 lite": (918e12, 1640e9),
+    "v6e": (918e12, 1640e9),
+}
+
+
+def chip_peaks(device) -> tuple:
+    kind = getattr(device, "device_kind", "").lower()
+    best = None
+    for key, peaks in CHIP_PEAKS.items():
+        if key in kind and (best is None or len(key) > len(best[0])):
+            best = (key, peaks)
+    return best[1] if best else (None, None)
 
 
 def materialize(state) -> float:
@@ -49,14 +79,15 @@ def scenario_sizes():
 
 def numpy_baseline_throughput(config, n_steps, join):
     """The same model, stepped by NumPy on the host — the honest
-    'without the accelerator' comparison: constants come from the SAME
-    SwarmConfig/abr defaults the device run uses, with the
-    availability contraction done as a BLAS matmul (NumPy's best path
-    for it)."""
+    'without the accelerator' comparison.  Mirrors the device step:
+    [P, P] eligibility via fancy-indexed gather, demand-split uplink
+    contention, urgency + budget failover, dual-EWMA ABR."""
     P, S, L = config.n_peers, config.n_segments, config.n_levels
-    bitrates = np.array(BITRATES, np.float32)
+    bitrates = np.array(BITRATES[:L], np.float32)
     adj = np.asarray(ring_adjacency(P, 8), np.float32)
+    adj_t = adj.T.copy()
     cdn = np.full((P,), 8_000_000.0, np.float32)
+    uplink = np.full((P,), config.p2p_bps, np.float32)
     join = np.asarray(join, np.float32)
     seg, dt_ms = config.seg_duration_s, config.dt_ms
     dt_s = dt_ms / 1000.0
@@ -64,11 +95,11 @@ def numpy_baseline_throughput(config, n_steps, join):
     playhead = np.zeros(P, np.float32); buf = np.zeros(P, np.float32)
     fast_e = np.zeros(P, np.float32); fast_w = np.zeros(P, np.float32)
     slow_e = np.zeros(P, np.float32); slow_w = np.zeros(P, np.float32)
-    avail = np.zeros((P, L, S), np.float32)
+    avail = np.zeros((P, L * S), np.float32)
     dl_active = np.zeros(P, bool); dl_p2p = np.zeros(P, bool)
     dl_seg = np.zeros(P, np.int32); dl_level = np.zeros(P, np.int32)
     dl_done = np.zeros(P, np.float32); dl_total = np.zeros(P, np.float32)
-    dl_ms = np.zeros(P, np.float32)
+    dl_ms = np.zeros(P, np.float32); dl_budget = np.zeros(P, np.float32)
     alpha_f = np.exp(np.log(0.5) / config.fast_half_life_s)
     alpha_s = np.exp(np.log(0.5) / config.slow_half_life_s)
     t = 0.0
@@ -76,7 +107,7 @@ def numpy_baseline_throughput(config, n_steps, join):
 
     start = time.perf_counter()
     for _ in range(n_steps):
-        joined = t >= join
+        present = t >= join
         zf = 1.0 - np.power(alpha_f, fast_w); zs = 1.0 - np.power(alpha_s, slow_w)
         est_f = np.where(fast_w > 0, fast_e / np.maximum(zf, 1e-12), 0.0)
         est_s = np.where(slow_w > 0, slow_e / np.maximum(zs, 1e-12), 0.0)
@@ -85,23 +116,48 @@ def numpy_baseline_throughput(config, n_steps, join):
         fits = bitrates[None, :] <= (est * BANDWIDTH_SAFETY)[:, None]
         want = np.max(np.where(fits, np.arange(L)[None, :], 0), axis=1)
         nxt = np.minimum(((playhead + buf) / seg).astype(np.int32), S - 1)
-        may = (joined & ~dl_active & ((playhead + buf) < S * seg)
-               & (buf < config.max_buffer_s))
-        counts = (adj @ avail.reshape(P, L * S)).reshape(P, L, S)
-        have = counts[pidx, want, nxt] > 0
+        wants = (present & ~dl_active & ((playhead + buf) < S * seg)
+                 & (buf < config.max_buffer_s))
+        # eligibility gather + contention (the [P, P] pipeline)
+        gi = np.where(dl_active, dl_level, want) * S \
+            + np.where(dl_active, dl_seg, nxt)
+        have_ji = avail[:, gi]                       # [j, i]
+        elig = adj_t * have_ji * present[:, None]
+        n_holders = elig.sum(axis=0)
+        have = n_holders > 0
+        margin = nxt.astype(np.float32) * seg - playhead
+        urgent = margin < config.urgent_margin_s
+        budget = np.clip(margin * 1000.0 * config.p2p_budget_fraction,
+                         config.p2p_budget_floor_ms,
+                         config.p2p_budget_cap_ms)
+        start_p2p = wants & have & ~urgent
+        may = start_p2p | (wants & ~start_p2p)
         total_new = bitrates[want] * seg / 8.0
         dl_active |= may
-        dl_p2p = np.where(may, have, dl_p2p)
+        dl_p2p = np.where(may, start_p2p, dl_p2p) & (n_holders > 0)
         dl_seg = np.where(may, nxt, dl_seg)
         dl_level = np.where(may, want, dl_level)
         dl_total = np.where(may, total_new, dl_total)
         dl_done = np.where(may, 0.0, dl_done)
         dl_ms = np.where(may, 0.0, dl_ms)
-        rate = np.where(dl_p2p, config.p2p_bps, cdn)
-        dl_done = dl_done + np.where(dl_active, rate * dt_s / 8.0, 0.0)
-        dl_ms = dl_ms + np.where(dl_active, dt_ms, 0.0)
-        comp = dl_active & (dl_done >= dl_total)
-        np.maximum.at(avail, (pidx, dl_level, dl_seg),
+        dl_budget = np.where(may, budget, dl_budget)
+        active_p2p = dl_active & dl_p2p
+        demand = active_p2p / np.maximum(n_holders, 1.0)
+        share = elig * demand[None, :]
+        load = share.sum(axis=1)
+        service = uplink / np.maximum(load, 1.0)
+        p2p_rate = np.minimum((share * service[:, None]).sum(axis=0),
+                              config.p2p_bps)
+        rate = np.where(dl_p2p, p2p_rate, cdn)
+        prog = dl_active & present
+        dl_done = dl_done + np.where(prog, rate * dt_s / 8.0, 0.0)
+        dl_ms = dl_ms + np.where(prog, dt_ms, 0.0)
+        comp = prog & (dl_done >= dl_total)
+        expired = dl_active & dl_p2p & ~comp & (dl_ms >= dl_budget)
+        dl_p2p &= ~expired
+        dl_done = np.where(expired, 0.0, dl_done)
+        dl_ms = np.where(expired, 0.0, dl_ms)
+        np.maximum.at(avail, (pidx, dl_level * S + dl_seg),
                       np.where(comp, 1.0, 0.0))
         ms = np.maximum(dl_ms, MIN_SAMPLE_DURATION_MS)
         bw = 8000.0 * dl_total / ms; w = ms / 1000.0
@@ -112,7 +168,7 @@ def numpy_baseline_throughput(config, n_steps, join):
             tw[:] = np.where(comp, tw + w, tw)
         buf = buf + np.where(comp, seg, 0.0)
         dl_active &= ~comp
-        can = joined & (playhead < S * seg)
+        can = present & (playhead < S * seg)
         adv = np.minimum(buf, dt_s) * can
         playhead = playhead + adv
         buf = buf - adv
@@ -140,21 +196,33 @@ def main():
                              join)
         materialize(final)
     elapsed = time.perf_counter() - start
-    device_throughput = P * T * repeats / elapsed
+    steps_per_sec = T * repeats / elapsed
+    device_throughput = P * steps_per_sec
 
     host_throughput = numpy_baseline_throughput(config, min(T, 20), join)
+
+    achieved_flops = steps_per_sec * step_flops(config)
+    achieved_hbm = steps_per_sec * step_hbm_bytes(config)
+    peak_flops, peak_hbm = chip_peaks(jax.devices()[0])
+    detail = {
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        "peers": P, "segments": S, "steps": T,
+        "final_offload": round(float(offload_ratio(final)), 4),
+        "host_peer_steps_per_sec": round(host_throughput, 1),
+        "tflops": round(achieved_flops / 1e12, 4),
+        "hbm_gbps": round(achieved_hbm / 1e9, 1),
+    }
+    if peak_flops is not None:
+        detail["mfu"] = round(achieved_flops / peak_flops, 5)
+        detail["hbm_util"] = round(achieved_hbm / peak_hbm, 4)
 
     print(json.dumps({
         "metric": "swarm_sim_peer_steps_per_sec",
         "value": round(device_throughput, 1),
         "unit": "peer-steps/s",
         "vs_baseline": round(device_throughput / host_throughput, 2),
-        "detail": {
-            "platform": jax.devices()[0].platform,
-            "peers": P, "segments": S, "steps": T,
-            "final_offload": round(float(offload_ratio(final)), 4),
-            "host_peer_steps_per_sec": round(host_throughput, 1),
-        },
+        "detail": detail,
     }))
 
 

@@ -44,6 +44,16 @@ HANDSHAKE_RETRY_MS = 5_000.0
 #: announcement stays banned.  Finite, so one corrupted transfer
 #: (bit-rot, not malice) doesn't permanently shrink a small swarm.
 DEFAULT_BAN_MS = 600_000.0
+#: serve pacing (the WebRTC ``bufferedAmount`` model): stop pushing
+#: chunks once this much traffic is queued on the shaped uplink, and
+#: re-pump on this cadence.  Pacing is what makes CANCEL effective —
+#: a burst-everything serve pre-commits a whole segment of uplink
+#: that an aborting downloader can never reclaim, and under
+#: contention that waste storm collapses the swarm to CDN.
+PACE_BACKLOG_MS = 200.0
+PACE_RETRY_MS = 50.0
+#: give up on an upload that can't make progress (partitioned peer)
+UPLOAD_TTL_MS = 30_000.0
 
 
 class _Download:
@@ -69,6 +79,21 @@ class _Download:
         # must match or the peer is dropped as misbehaving
         self.expected_size: Optional[int] = expected_size
         self.expected_digest: Optional[bytes] = expected_digest
+
+
+class _Upload:
+    """One paced outbound serve."""
+
+    __slots__ = ("src_id", "request_id", "payload", "offset", "timer",
+                 "deadline_ms")
+
+    def __init__(self, src_id, request_id, payload, deadline_ms):
+        self.src_id = src_id
+        self.request_id = request_id
+        self.payload = payload
+        self.offset = 0
+        self.timer = None
+        self.deadline_ms = deadline_ms
 
 
 class DownloadHandle:
@@ -123,6 +148,8 @@ class PeerMesh:
         # punished peer every round, so dropping without remembering
         # would re-trust the poisoner seconds later
         self._banned: Dict[str, float] = {}
+        # (requester id, request id) -> in-flight paced serve
+        self._uploads: Dict[tuple, _Upload] = {}
         self.upload_bytes = 0
         self._downloads: Dict[int, _Download] = {}
         self._request_ids = itertools.count(1)
@@ -156,11 +183,14 @@ class PeerMesh:
             self.connect_to(peer_id)
 
     def drop_peer(self, peer_id: str) -> None:
-        """Forget a neighbor; fail its in-flight downloads."""
+        """Forget a neighbor; fail its in-flight downloads and stop
+        serving it."""
         self.peers.pop(peer_id, None)
         for request_id in [r for r, d in self._downloads.items()
                            if d.peer_id == peer_id]:
             self._fail_download(request_id, {"status": 0})
+        for key in [k for k in self._uploads if k[0] == peer_id]:
+            self._drop_upload(key)
 
     # -- availability --------------------------------------------------
     def holders_of(self, key: bytes) -> list:
@@ -235,6 +265,10 @@ class PeerMesh:
         if download is None:
             return
         download.timer.cancel()
+        # tell the server to reclaim its paced serve: a timeout that
+        # stays silent leaves it pushing bytes nobody will use
+        if not self.closed:
+            self._send(download.peer_id, P.Cancel(request_id))
         download.on_error(error)
 
     # -- frame handling ------------------------------------------------
@@ -282,7 +316,8 @@ class PeerMesh:
         elif isinstance(msg, P.Request):
             self._serve(src_id, msg)
         elif isinstance(msg, P.Cancel):
-            pass  # uploads are sent in one burst; nothing to stop
+            # reclaim the unsent remainder of a paced serve
+            self._drop_upload((src_id, msg.request_id))
         elif isinstance(msg, P.Chunk):
             self._on_chunk(src_id, msg)
         elif isinstance(msg, P.Deny):
@@ -300,19 +335,51 @@ class PeerMesh:
             # our LOST may still be in flight to them — stay truthful
             self._send(src_id, P.Deny(msg.request_id, P.DenyReason.NOT_FOUND))
             return
-        total = len(payload)
-        if total == 0:
+        if len(payload) == 0:
             self._send(src_id, P.Chunk(msg.request_id, 0, 0, b""))
-        for offset in range(0, total, self.chunk_bytes):
-            piece = payload[offset:offset + self.chunk_bytes]
-            if not self._send(src_id,
-                              P.Chunk(msg.request_id, offset, total, piece)):
-                # refused frame = a gap the downloader's sequential
-                # check will fail on anyway — stop wasting the uplink
-                break
+            return
+        key = (src_id, msg.request_id)
+        self._drop_upload(key)  # a duplicate request restarts cleanly
+        self._uploads[key] = _Upload(src_id, msg.request_id, payload,
+                                     self.clock.now() + UPLOAD_TTL_MS)
+        self._pump_upload(key)
+
+    def _pump_upload(self, key: tuple) -> None:
+        """Send chunks while the uplink backlog stays under the pacing
+        threshold, then re-arm.  Pacing keeps most of a serve
+        reclaimable: a CANCEL (or peer drop) stops everything not yet
+        handed to the transport."""
+        upload = self._uploads.get(key)
+        if upload is None or self.closed:
+            return
+        upload.timer = None
+        if self.clock.now() >= upload.deadline_ms:
+            del self._uploads[key]  # peer unreachable; stop retrying
+            return
+        total = len(upload.payload)
+        backlog = getattr(self.endpoint, "backlog_ms", lambda: 0.0)
+        while upload.offset < total and backlog() < PACE_BACKLOG_MS:
+            piece = upload.payload[upload.offset:
+                                   upload.offset + self.chunk_bytes]
+            if not self._send(upload.src_id,
+                              P.Chunk(upload.request_id, upload.offset,
+                                      total, piece)):
+                break  # transport refused: retry this SAME chunk later
             # count only what the transport accepted — `upload` is a
-            # conservation metric, not an intent metric
+            # conservation metric, not an intent metric; offset only
+            # advances on acceptance, so the receiver never sees a gap
             self.upload_bytes += len(piece)
+            upload.offset += len(piece)
+        if upload.offset >= total:
+            del self._uploads[key]
+            return
+        upload.timer = self.clock.call_later(
+            PACE_RETRY_MS, lambda: self._pump_upload(key))
+
+    def _drop_upload(self, key: tuple) -> None:
+        upload = self._uploads.pop(key, None)
+        if upload is not None and upload.timer is not None:
+            upload.timer.cancel()
 
     def _on_chunk(self, src_id: str, msg: P.Chunk) -> None:
         download = self._downloads.get(msg.request_id)
@@ -398,6 +465,8 @@ class PeerMesh:
         self.closed = True
         for request_id in list(self._downloads):
             self._fail_download(request_id, {"status": 0})
+        for key in list(self._uploads):
+            self._drop_upload(key)
         self.peers.clear()
 
     def _send(self, peer_id: str, msg) -> bool:

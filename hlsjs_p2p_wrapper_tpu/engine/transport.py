@@ -50,6 +50,15 @@ class Endpoint:
             return False
         return self.network._transmit(self, dest_id, frame)
 
+    def backlog_ms(self) -> float:
+        """How much already-accepted traffic is still waiting on this
+        peer's shaped uplink — the WebRTC ``bufferedAmount`` analogue.
+        Senders that pace on this can stop pushing when a transfer is
+        cancelled instead of having pre-queued a whole segment."""
+        if self.uplink_bps is None:
+            return 0.0
+        return max(0.0, self._uplink_free_at - self.network.clock.now())
+
     def close(self) -> None:
         self.closed = True
         self.network._endpoints.pop(self.peer_id, None)

@@ -2,11 +2,15 @@
 device-resident swarm simulator."""
 
 from .ewma import EwmaState, get_estimate, init_state, scan_samples, update
-from .swarm_sim import (SwarmConfig, SwarmState, init_swarm, offload_ratio,
-                        rebuffer_ratio, ring_adjacency, run_swarm,
-                        staggered_joins, swarm_step)
+from .swarm_sim import (SwarmConfig, SwarmScenario, SwarmState,
+                        full_adjacency, init_swarm, make_scenario,
+                        offload_ratio, rebuffer_ratio, ring_adjacency,
+                        run_swarm, stable_ranks, staggered_joins,
+                        step_flops, step_hbm_bytes, swarm_step)
 
 __all__ = ["EwmaState", "get_estimate", "init_state", "scan_samples",
-           "update", "SwarmConfig", "SwarmState", "init_swarm",
+           "update", "SwarmConfig", "SwarmScenario", "SwarmState",
+           "full_adjacency", "init_swarm", "make_scenario",
            "offload_ratio", "rebuffer_ratio", "ring_adjacency",
-           "run_swarm", "staggered_joins", "swarm_step"]
+           "run_swarm", "stable_ranks", "staggered_joins", "step_flops",
+           "step_hbm_bytes", "swarm_step"]

@@ -13,26 +13,51 @@ estimator (bit-identical numerics to the player's, ``ops/ewma.py``),
 one in-flight segment download, and a per-(level, segment) cache map.
 Per step (``dt_ms``):
 
-1. idle peers pick the next needed segment and an ABR level from the
-   EWMA estimate (same highest-fitting-bitrate rule as
+1. idle present peers pick the next needed segment and an ABR level
+   from the EWMA estimate (same highest-fitting-bitrate rule as
    ``core/abr.py:next_level``),
-2. swarm availability is one einsum ``adj[i,j] x avail[j,l,s]`` — the
-   MXU does neighbor counting for every (peer, level, segment) at
-   once,
-3. downloads progress at the P2P or CDN rate; completions update
-   cache, buffer, estimator, and byte counters,
+2. **availability + uplink contention** run on one ``[P, P]``
+   eligibility matrix: ``elig[j, i] = adj[i, j] · avail[j, seg_i] ·
+   present[j]`` — built by gathering each peer's single segment of
+   interest out of the cache map.  (Round 1 computed the FULL
+   ``adj @ avail`` product, ``O(P²·L·S)`` MXU flops per step, then
+   read ONE ``(level, segment)`` entry per peer from it — 768× more
+   arithmetic than used at the default ladder.  The gather form does
+   exactly the needed column in ``O(P²)``; the step becomes
+   HBM-bandwidth-bound rather than FLOPs-bound, which is the honest
+   roofline for this access pattern, and throughput rises
+   accordingly.)  From the same matrix: a downloader splits demand
+   across its holders, a holder's uplink is shared across the demand
+   on it (the ``engine/transport.py:126-132`` uplink-serialization
+   model), and a P2P download's rate is its share-weighted service,
+   capped by the downlink,
+3. downloads progress; P2P downloads whose holders all departed flip
+   to the CDN (the aggregate analogue of the agent's multi-holder →
+   CDN failover); completions update cache, buffer, estimator, and
+   byte counters,
 4. playback advances where buffered, else rebuffer accrues.
+
+Live mode (``config.live=True``): segment ``s`` becomes downloadable
+only once fully published (``(s+1)·seg ≤ t``); joiners start
+``live_sync_s`` behind the edge; and when no neighbor has a fresh
+segment, a peer may hit the CDN only after its stable per-peer
+stagger delay (``edge_rank · live_spread_s``) — the device-side sweep
+model of the agent's live-edge stagger (engine/p2p_agent.py).  Churn:
+peers depart at ``leave_s``; departed peers stop downloading,
+serving, and playing, but their transferred bytes stay in the totals
+(same accounting as the harness).
 
 Everything is ``lax.scan``-stepped, statically shaped, and
 ``shard_map``/pjit-shardable over the peer axis (see ``parallel/``):
-``avail`` and all per-peer state shard cleanly; the einsum's contracted
-peer axis turns into an XLA all-gather of neighbor caches over ICI.
+per-peer state shards cleanly; the eligibility gather contracts the
+peer axis, so under a sharded mesh XLA lowers it to the simulator's
+only collective.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +67,8 @@ from ..core.abr import (DEFAULT_FAST_HALF_LIFE_S, DEFAULT_SLOW_HALF_LIFE_S,
 from .ewma import EwmaState, get_estimate, init_state, update
 
 BANDWIDTH_SAFETY = 0.8  # core/abr.py AbrController.BANDWIDTH_SAFETY
+
+NEVER_S = 1e18  # "leave" time of a peer that never departs
 
 
 class SwarmConfig(NamedTuple):
@@ -54,9 +81,53 @@ class SwarmConfig(NamedTuple):
     seg_duration_s: float = 4.0
     dt_ms: float = 250.0
     max_buffer_s: float = 30.0
-    p2p_bps: float = 20_000_000.0
+    p2p_bps: float = 20_000_000.0        # downlink cap for P2P transfers
     fast_half_life_s: float = DEFAULT_FAST_HALF_LIFE_S
     slow_half_life_s: float = DEFAULT_SLOW_HALF_LIFE_S
+    live: bool = False
+    live_sync_s: float = 12.0            # join this far behind the edge
+    live_spread_s: float = 0.0           # CDN stagger window at the edge
+    # deadline-aware source selection — the SAME policy knobs as
+    # engine/scheduler.py SchedulingPolicy, so on-device sweeps tune
+    # the real agent's parameters:
+    urgent_margin_s: float = 4.0         # below this slack: straight CDN
+    p2p_budget_fraction: float = 0.5     # budget = margin × fraction...
+    p2p_budget_cap_ms: float = 6_000.0   # ...capped here
+    p2p_budget_floor_ms: float = 500.0   # ...floored here
+
+
+class SwarmScenario(NamedTuple):
+    """Per-peer scenario arrays (all ``[P]`` except as noted)."""
+
+    bitrates: jax.Array      # [L] bits/s ladder
+    adjacency: jax.Array     # [P, P] 0/1; row i = whom i downloads from
+    cdn_bps: jax.Array       # [P] per-peer CDN rate
+    uplink_bps: jax.Array    # [P] per-peer serving capacity
+    join_s: jax.Array        # [P] arrival time
+    leave_s: jax.Array       # [P] departure time (NEVER_S = stays)
+    edge_rank: jax.Array     # [P] in [0,1): live CDN stagger rank
+
+
+def make_scenario(config: SwarmConfig, bitrates, adjacency, cdn_bps,
+                  join_s=None, *, uplink_bps=None, leave_s=None,
+                  edge_rank=None) -> SwarmScenario:
+    """Normalize optional arrays to their defaults: everyone joins at
+    t=0, never leaves, serves at the downlink cap, rank 0."""
+    P = config.n_peers
+    return SwarmScenario(
+        bitrates=jnp.asarray(bitrates, jnp.float32),
+        adjacency=jnp.asarray(adjacency, jnp.float32),
+        cdn_bps=jnp.asarray(cdn_bps, jnp.float32),
+        uplink_bps=(jnp.asarray(uplink_bps, jnp.float32)
+                    if uplink_bps is not None
+                    else jnp.full((P,), config.p2p_bps, jnp.float32)),
+        join_s=(jnp.asarray(join_s, jnp.float32) if join_s is not None
+                else jnp.zeros((P,), jnp.float32)),
+        leave_s=(jnp.asarray(leave_s, jnp.float32) if leave_s is not None
+                 else jnp.full((P,), NEVER_S, jnp.float32)),
+        edge_rank=(jnp.asarray(edge_rank, jnp.float32)
+                   if edge_rank is not None
+                   else jnp.zeros((P,), jnp.float32)))
 
 
 class SwarmState(NamedTuple):
@@ -79,6 +150,7 @@ class SwarmState(NamedTuple):
     dl_done_bytes: jax.Array   # [P] f32
     dl_total_bytes: jax.Array  # [P] f32
     dl_elapsed_ms: jax.Array   # [P] f32
+    dl_budget_ms: jax.Array    # [P] f32 P2P time budget before CDN failover
 
 
 def init_swarm(config: SwarmConfig) -> SwarmState:
@@ -92,7 +164,7 @@ def init_swarm(config: SwarmConfig) -> SwarmState:
         ewma=init_state(P), avail=jnp.zeros((P, L, S), jnp.float32),
         cdn_bytes=f0, p2p_bytes=f0, dl_active=b0, dl_is_p2p=b0,
         dl_seg=i0, dl_level=i0, dl_done_bytes=f0, dl_total_bytes=f0,
-        dl_elapsed_ms=f0)
+        dl_elapsed_ms=f0, dl_budget_ms=f0)
 
 
 def _abr_pick(estimate_bps: jax.Array, bitrates: jax.Array) -> jax.Array:
@@ -103,59 +175,132 @@ def _abr_pick(estimate_bps: jax.Array, bitrates: jax.Array) -> jax.Array:
     return jnp.max(jnp.where(fits, idx[None, :], 0), axis=1)
 
 
-def swarm_step(config: SwarmConfig, bitrates: jax.Array,
-               adjacency: jax.Array, cdn_bps: jax.Array,
-               join_s: jax.Array, state: SwarmState) -> SwarmState:
-    """One ``dt_ms`` tick for every peer at once.  ``bitrates`` is
-    ``[L]`` bits/s, ``adjacency`` ``[P, P]`` 0/1 (row i = whom peer i
-    can download from), ``cdn_bps`` ``[P]``, ``join_s`` ``[P]`` each
-    peer's arrival time (audiences are staggered — a fully synchronized
-    swarm has nothing to share, every peer needs every segment at the
-    same instant)."""
+def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
+               state: SwarmState) -> SwarmState:
+    """One ``dt_ms`` tick for every peer at once."""
     dt_s = config.dt_ms / 1000.0
     seg = config.seg_duration_s
-    end_s = config.n_segments * seg
-    joined = state.t_s >= join_s  # [P]
+    S = config.n_segments
+    end_s = S * seg
+    t = state.t_s
+    present = (t >= scenario.join_s) & (t < scenario.leave_s)  # [P]
 
-    # ---- 1. idle peers start the next download -----------------------
+    playhead = state.playhead_s
+    if config.live:
+        # joiners start live_sync_s behind the edge (their join time):
+        # a static per-peer floor the playhead crosses once, at join
+        live_start = jnp.maximum(scenario.join_s - config.live_sync_s, 0.0)
+        playhead = jnp.maximum(playhead,
+                               jnp.where(t >= scenario.join_s,
+                                         live_start, 0.0))
+
+    # ---- 1. what does each peer need next? ---------------------------
     estimate = get_estimate(state.ewma, config.fast_half_life_s,
                             config.slow_half_life_s)
-    want_level = _abr_pick(estimate, bitrates)
+    want_level = _abr_pick(estimate, scenario.bitrates)
     next_seg = jnp.minimum(
-        ((state.playhead_s + state.buffer_s) / seg).astype(jnp.int32),
-        config.n_segments - 1)
-    timeline_left = (state.playhead_s + state.buffer_s) < end_s
-    may_start = (joined & ~state.dl_active & timeline_left
-                 & (state.buffer_s < config.max_buffer_s))
+        ((playhead + state.buffer_s) / seg).astype(jnp.int32), S - 1)
+    timeline_left = (playhead + state.buffer_s) < end_s
+    wants = (present & ~state.dl_active & timeline_left
+             & (state.buffer_s < config.max_buffer_s))
+    if config.live:
+        # only fully published segments are downloadable
+        wants = wants & ((next_seg.astype(jnp.float32) + 1.0) * seg <= t)
 
-    # ---- 2. swarm availability: the MXU step -------------------------
-    # counts[i, l, s] = how many of i's neighbors cache (l, s).
-    # bf16 inputs: adjacency and avail are 0/1 and realistic degrees
-    # stay far below bf16's exact-integer range, so the cast is
-    # lossless and the matmul runs at the MXU's fast rate.
-    counts = jnp.einsum("ij,jls->ils", adjacency.astype(jnp.bfloat16),
-                        state.avail.astype(jnp.bfloat16),
-                        preferred_element_type=jnp.float32)
-    peer_idx = jnp.arange(config.n_peers)
-    have_neighbors = counts[peer_idx, want_level, next_seg] > 0.0
+    # ---- 2. eligibility: one [P, P] gather instead of the full ------
+    # adj @ avail product.  Column i of `have` is every peer j's
+    # availability of peer i's single segment of interest — the
+    # in-flight (level, seg) for active downloads (contention), the
+    # wanted (level, seg) for idle peers (start decision).
+    gi_level = jnp.where(state.dl_active, state.dl_level, want_level)
+    gi_seg = jnp.where(state.dl_active, state.dl_seg, next_seg)
+    flat_idx = gi_level * S + gi_seg                         # [P] over i
+    # bf16 for the [P, P] arrays: every element is exactly 0 or 1, and
+    # all reductions accumulate in f32, so the halved HBM traffic is
+    # numerically free
+    avail_flat = state.avail.reshape(
+        config.n_peers, config.n_levels * S).astype(jnp.bfloat16)
+    have_ji = jnp.take(avail_flat, flat_idx, axis=1)         # [j, i]
+    elig_ji = (scenario.adjacency.T.astype(jnp.bfloat16) * have_ji
+               * present.astype(jnp.bfloat16)[:, None])      # [j, i]
+    n_holders = jnp.sum(elig_ji, axis=0, dtype=jnp.float32)  # [i]
+    have_neighbors = n_holders > 0.0
 
-    new_total = bitrates[want_level] * seg / 8.0
+    # ---- start decisions (engine/scheduler.py decide()) -------------
+    # margin = playback slack until the wanted segment is needed
+    # (segment start time minus playhead, the agent's
+    # _playback_margin_s); urgent requests must not gamble on peers,
+    # and P2P attempts get a bounded time budget before conceding to
+    # the CDN
+    margin_s = next_seg.astype(jnp.float32) * seg - playhead
+    urgent = margin_s < config.urgent_margin_s
+    budget_ms = jnp.clip(margin_s * 1000.0 * config.p2p_budget_fraction,
+                         config.p2p_budget_floor_ms,
+                         config.p2p_budget_cap_ms)
+    if config.live and config.live_spread_s > 0.0:
+        # live-edge stagger: with no holder yet, only low-rank peers
+        # hit the CDN now; the rest wait their stable fraction of the
+        # spread and usually catch the seeders' announcements instead
+        publish_t = (gi_seg.astype(jnp.float32) + 1.0) * seg
+        cdn_allowed = t >= publish_t + scenario.edge_rank * config.live_spread_s
+    else:
+        cdn_allowed = jnp.ones_like(have_neighbors)
+    start_p2p = wants & have_neighbors & ~urgent
+    start_cdn = wants & ~start_p2p & (cdn_allowed | urgent)
+    may_start = start_p2p | start_cdn
+
+    new_total = scenario.bitrates[want_level] * seg / 8.0
     dl_active = state.dl_active | may_start
-    dl_is_p2p = jnp.where(may_start, have_neighbors, state.dl_is_p2p)
+    dl_is_p2p = jnp.where(may_start, start_p2p, state.dl_is_p2p)
+    # a P2P download whose holders all departed flips to the CDN — the
+    # aggregate analogue of the agent's holders-exhausted failover
+    dl_is_p2p = dl_is_p2p & (n_holders > 0.0)
     dl_seg = jnp.where(may_start, next_seg, state.dl_seg)
     dl_level = jnp.where(may_start, want_level, state.dl_level)
     dl_total = jnp.where(may_start, new_total, state.dl_total_bytes)
     dl_done = jnp.where(may_start, 0.0, state.dl_done_bytes)
     dl_elapsed = jnp.where(may_start, 0.0, state.dl_elapsed_ms)
+    dl_budget = jnp.where(may_start, budget_ms, state.dl_budget_ms)
     level = jnp.where(may_start, want_level, state.level)
 
-    # ---- 3. progress + completion ------------------------------------
-    rate_bps = jnp.where(dl_is_p2p, config.p2p_bps, cdn_bps)
-    dl_done = dl_done + jnp.where(dl_active, rate_bps * dt_s / 8.0, 0.0)
-    dl_elapsed = dl_elapsed + jnp.where(dl_active, config.dt_ms, 0.0)
-    completed = dl_active & (dl_done >= dl_total)
+    # ---- 3. uplink contention + progress ----------------------------
+    # each active P2P downloader splits unit demand across its
+    # holders; a holder's uplink is shared across the demand on it
+    # (engine/transport.py:126-132); a downloader's rate is its
+    # share-weighted service, capped by the downlink.  The share
+    # matrix ``elig · demand`` never materializes: its row-sum is the
+    # matvec ``elig @ demand`` and its service-weighted column-sum is
+    # ``demand · (service @ elig)`` — two MXU matvecs instead of two
+    # more [P, P] arrays through HBM.
+    active_p2p = dl_active & dl_is_p2p
+    demand_i = active_p2p.astype(jnp.float32) / jnp.maximum(n_holders, 1.0)
+    load_j = jnp.einsum("ji,i->j", elig_ji,
+                        demand_i.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)  # [j]
+    service_j = scenario.uplink_bps / jnp.maximum(load_j, 1.0)
+    p2p_rate = jnp.minimum(
+        demand_i * jnp.einsum("j,ji->i", service_j.astype(jnp.bfloat16),
+                              elig_ji,
+                              preferred_element_type=jnp.float32),
+        config.p2p_bps)                                      # [i]
+    rate_bps = jnp.where(dl_is_p2p, p2p_rate, scenario.cdn_bps)
+    progressing = dl_active & present
+    dl_done = dl_done + jnp.where(progressing, rate_bps * dt_s / 8.0, 0.0)
+    dl_elapsed = dl_elapsed + jnp.where(progressing, config.dt_ms, 0.0)
+    completed = progressing & (dl_done >= dl_total)
+
+    # budget failover (engine/p2p_agent.py _start_p2p_leg → to_cdn): a
+    # P2P attempt that outlives its budget concedes to the CDN,
+    # DISCARDING partial bytes — the uplink it consumed meanwhile was
+    # real, which is how contention collapse propagates
+    p2p_expired = (dl_active & dl_is_p2p & ~completed
+                   & (dl_elapsed >= dl_budget))
+    dl_is_p2p = dl_is_p2p & ~p2p_expired
+    dl_done = jnp.where(p2p_expired, 0.0, dl_done)
+    dl_elapsed = jnp.where(p2p_expired, 0.0, dl_elapsed)
 
     # cache insert (scatter of 1s at completed (peer, level, seg))
+    peer_idx = jnp.arange(config.n_peers)
     avail = state.avail.at[peer_idx, dl_level, dl_seg].max(
         jnp.where(completed, 1.0, 0.0))
 
@@ -175,40 +320,57 @@ def swarm_step(config: SwarmConfig, bitrates: jax.Array,
     dl_active = dl_active & ~completed
 
     # ---- 4. playback ------------------------------------------------
-    can_play = joined & (state.playhead_s < end_s)
+    can_play = present & (playhead < end_s)
+    if config.live:
+        # live players hold live_sync_s of slack: playback starts that
+        # long after join, so the playhead trails the edge by the sync
+        # target and edge segments keep a non-urgent margin — without
+        # this, viewers pin to the edge with zero slack and the
+        # urgency rule sends every fetch to the CDN
+        can_play = can_play & (t >= scenario.join_s + config.live_sync_s)
     advance = jnp.minimum(buffer_s, dt_s) * can_play
-    playhead = state.playhead_s + advance
+    playhead = playhead + advance
     rebuffer = state.rebuffer_s + jnp.where(can_play, dt_s - advance, 0.0)
     buffer_s = buffer_s - advance
 
     return SwarmState(
-        t_s=state.t_s + dt_s,
+        t_s=t + dt_s,
         playhead_s=playhead, buffer_s=buffer_s, rebuffer_s=rebuffer,
         level=level, ewma=ewma, avail=avail, cdn_bytes=cdn_bytes,
         p2p_bytes=p2p_bytes, dl_active=dl_active, dl_is_p2p=dl_is_p2p,
         dl_seg=dl_seg, dl_level=dl_level, dl_done_bytes=dl_done,
-        dl_total_bytes=dl_total, dl_elapsed_ms=dl_elapsed)
+        dl_total_bytes=dl_total, dl_elapsed_ms=dl_elapsed,
+        dl_budget_ms=dl_budget)
 
 
 @partial(jax.jit, static_argnames=("config", "n_steps"))
-def run_swarm(config: SwarmConfig, bitrates: jax.Array,
-              adjacency: jax.Array, cdn_bps: jax.Array,
-              state: SwarmState, n_steps: int,
-              join_s: jax.Array = None) -> Tuple[SwarmState, jax.Array]:
-    """Scan ``n_steps`` ticks; returns (final state, offload-over-time
-    ``[n_steps]``).  One compiled program regardless of T.
-    ``join_s`` defaults to everyone arriving at t=0."""
-    if join_s is None:
-        join_s = jnp.zeros((config.n_peers,), jnp.float32)
-
+def _run_swarm(config: SwarmConfig, scenario: SwarmScenario,
+               state: SwarmState, n_steps: int):
     def step(carry, _):
-        new = swarm_step(config, bitrates, adjacency, cdn_bps, join_s,
-                         carry)
+        new = swarm_step(config, scenario, carry)
         p2p = jnp.sum(new.p2p_bytes)
         total = p2p + jnp.sum(new.cdn_bytes)
         return new, p2p / jnp.maximum(total, 1.0)
 
     return jax.lax.scan(step, state, None, length=n_steps)
+
+
+def run_swarm(config: SwarmConfig, bitrates: jax.Array,
+              adjacency: jax.Array, cdn_bps: jax.Array,
+              state: SwarmState, n_steps: int,
+              join_s: Optional[jax.Array] = None, *,
+              uplink_bps: Optional[jax.Array] = None,
+              leave_s: Optional[jax.Array] = None,
+              edge_rank: Optional[jax.Array] = None,
+              ) -> Tuple[SwarmState, jax.Array]:
+    """Scan ``n_steps`` ticks; returns (final state, offload-over-time
+    ``[n_steps]``).  One compiled program regardless of T.  Optional
+    arrays default to: everyone at t=0, forever, serving at the
+    downlink cap, rank 0 (see :func:`make_scenario`)."""
+    scenario = make_scenario(config, bitrates, adjacency, cdn_bps, join_s,
+                             uplink_bps=uplink_bps, leave_s=leave_s,
+                             edge_rank=edge_rank)
+    return _run_swarm(config, scenario, state, n_steps)
 
 
 def offload_ratio(state: SwarmState) -> jax.Array:
@@ -229,6 +391,27 @@ def rebuffer_ratio(state: SwarmState, elapsed_s: float,
     return jnp.sum(state.rebuffer_s) / jnp.maximum(watched, 1e-9)
 
 
+def step_flops(config: SwarmConfig) -> float:
+    """Analytic arithmetic per step, dominated by the ``[P, P]``
+    eligibility/contention pipeline (gather + 2 muls + mask + 2
+    reductions + share/service ≈ 7 ops per (j, i) pair) plus the
+    O(P·L·S) cache-map update.  Used by bench.py for achieved-FLOPs /
+    utilization reporting."""
+    P, L, S = config.n_peers, config.n_levels, config.n_segments
+    return 7.0 * P * P + 4.0 * P * L * S
+
+
+def step_hbm_bytes(config: SwarmConfig) -> float:
+    """Analytic main-memory traffic per step: the bf16 [P, P] arrays
+    (adjacency read; gathered availability written + read; eligibility
+    written + read three times by the reductions) plus the f32
+    [P, L, S] cache-map traffic (bf16 cast + scatter).  The step is
+    bandwidth-bound, so THIS is the roofline that bounds
+    peer-steps/s."""
+    P, L, S = config.n_peers, config.n_levels, config.n_segments
+    return 2.0 * 7.0 * P * P + 8.0 * P * L * S
+
+
 def staggered_joins(n_peers: int, window_s: float = 60.0,
                     seed: int = 0) -> jnp.ndarray:
     """Deterministic shuffled join times over ``window_s``.  Shuffling
@@ -238,6 +421,13 @@ def staggered_joins(n_peers: int, window_s: float = 60.0,
     position."""
     base = jnp.linspace(0.0, window_s, n_peers)
     return jax.random.permutation(jax.random.PRNGKey(seed), base)
+
+
+def stable_ranks(n_peers: int, seed: int = 0) -> jnp.ndarray:
+    """Deterministic per-peer ranks in [0, 1) for the live-edge CDN
+    stagger — the device-side analogue of the agent's hashed
+    ``_edge_rank`` (engine/p2p_agent.py)."""
+    return jax.random.uniform(jax.random.PRNGKey(seed), (n_peers,))
 
 
 def ring_adjacency(n_peers: int, degree: int = 8) -> jnp.ndarray:
@@ -253,3 +443,10 @@ def ring_adjacency(n_peers: int, degree: int = 8) -> jnp.ndarray:
     neighbors = (idx[:, None] + offsets[None, :]) % n_peers
     adj = jnp.zeros((n_peers, n_peers), jnp.float32)
     return adj.at[idx[:, None], neighbors].set(1.0)
+
+
+def full_adjacency(n_peers: int) -> jnp.ndarray:
+    """Everyone sees everyone (minus self) — the small-swarm topology
+    the tracker-based harness produces, for parity tests."""
+    return (jnp.ones((n_peers, n_peers), jnp.float32)
+            - jnp.eye(n_peers, dtype=jnp.float32))

@@ -1,8 +1,8 @@
 """Multi-chip scaling: device meshes + canonical shardings for the
 swarm simulator (peers = data axis, segments = optional second axis)."""
 
-from .mesh import (PEER_AXIS, SEGMENT_AXIS, input_shardings, make_mesh,
+from .mesh import (PEER_AXIS, SEGMENT_AXIS, make_mesh, scenario_shardings,
                    shard_swarm, sharded_run, state_shardings)
 
-__all__ = ["PEER_AXIS", "SEGMENT_AXIS", "input_shardings", "make_mesh",
+__all__ = ["PEER_AXIS", "SEGMENT_AXIS", "make_mesh", "scenario_shardings",
            "shard_swarm", "sharded_run", "state_shardings"]

@@ -5,10 +5,11 @@ annotate shardings, let XLA insert the collectives.  The simulator's
 natural data axis is **peers** — every per-peer field shards over it
 ("dp"-style), and the cache map's segment axis can shard over a second
 **segments** axis ("sp"-style) for very long timelines.  The one
-cross-peer op, the availability einsum ``adj[i,j] x avail[j,l,s]``,
-contracts the full peer axis: under a sharded ``j``, XLA lowers it to
-a reduce-scatter/all-gather over ICI — the simulator's only
-collective, riding the fast fabric by construction.
+cross-peer op, the eligibility gather ``avail[j, seg_i]`` and its
+contention reductions over ``j``, contracts the full peer axis: under
+a sharded ``j``, XLA lowers it to gather/reduce collectives over ICI —
+the simulator's only cross-device traffic, riding the fast fabric by
+construction.
 """
 
 from __future__ import annotations
@@ -19,7 +20,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.swarm_sim import SwarmConfig, SwarmState
+from ..ops.swarm_sim import SwarmConfig, SwarmScenario, SwarmState
 
 PEER_AXIS = "peers"
 SEGMENT_AXIS = "segments"
@@ -55,44 +56,42 @@ def state_shardings(mesh: Mesh) -> SwarmState:
         avail=avail, cdn_bytes=peer_vec, p2p_bytes=peer_vec,
         dl_active=peer_vec, dl_is_p2p=peer_vec, dl_seg=peer_vec,
         dl_level=peer_vec, dl_done_bytes=peer_vec,
-        dl_total_bytes=peer_vec, dl_elapsed_ms=peer_vec)
+        dl_total_bytes=peer_vec, dl_elapsed_ms=peer_vec,
+        dl_budget_ms=peer_vec)
 
 
-def input_shardings(mesh: Mesh):
-    """(bitrates, adjacency, cdn_bps) shardings: the bitrate ladder is
-    tiny and replicated; adjacency shards its ROW (requester) axis so
-    each device owns its peers' neighbor lists; per-peer CDN rates
-    shard like every peer vector."""
-    return (NamedSharding(mesh, P()),
-            NamedSharding(mesh, P(PEER_AXIS, None)),
-            NamedSharding(mesh, P(PEER_AXIS)))
+def scenario_shardings(mesh: Mesh) -> SwarmScenario:
+    """A ``SwarmScenario``-shaped pytree of NamedShardings: the bitrate
+    ladder is tiny and replicated; adjacency shards its ROW (requester)
+    axis so each device owns its peers' neighbor lists; every per-peer
+    vector shards over the peer axis."""
+    peer_vec = NamedSharding(mesh, P(PEER_AXIS))
+    return SwarmScenario(
+        bitrates=NamedSharding(mesh, P()),
+        adjacency=NamedSharding(mesh, P(PEER_AXIS, None)),
+        cdn_bps=peer_vec, uplink_bps=peer_vec, join_s=peer_vec,
+        leave_s=peer_vec, edge_rank=peer_vec)
 
 
-def shard_swarm(mesh: Mesh, bitrates, adjacency, cdn_bps, join_s,
-                state: SwarmState):
+def shard_swarm(mesh: Mesh, scenario: SwarmScenario, state: SwarmState):
     """Place scenario + state onto the mesh with the canonical
-    shardings; returns device arrays ready for ``run_swarm``."""
-    bit_s, adj_s, cdn_s = input_shardings(mesh)
-    return (jax.device_put(bitrates, bit_s),
-            jax.device_put(adjacency, adj_s),
-            jax.device_put(cdn_bps, cdn_s),
-            jax.device_put(join_s, cdn_s),
-            jax.tree_util.tree_map(jax.device_put, state,
-                                   state_shardings(mesh)))
+    shardings; returns device pytrees ready for ``_run_swarm``."""
+    scenario = jax.tree_util.tree_map(jax.device_put, scenario,
+                                      scenario_shardings(mesh))
+    state = jax.tree_util.tree_map(jax.device_put, state,
+                                   state_shardings(mesh))
+    return scenario, state
 
 
 def sharded_run(mesh: Mesh, config: SwarmConfig, bitrates, adjacency,
-                cdn_bps, state: SwarmState, n_steps: int, join_s=None):
-    """jit ``run_swarm`` with explicit input shardings over the mesh.
-    XLA inserts the ICI collectives for the availability einsum; all
-    other ops stay local to their shard."""
-    import jax.numpy as jnp
-
-    from ..ops.swarm_sim import run_swarm
-    if join_s is None:
-        join_s = jnp.zeros((config.n_peers,), jnp.float32)
-    bitrates, adjacency, cdn_bps, join_s, state = shard_swarm(
-        mesh, bitrates, adjacency, cdn_bps, join_s, state)
+                cdn_bps, state: SwarmState, n_steps: int, join_s=None,
+                **scenario_kwargs):
+    """jit the swarm scan with explicit input shardings over the mesh.
+    XLA inserts the ICI collectives for the eligibility gather and
+    contention reductions; all other ops stay local to their shard."""
+    from ..ops.swarm_sim import _run_swarm, make_scenario
+    scenario = make_scenario(config, bitrates, adjacency, cdn_bps, join_s,
+                             **scenario_kwargs)
+    scenario, state = shard_swarm(mesh, scenario, state)
     with mesh:
-        return run_swarm(config, bitrates, adjacency, cdn_bps, state,
-                         n_steps, join_s)
+        return _run_swarm(config, scenario, state, n_steps)

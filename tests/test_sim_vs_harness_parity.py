@@ -1,0 +1,92 @@
+"""Device sim ↔ discrete harness parity (VERDICT r1 #3).
+
+The TPU simulator exists to sweep policy/topology at scales the
+discrete-event harness can't reach — which is only trustworthy if the
+two models agree where they overlap.  This runs the SAME small
+scenario through both: N fully-connected peers (the tracker topology),
+staggered joins, one-level ladder (removes ABR-path differences),
+shared per-peer CDN rate and seeder uplink — and requires the
+swarm-wide offload ratios to land close.
+
+The round-1 gap this pins down: the device sim gave every P2P
+download a flat ``p2p_bps`` regardless of seeder load, while the
+harness serializes a seeder's uplink (engine/transport.py:126-132) —
+so the sim systematically overestimated offload under tight uplinks.
+"""
+
+import jax.numpy as jnp
+
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (SwarmConfig, full_adjacency,
+                                                 init_swarm, offload_ratio,
+                                                 run_swarm)
+from hlsjs_p2p_wrapper_tpu.testing.swarm import SwarmHarness
+
+N_PEERS = 8
+FRAGS = 24
+SEG_S = 4.0
+BITRATE = 800_000.0
+CDN_BPS = 8_000_000.0
+JOIN_SPACING_S = 6.0
+
+
+def harness_offload(uplink_bps):
+    harness = SwarmHarness(seg_duration=SEG_S, frag_count=FRAGS,
+                           level_bitrates=(int(BITRATE),),
+                           cdn_bandwidth_bps=CDN_BPS)
+    for i in range(N_PEERS):
+        harness.add_peer(f"p{i}", uplink_bps=uplink_bps)
+        harness.run(JOIN_SPACING_S * 1000.0)
+    assert harness.run_until_all_finished(), "harness swarm stalled"
+    return harness.offload_ratio
+
+
+def sim_offload(uplink_bps):
+    config = SwarmConfig(n_peers=N_PEERS, n_segments=FRAGS, n_levels=1,
+                         seg_duration_s=SEG_S)
+    join = jnp.arange(N_PEERS, dtype=jnp.float32) * JOIN_SPACING_S
+    uplink = jnp.full((N_PEERS,), float(uplink_bps))
+    final, _ = run_swarm(config, jnp.array([BITRATE]),
+                         full_adjacency(N_PEERS),
+                         jnp.full((N_PEERS,), CDN_BPS),
+                         init_swarm(config),
+                         int(400.0 * 1000.0 / config.dt_ms), join,
+                         uplink_bps=uplink)
+    # every peer must actually finish the timeline, like the harness
+    assert float(jnp.min(final.playhead_s)) >= FRAGS * SEG_S - 0.5
+    return float(offload_ratio(final))
+
+
+def test_offload_parity_ample_uplink():
+    """With uplink ≫ demand both models should report the same
+    high offload for a staggered audience."""
+    h = harness_offload(50_000_000.0)
+    s = sim_offload(50_000_000.0)
+    assert abs(h - s) < 0.15, (h, s)
+    assert h > 0.5 and s > 0.5  # and it's genuinely a P2P-served swarm
+
+
+def test_offload_drops_under_tight_uplink_in_both_models():
+    """With seeder uplinks barely above the bitrate, contention must
+    push BOTH models' offload down substantially from their ample
+    values — the round-1 sim stayed at its ample value here.
+
+    Point equality is NOT asserted in this regime, deliberately: past
+    the contention cliff the harness collapses harder than the sim
+    because each harness peer runs up to three concurrent transfers
+    (foreground + 2 prefetches) from its single least-loaded holder,
+    and every timed-out attempt discards its partial bytes — while
+    the sim models one download per peer spread across all holders.
+    In the supply-adequate regime (the ample test above) the two
+    agree closely; under extreme contention the sim is a documented
+    OPTIMISTIC bound, and the property a design sweep needs is that
+    both models rank the scenarios the same way."""
+    h_ample = harness_offload(50_000_000.0)
+    s_ample = sim_offload(50_000_000.0)
+    h_tight = harness_offload(1_200_000.0)
+    s_tight = sim_offload(1_200_000.0)
+    # both models lose a meaningful share of offload to contention
+    assert h_ample - h_tight > 0.15, (h_ample, h_tight)
+    assert s_ample - s_tight > 0.15, (s_ample, s_tight)
+    # same ranking; the sim errs on the optimistic side only
+    assert s_tight >= h_tight - 0.05
+    assert s_ample >= s_tight  # tight uplink can't raise offload

@@ -1,15 +1,16 @@
 """Device-side swarm simulator: dynamics sanity, offload behavior,
-determinism, and sharded multi-device execution (8 virtual CPU
-devices via conftest)."""
+uplink contention, live+churn, determinism, and sharded multi-device
+execution (8 virtual CPU devices via conftest)."""
 
 import jax
 import jax.numpy as jnp
 import pytest
 
-from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (SwarmConfig, init_swarm,
-                                                 offload_ratio,
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (NEVER_S, SwarmConfig,
+                                                 init_swarm, offload_ratio,
                                                  rebuffer_ratio,
-                                                 ring_adjacency, run_swarm)
+                                                 ring_adjacency, run_swarm,
+                                                 stable_ranks)
 from hlsjs_p2p_wrapper_tpu.parallel import make_mesh, sharded_run
 
 BITRATES = jnp.array([300_000.0, 800_000.0, 2_000_000.0])
@@ -111,6 +112,104 @@ def test_byte_accounting_consistent():
     expected_min = completions * float(seg_bytes[0])
     expected_max = completions * float(seg_bytes[-1])
     assert expected_min <= total <= expected_max
+
+
+# -- uplink contention (VERDICT r1 #3) ---------------------------------
+
+def test_uplink_contention_slows_shared_seeder():
+    """Many followers pulling from ONE seeder must share its uplink:
+    with a tight uplink the same swarm takes visibly longer to move
+    the same P2P bytes than with an ample one — the round-1 model
+    gave every P2P download the full rate regardless of load."""
+    n = 17  # 1 seeder + 16 followers
+    config = SwarmConfig(n_peers=n, n_segments=32, n_levels=1,
+                         p2p_bps=50_000_000.0)
+    bitrates = jnp.array([2_000_000.0])
+    # star: every follower sees only peer 0
+    adj = jnp.zeros((n, n)).at[1:, 0].set(1.0)
+    cdn = jnp.full((n,), 8_000_000.0)
+    # seeder joins at 0 and runs ahead; followers join together later
+    join = jnp.full((n,), 30.0).at[0].set(0.0)
+
+    def run(uplink0):
+        uplink = jnp.full((n,), 50_000_000.0).at[0].set(uplink0)
+        final, _ = run_swarm(config, bitrates, adj, cdn,
+                             init_swarm(config), 480, join,
+                             uplink_bps=uplink)
+        return final
+
+    ample = run(200_000_000.0)
+    tight = run(4_000_000.0)  # 16 followers share 4 Mbps: 0.25 Mbps each
+    # same swarm, same demand: the tight uplink must deliver fewer P2P
+    # bytes in the same wall-clock (followers fall back to CDN or wait)
+    assert float(jnp.sum(tight.p2p_bytes)) < float(jnp.sum(ample.p2p_bytes))
+    # and nothing broke conservation: everyone still made progress
+    assert float(jnp.min(tight.playhead_s + tight.buffer_s)) > 0.0
+
+
+# -- churn + live (VERDICT r1 #6) --------------------------------------
+
+def test_departed_peers_stop_serving_and_counting():
+    config, bitrates, adjacency, cdn, join, state = scenario(stagger_s=10.0)
+    n = config.n_peers
+    # half the swarm departs at t=30s
+    leave = jnp.where(jnp.arange(n) % 2 == 0, 30.0, NEVER_S)
+    final, _ = run_swarm(config, bitrates, adjacency, cdn, state,
+                         steps_for(config, 120.0), join, leave_s=leave)
+    stayers = jnp.arange(n) % 2 == 1
+    leavers = ~stayers
+    # leavers froze at ~30s of playback; stayers finished the timeline
+    assert float(jnp.max(jnp.where(leavers, final.playhead_s, 0.0))) <= 31.0
+    assert float(jnp.min(jnp.where(stayers, final.playhead_s, 1e9))) > 100.0
+    # leavers' transferred bytes remain in the totals (harness contract)
+    assert float(jnp.sum(jnp.where(leavers, final.cdn_bytes
+                                   + final.p2p_bytes, 0.0))) > 0.0
+
+
+def test_live_mode_respects_publish_times():
+    config = SwarmConfig(n_peers=16, n_segments=64, n_levels=1, live=True,
+                         live_sync_s=12.0)
+    bitrates = jnp.array([800_000.0])
+    adjacency = ring_adjacency(16, 8)
+    cdn = jnp.full((16,), 8_000_000.0)
+    state = init_swarm(config)
+    # after 60s, only segments published by then can exist anywhere
+    final, _ = run_swarm(config, bitrates, adjacency, cdn, state,
+                         steps_for(config, 60.0))
+    S = config.n_segments
+    published = int(60.0 / config.seg_duration_s)
+    cached_segs = jnp.any(final.avail > 0, axis=(0, 1))  # [S]
+    assert not bool(jnp.any(cached_segs[published:]))
+    # viewers track the edge: playheads advanced with the broadcast
+    assert float(jnp.min(final.playhead_s)) > 30.0
+
+
+def test_live_edge_stagger_raises_offload_at_scale():
+    """The agent's live-edge stagger policy, swept on-device at 1000+
+    peers: with rank-staggered CDN fetches, low-rank peers seed each
+    fresh segment and the rest ride P2P — offload must beat the
+    no-stagger swarm, where everyone races the CDN at publish time."""
+    n = 1024
+    bitrates = jnp.array([800_000.0])
+    adjacency = ring_adjacency(n, 16)
+    cdn = jnp.full((n,), 8_000_000.0)
+    ranks = stable_ranks(n)
+
+    def run(spread_s):
+        # sync must leave stagger room: margin at publish is
+        # sync − seg_duration, and the spread + urgency threshold
+        # must fit inside it (sync 16 → margin 12 > spread 2 + urgent 4)
+        config = SwarmConfig(n_peers=n, n_segments=48, n_levels=1,
+                             live=True, live_sync_s=16.0,
+                             live_spread_s=spread_s, dt_ms=250.0)
+        final, _ = run_swarm(config, bitrates, adjacency, cdn,
+                             init_swarm(config),
+                             steps_for(config, 120.0), edge_rank=ranks)
+        return float(offload_ratio(final))
+
+    no_stagger = run(0.0)
+    staggered = run(2.0)
+    assert staggered > no_stagger + 0.1, (no_stagger, staggered)
 
 
 # -- multi-device sharding (8 virtual CPU devices) ---------------------

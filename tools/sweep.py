@@ -1,0 +1,127 @@
+"""Design-space sweep: the device simulator's concrete payoff.
+
+Runs the batched swarm simulator (ops/swarm_sim.py) over a grid of
+design knobs — mesh degree × scheduler policy × bitrate ladder ×
+(optionally) live-edge stagger — and prints the offload/rebuffer
+frontier, on-device, in seconds.  This is the tool the reference
+could never have: its multi-instance story was "open several browser
+tabs" (reference README.md:253); here a thousand-peer swarm is one
+``lax.scan`` and a whole policy grid is a coffee-length run.
+
+Usage::
+
+    python tools/sweep.py                 # default VOD grid
+    python tools/sweep.py --live          # live-edge stagger grid
+    python tools/sweep.py --peers 2048 --watch-s 180 --json
+
+Output: one row per grid point with the north-star pair
+(BASELINE.json) — P2P offload ratio and rebuffer ratio — plus the
+knob values, sorted best-offload-first; ``--json`` emits one JSON
+line per row for downstream tooling.
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
+    SwarmConfig, init_swarm, offload_ratio, rebuffer_ratio, ring_adjacency,
+    run_swarm, stable_ranks, staggered_joins)
+
+LADDERS = {
+    "sd": (300_000.0, 800_000.0),
+    "hd": (300_000.0, 800_000.0, 2_000_000.0),
+    "fhd": (500_000.0, 1_500_000.0, 4_000_000.0),
+}
+
+
+def run_point(*, peers, segments, ladder, degree, urgent_margin_s,
+              budget_cap_ms, watch_s, live, spread_s, uplink_bps,
+              cdn_bps, stagger_s, seed):
+    bitrates = jnp.array(LADDERS[ladder])
+    config = SwarmConfig(
+        n_peers=peers, n_segments=segments, n_levels=len(LADDERS[ladder]),
+        live=live, live_sync_s=16.0, live_spread_s=spread_s,
+        urgent_margin_s=urgent_margin_s, p2p_budget_cap_ms=budget_cap_ms)
+    adjacency = ring_adjacency(peers, degree)
+    cdn = jnp.full((peers,), cdn_bps)
+    uplink = jnp.full((peers,), uplink_bps)
+    join = (jnp.zeros((peers,)) if live
+            else staggered_joins(peers, stagger_s, seed))
+    ranks = stable_ranks(peers, seed)
+    n_steps = int(watch_s * 1000.0 / config.dt_ms)
+    final, _ = run_swarm(config, bitrates, adjacency, cdn,
+                         init_swarm(config), n_steps, join,
+                         uplink_bps=uplink, edge_rank=ranks)
+    return {
+        "offload": round(float(offload_ratio(final)), 4),
+        "rebuffer": round(float(rebuffer_ratio(final, watch_s, join)), 5),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--peers", type=int, default=1024)
+    ap.add_argument("--segments", type=int, default=128)
+    ap.add_argument("--watch-s", type=float, default=240.0)
+    ap.add_argument("--live", action="store_true",
+                    help="sweep the live-edge stagger grid instead of VOD")
+    ap.add_argument("--uplink-mbps", type=float, default=10.0)
+    ap.add_argument("--cdn-mbps", type=float, default=8.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line per grid point")
+    args = ap.parse_args()
+
+    degrees = (4, 8, 16)
+    ladders = ("sd", "hd")
+    if args.live:
+        spreads = (0.0, 1.0, 2.0, 4.0)
+        grid = [dict(degree=d, ladder=lad, spread_s=sp,
+                     urgent_margin_s=4.0, budget_cap_ms=6_000.0)
+                for d, lad, sp in itertools.product(degrees, ladders,
+                                                    spreads)]
+    else:
+        urgents = (2.0, 4.0, 8.0)
+        grid = [dict(degree=d, ladder=lad, spread_s=0.0,
+                     urgent_margin_s=u, budget_cap_ms=6_000.0)
+                for d, lad, u in itertools.product(degrees, ladders,
+                                                   urgents)]
+
+    t0 = time.perf_counter()
+    rows = []
+    for knobs in grid:
+        metrics = run_point(
+            peers=args.peers, segments=args.segments, watch_s=args.watch_s,
+            live=args.live, uplink_bps=args.uplink_mbps * 1e6,
+            cdn_bps=args.cdn_mbps * 1e6, stagger_s=60.0, seed=args.seed,
+            **knobs)
+        rows.append({**knobs, **metrics})
+    elapsed = time.perf_counter() - t0
+
+    rows.sort(key=lambda r: (-r["offload"], r["rebuffer"]))
+    if args.json:
+        for row in rows:
+            print(json.dumps(row))
+    else:
+        knob_names = [k for k in rows[0] if k not in ("offload", "rebuffer")]
+        header = " | ".join(f"{k:>15}" for k in knob_names
+                            + ["offload", "rebuffer"])
+        print(header)
+        print("-" * len(header))
+        for row in rows:
+            print(" | ".join(f"{row[k]!s:>15}" for k in knob_names
+                             + ["offload", "rebuffer"]))
+    print(f"# {len(rows)} grid points x {args.peers} peers x "
+          f"{args.watch_s:.0f}s in {elapsed:.1f}s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
