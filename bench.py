@@ -8,12 +8,23 @@ of the batched swarm+ABR simulator (ops/swarm_sim.py) on the
 accelerator, versus the same model stepped by NumPy on the host
 (``vs_baseline`` = accelerator / host speedup).
 
-Utilization is reported against the analytic cost model
-(``step_flops`` / ``step_hbm_bytes``): the step is a gather/reduce
-pipeline over ``[P, P]`` eligibility — HBM-bandwidth-bound by
-design (see ops/swarm_sim.py module docstring for why that beats the
-round-1 ``O(P²·L·S)`` einsum formulation) — so ``hbm_util`` is the
-roofline that matters and ``mfu`` is honestly tiny.
+Round 3 notes for the honest read of the numbers:
+- The simulator is now the sparse ``[P, K]`` neighbor-list
+  formulation (ops/swarm_sim.py module docstring): O(P·K) memory and
+  compute per step, which is why the default device scenario is now
+  65,536 peers — impossible under round 2's dense [P, P] form, whose
+  adjacency alone would be 17 GB.
+- The host baseline runs the SAME sparse model, vectorized with NumPy
+  fancy-indexing + ``np.add.at`` scatter — not a strawman (VERDICT r2
+  weak #6: round 2's host path materialized a [P, P] share matrix the
+  device path avoided, inflating ``vs_baseline`` to 838×; this one is
+  the fastest pure-NumPy formulation we know).
+- Utilization is reported against the analytic per-step cost model
+  (``step_flops`` / ``step_hbm_bytes``), which counts only
+  algorithmically-required traffic; the sparse step is
+  bandwidth/overhead-bound, so ``mfu`` is honestly tiny and
+  ``hbm_util`` is a lower bound (random-access gathers touch full
+  cache lines the model doesn't charge for).
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -33,10 +44,11 @@ import numpy as np  # noqa: E402
 from hlsjs_p2p_wrapper_tpu.core.abr import (  # noqa: E402
     DEFAULT_ESTIMATE_BPS, MIN_SAMPLE_DURATION_MS)
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
-    BANDWIDTH_SAFETY, SwarmConfig, init_swarm, offload_ratio, ring_adjacency,
-    run_swarm, staggered_joins, step_flops, step_hbm_bytes)
+    BANDWIDTH_SAFETY, SwarmConfig, init_swarm, offload_ratio, ring_neighbors,
+    ring_offsets, run_swarm, staggered_joins, step_flops, step_hbm_bytes)
 
 BITRATES = [300_000.0, 800_000.0, 2_000_000.0]
+DEGREE = 8
 
 #: nominal per-chip peaks for utilization reporting: (bf16 FLOP/s,
 #: HBM bytes/s).  Fuzzy-matched against jax device_kind; unknown
@@ -73,19 +85,24 @@ def materialize(state) -> float:
 def scenario_sizes():
     platform = jax.devices()[0].platform
     if platform in ("tpu", "gpu"):
-        return 4096, 256, 400, 3  # peers, segments, steps, timed repeats
+        # peers, segments, steps, timed repeats.  65,536 peers is the
+        # sparse formulation's scale demonstration (VERDICT r2 next
+        # #1): dense adjacency alone would need 17 GB here.
+        peers = int(os.environ.get("BENCH_PEERS", 65536))
+        return peers, 256, 400, 3
     return 256, 64, 100, 2  # host-class fallback so local runs finish
 
 
 def numpy_baseline_throughput(config, n_steps, join):
-    """The same model, stepped by NumPy on the host — the honest
-    'without the accelerator' comparison.  Mirrors the device step:
-    [P, P] eligibility via fancy-indexed gather, demand-split uplink
+    """The same sparse model, stepped by NumPy on the host — the
+    honest 'without the accelerator' comparison.  Mirrors the device
+    step op-for-op: [P, K] eligibility via fancy-indexed gather,
+    ``np.add.at`` scatter for holder load, demand-split uplink
     contention, urgency + budget failover, dual-EWMA ABR."""
     P, S, L = config.n_peers, config.n_segments, config.n_levels
     bitrates = np.array(BITRATES[:L], np.float32)
-    adj = np.asarray(ring_adjacency(P, 8), np.float32)
-    adj_t = adj.T.copy()
+    nbr = np.asarray(ring_neighbors(P, DEGREE))          # [P, K]
+    valid = nbr != np.arange(P)[:, None]
     cdn = np.full((P,), 8_000_000.0, np.float32)
     uplink = np.full((P,), config.p2p_bps, np.float32)
     join = np.asarray(join, np.float32)
@@ -95,7 +112,7 @@ def numpy_baseline_throughput(config, n_steps, join):
     playhead = np.zeros(P, np.float32); buf = np.zeros(P, np.float32)
     fast_e = np.zeros(P, np.float32); fast_w = np.zeros(P, np.float32)
     slow_e = np.zeros(P, np.float32); slow_w = np.zeros(P, np.float32)
-    avail = np.zeros((P, L * S), np.float32)
+    avail = np.zeros((P, L * S), np.uint8)
     dl_active = np.zeros(P, bool); dl_p2p = np.zeros(P, bool)
     dl_seg = np.zeros(P, np.int32); dl_level = np.zeros(P, np.int32)
     dl_done = np.zeros(P, np.float32); dl_total = np.zeros(P, np.float32)
@@ -118,19 +135,19 @@ def numpy_baseline_throughput(config, n_steps, join):
         nxt = np.minimum(((playhead + buf) / seg).astype(np.int32), S - 1)
         wants = (present & ~dl_active & ((playhead + buf) < S * seg)
                  & (buf < config.max_buffer_s))
-        # eligibility gather + contention (the [P, P] pipeline)
+        # sparse eligibility gather + contention (the [P, K] pipeline)
         gi = np.where(dl_active, dl_level, want) * S \
             + np.where(dl_active, dl_seg, nxt)
-        have_ji = avail[:, gi]                       # [j, i]
-        elig = adj_t * have_ji * present[:, None]
-        n_holders = elig.sum(axis=0)
-        have = n_holders > 0
+        have = avail[nbr, gi[:, None]]                   # [P, K]
+        elig = valid * have * present[nbr]
+        n_holders = elig.sum(axis=1)
+        have_n = n_holders > 0
         margin = nxt.astype(np.float32) * seg - playhead
         urgent = margin < config.urgent_margin_s
         budget = np.clip(margin * 1000.0 * config.p2p_budget_fraction,
                          config.p2p_budget_floor_ms,
                          config.p2p_budget_cap_ms)
-        start_p2p = wants & have & ~urgent
+        start_p2p = wants & have_n & ~urgent
         may = start_p2p | (wants & ~start_p2p)
         total_new = bitrates[want] * seg / 8.0
         dl_active |= may
@@ -143,10 +160,12 @@ def numpy_baseline_throughput(config, n_steps, join):
         dl_budget = np.where(may, budget, dl_budget)
         active_p2p = dl_active & dl_p2p
         demand = active_p2p / np.maximum(n_holders, 1.0)
-        share = elig * demand[None, :]
-        load = share.sum(axis=1)
+        contrib = elig * demand[:, None]
+        # bincount is NumPy's fastest segment-sum (4.5× np.add.at here)
+        load = np.bincount(nbr.ravel(), weights=contrib.ravel(),
+                           minlength=P).astype(np.float32)
         service = uplink / np.maximum(load, 1.0)
-        p2p_rate = np.minimum((share * service[:, None]).sum(axis=0),
+        p2p_rate = np.minimum(demand * (elig * service[nbr]).sum(axis=1),
                               config.p2p_bps)
         rate = np.where(dl_p2p, p2p_rate, cdn)
         prog = dl_active & present
@@ -158,7 +177,7 @@ def numpy_baseline_throughput(config, n_steps, join):
         dl_done = np.where(expired, 0.0, dl_done)
         dl_ms = np.where(expired, 0.0, dl_ms)
         np.maximum.at(avail, (pidx, dl_level * S + dl_seg),
-                      np.where(comp, 1.0, 0.0))
+                      comp.astype(np.uint8))
         ms = np.maximum(dl_ms, MIN_SAMPLE_DURATION_MS)
         bw = 8000.0 * dl_total / ms; w = ms / 1000.0
         for (e, tw, alpha) in ((fast_e, fast_w, alpha_f),
@@ -179,20 +198,22 @@ def numpy_baseline_throughput(config, n_steps, join):
 
 def main():
     P, S, T, repeats = scenario_sizes()
-    config = SwarmConfig(n_peers=P, n_segments=S, n_levels=3)
+    # circulant ring topology → the roll/stencil fast path (the
+    # flagship formulation; see ops/swarm_sim.py neighbor_offsets)
+    config = SwarmConfig(n_peers=P, n_segments=S, n_levels=3,
+                         neighbor_offsets=ring_offsets(DEGREE))
     bitrates = jnp.array(BITRATES)
-    adjacency = ring_adjacency(P, 8)
     cdn = jnp.full((P,), 8_000_000.0)
     join = staggered_joins(P, 60.0)
     state = init_swarm(config)
 
     # compile + warm up
-    final, _ = run_swarm(config, bitrates, adjacency, cdn, state, T, join)
+    final, _ = run_swarm(config, bitrates, None, cdn, state, T, join)
     materialize(final)
 
     start = time.perf_counter()
     for _ in range(repeats):
-        final, _ = run_swarm(config, bitrates, adjacency, cdn, state, T,
+        final, _ = run_swarm(config, bitrates, None, cdn, state, T,
                              join)
         materialize(final)
     elapsed = time.perf_counter() - start
@@ -201,13 +222,15 @@ def main():
 
     host_throughput = numpy_baseline_throughput(config, min(T, 20), join)
 
-    achieved_flops = steps_per_sec * step_flops(config)
-    achieved_hbm = steps_per_sec * step_hbm_bytes(config)
+    achieved_flops = steps_per_sec * step_flops(config, DEGREE)
+    achieved_hbm = steps_per_sec * step_hbm_bytes(config, DEGREE)
     peak_flops, peak_hbm = chip_peaks(jax.devices()[0])
     detail = {
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
-        "peers": P, "segments": S, "steps": T,
+        "peers": P, "segments": S, "steps": T, "degree": DEGREE,
+        "formulation": "circulant roll/stencil, O(P·K) (round 3)",
+        "host_model": "same sparse model, vectorized NumPy",
         "final_offload": round(float(offload_ratio(final)), 4),
         "host_peer_steps_per_sec": round(host_throughput, 1),
         "tflops": round(achieved_flops / 1e12, 4),

@@ -3,14 +3,19 @@ device-resident swarm simulator."""
 
 from .ewma import EwmaState, get_estimate, init_state, scan_samples, update
 from .swarm_sim import (SwarmConfig, SwarmScenario, SwarmState,
-                        full_adjacency, init_swarm, make_scenario,
-                        offload_ratio, rebuffer_ratio, ring_adjacency,
-                        run_swarm, stable_ranks, staggered_joins,
-                        step_flops, step_hbm_bytes, swarm_step)
+                        full_neighbors, full_offsets, init_swarm,
+                        invert_neighbors, isolated_neighbors,
+                        make_scenario, neighbors_from_adjacency,
+                        offload_ratio, rebuffer_ratio, ring_neighbors,
+                        ring_offsets, run_swarm, stable_ranks,
+                        staggered_joins, step_flops, step_hbm_bytes,
+                        swarm_step)
 
 __all__ = ["EwmaState", "get_estimate", "init_state", "scan_samples",
            "update", "SwarmConfig", "SwarmScenario", "SwarmState",
-           "full_adjacency", "init_swarm", "make_scenario",
-           "offload_ratio", "rebuffer_ratio", "ring_adjacency",
+           "full_neighbors", "full_offsets", "init_swarm",
+           "invert_neighbors", "isolated_neighbors", "make_scenario",
+           "neighbors_from_adjacency", "offload_ratio",
+           "rebuffer_ratio", "ring_neighbors", "ring_offsets",
            "run_swarm", "stable_ranks", "staggered_joins", "step_flops",
            "step_hbm_bytes", "swarm_step"]

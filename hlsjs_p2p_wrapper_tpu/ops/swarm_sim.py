@@ -2,10 +2,10 @@
 
 The discrete-event harness (``testing/swarm.py``) runs tens of peers
 with full protocol fidelity; this module trades per-frame fidelity for
-**scale**: thousands of peers stepped in parallel on the TPU, for
-design-space exploration (topology / policy / bitrate-ladder sweeps)
-and the repo's benchmark.  The reference has no counterpart — its
-answer to swarm questions was "open several browser tabs"
+**scale**: hundreds of thousands of peers stepped in parallel on the
+TPU, for design-space exploration (topology / policy / bitrate-ladder
+sweeps) and the repo's benchmark.  The reference has no counterpart —
+its answer to swarm questions was "open several browser tabs"
 (reference README.md:253).
 
 Model per peer: playhead, buffer, quality level, dual-EWMA bandwidth
@@ -16,21 +16,25 @@ Per step (``dt_ms``):
 1. idle present peers pick the next needed segment and an ABR level
    from the EWMA estimate (same highest-fitting-bitrate rule as
    ``core/abr.py:next_level``),
-2. **availability + uplink contention** run on one ``[P, P]``
-   eligibility matrix: ``elig[j, i] = adj[i, j] · avail[j, seg_i] ·
-   present[j]`` — built by gathering each peer's single segment of
-   interest out of the cache map.  (Round 1 computed the FULL
-   ``adj @ avail`` product, ``O(P²·L·S)`` MXU flops per step, then
-   read ONE ``(level, segment)`` entry per peer from it — 768× more
-   arithmetic than used at the default ladder.  The gather form does
-   exactly the needed column in ``O(P²)``; the step becomes
-   HBM-bandwidth-bound rather than FLOPs-bound, which is the honest
-   roofline for this access pattern, and throughput rises
-   accordingly.)  From the same matrix: a downloader splits demand
-   across its holders, a holder's uplink is shared across the demand
-   on it (the ``engine/transport.py:126-132`` uplink-serialization
-   model), and a P2P download's rate is its share-weighted service,
-   capped by the downlink,
+2. **availability + uplink contention** run on the sparse ``[P, K]``
+   neighbor lists: ``have[i, k] = avail[nbr[i, k], seg_i]`` — each
+   peer gathers its K neighbors' availability of its single segment
+   of interest.  (Round 1 computed the full ``adj @ avail`` product,
+   ``O(P²·L·S)``; round 2's gather-form ``[P, P]`` eligibility cut
+   that to ``O(P²)`` but still streamed two dense matrices through
+   HBM per step and needed ``O(P²)`` adjacency memory — 17 GB at 65k
+   peers.  Real overlays are degree-K sparse (the agent's mesh caps
+   its neighbor set, engine/mesh.py), so 99.8% of that matrix was
+   structurally zero at the default degree-8 ring.  The ``[P, K]``
+   form makes the step ``O(P·K)`` compute AND memory: gathers for
+   eligibility, one segment-sum scatter for holder load, and the
+   same demand-split service — bit-equivalent contention semantics
+   at 1/500th the traffic, which is what unlocks 100k+-peer sweeps.)
+   From the same eligibility: a downloader splits demand across its
+   holders, a holder's uplink is shared across the demand on it (the
+   ``engine/transport.py:126-132`` uplink-serialization model), and a
+   P2P download's rate is its share-weighted service, capped by the
+   downlink,
 3. downloads progress; P2P downloads whose holders all departed flip
    to the CDN (the aggregate analogue of the agent's multi-holder →
    CDN failover); completions update cache, buffer, estimator, and
@@ -47,11 +51,16 @@ peers depart at ``leave_s``; departed peers stop downloading,
 serving, and playing, but their transferred bytes stay in the totals
 (same accounting as the harness).
 
+Scheduler-policy knobs (urgency margin, P2P time budget, live-edge
+spread) are **dynamic scenario fields**, not compile-time constants:
+they only feed ``jnp`` arithmetic, so a whole policy grid reuses ONE
+compiled program (``tools/sweep.py`` sweeps them recompile-free).
+
 Everything is ``lax.scan``-stepped, statically shaped, and
 ``shard_map``/pjit-shardable over the peer axis (see ``parallel/``):
-per-peer state shards cleanly; the eligibility gather contracts the
-peer axis, so under a sharded mesh XLA lowers it to the simulator's
-only collective.
+per-peer state shards cleanly; the neighbor gathers and the holder
+load scatter-add reference global peer indices, so under a sharded
+mesh XLA lowers them to the simulator's only collectives.
 """
 
 from __future__ import annotations
@@ -61,6 +70,7 @@ from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.abr import (DEFAULT_FAST_HALF_LIFE_S, DEFAULT_SLOW_HALF_LIFE_S,
                         MIN_SAMPLE_DURATION_MS)
@@ -70,14 +80,31 @@ BANDWIDTH_SAFETY = 0.8  # core/abr.py AbrController.BANDWIDTH_SAFETY
 
 NEVER_S = 1e18  # "leave" time of a peer that never departs
 
+#: ladder pad value for one-compile multi-ladder sweeps: a level that
+#: never fits under any estimate is never chosen by the ABR rule
+UNREACHABLE_BITRATE = 1e18
+
 
 class SwarmConfig(NamedTuple):
     """Static scenario description (python floats/ints: hashable, so
-    jit treats it as compile-time constant)."""
+    jit treats it as compile-time constant).  The scheduler-policy
+    values here are DEFAULTS that :func:`make_scenario` copies into
+    dynamic scenario fields — override them per-run (recompile-free)
+    via the ``make_scenario``/``run_swarm`` keyword arguments."""
 
     n_peers: int
     n_segments: int
     n_levels: int
+    #: circulant fast path: peer i's neighbors are (i + off) % P for
+    #: each offset (0 = padding/no edge).  When set, every cross-peer
+    #: op compiles to static rolls (stencils) + one-hot contractions —
+    #: no gathers/scatters, which run ~50× slower on TPU (measured
+    #: 0.08 vs 3.7 ms/step at 65k peers, tools/profile_kernels.py);
+    #: under a sharded peer axis the rolls lower to ICI
+    #: collective-permute (halo exchange).  When None, the general
+    #: ``scenario.neighbors`` [P, K] gather path is used (arbitrary
+    #: topologies; slower, fine for small swarms).
+    neighbor_offsets: Optional[Tuple[int, ...]] = None
     seg_duration_s: float = 4.0
     dt_ms: float = 250.0
     max_buffer_s: float = 30.0
@@ -97,26 +124,73 @@ class SwarmConfig(NamedTuple):
 
 
 class SwarmScenario(NamedTuple):
-    """Per-peer scenario arrays (all ``[P]`` except as noted)."""
+    """Per-peer scenario arrays (``[P]`` except as noted) plus the
+    dynamic policy scalars (``[]`` f32, swept recompile-free)."""
 
     bitrates: jax.Array      # [L] bits/s ladder
-    adjacency: jax.Array     # [P, P] 0/1; row i = whom i downloads from
+    neighbors: jax.Array     # [P, K] i32; row i = whom i downloads from
+    #                          (self-index entries are padding = no edge)
+    in_edges: jax.Array      # [P, K_in] i32; row j = flat (i·K + k)
+    #                          indices of the outbound slots that point
+    #                          AT j (-1 = padding).  The precomputed
+    #                          inverse of ``neighbors``: holder load is
+    #                          a gather over these instead of a
+    #                          scatter-add over ``neighbors`` — TPU
+    #                          scatters with duplicate indices
+    #                          serialize (measured 4.6 ms/step at 65k
+    #                          peers); the equivalent gather runs at
+    #                          full vector throughput.
     cdn_bps: jax.Array       # [P] per-peer CDN rate
     uplink_bps: jax.Array    # [P] per-peer serving capacity
     join_s: jax.Array        # [P] arrival time
     leave_s: jax.Array       # [P] departure time (NEVER_S = stays)
     edge_rank: jax.Array     # [P] in [0,1): live CDN stagger rank
+    urgent_margin_s: jax.Array      # [] scheduler urgency threshold
+    p2p_budget_fraction: jax.Array  # [] budget = margin × fraction
+    p2p_budget_cap_ms: jax.Array    # [] budget ceiling
+    p2p_budget_floor_ms: jax.Array  # [] budget floor
+    live_spread_s: jax.Array        # [] live-edge CDN stagger window
 
 
-def make_scenario(config: SwarmConfig, bitrates, adjacency, cdn_bps,
+def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
                   join_s=None, *, uplink_bps=None, leave_s=None,
-                  edge_rank=None) -> SwarmScenario:
-    """Normalize optional arrays to their defaults: everyone joins at
-    t=0, never leaves, serves at the downlink cap, rank 0."""
+                  edge_rank=None, urgent_margin_s=None,
+                  p2p_budget_fraction=None, p2p_budget_cap_ms=None,
+                  p2p_budget_floor_ms=None,
+                  live_spread_s=None) -> SwarmScenario:
+    """Normalize optional arrays to their defaults (everyone joins at
+    t=0, never leaves, serves at the downlink cap, rank 0) and policy
+    scalars to the config's values.  Also precomputes the inbound
+    edge lists (the ``neighbors`` inverse) on the host — see
+    :func:`invert_neighbors`.  With ``config.neighbor_offsets`` set
+    (circulant fast path), ``neighbors`` may be None: topology lives
+    in the static config and the scenario carries empty
+    placeholders."""
     P = config.n_peers
+
+    def scalar(value, default):
+        return jnp.asarray(default if value is None else value, jnp.float32)
+
+    if neighbors is None:
+        if config.neighbor_offsets is None:
+            raise ValueError("neighbors=None requires "
+                             "config.neighbor_offsets (circulant mode)")
+        neighbors = jnp.zeros((P, 0), jnp.int32)
+        in_edges = jnp.zeros((P, 0), jnp.int32)
+    elif config.neighbor_offsets is not None:
+        # refuse the ambiguous case: with offsets set the step takes
+        # the circulant path and would silently ignore the array
+        raise ValueError(
+            "both config.neighbor_offsets and a neighbors array were "
+            "given; pass neighbors=None for circulant mode, or unset "
+            "neighbor_offsets to use the [P, K] topology")
+    else:
+        in_edges = invert_neighbors(neighbors)
+
     return SwarmScenario(
         bitrates=jnp.asarray(bitrates, jnp.float32),
-        adjacency=jnp.asarray(adjacency, jnp.float32),
+        neighbors=jnp.asarray(neighbors, jnp.int32),
+        in_edges=in_edges,
         cdn_bps=jnp.asarray(cdn_bps, jnp.float32),
         uplink_bps=(jnp.asarray(uplink_bps, jnp.float32)
                     if uplink_bps is not None
@@ -127,7 +201,15 @@ def make_scenario(config: SwarmConfig, bitrates, adjacency, cdn_bps,
                  else jnp.full((P,), NEVER_S, jnp.float32)),
         edge_rank=(jnp.asarray(edge_rank, jnp.float32)
                    if edge_rank is not None
-                   else jnp.zeros((P,), jnp.float32)))
+                   else jnp.zeros((P,), jnp.float32)),
+        urgent_margin_s=scalar(urgent_margin_s, config.urgent_margin_s),
+        p2p_budget_fraction=scalar(p2p_budget_fraction,
+                                   config.p2p_budget_fraction),
+        p2p_budget_cap_ms=scalar(p2p_budget_cap_ms,
+                                 config.p2p_budget_cap_ms),
+        p2p_budget_floor_ms=scalar(p2p_budget_floor_ms,
+                                   config.p2p_budget_floor_ms),
+        live_spread_s=scalar(live_spread_s, config.live_spread_s))
 
 
 class SwarmState(NamedTuple):
@@ -140,7 +222,7 @@ class SwarmState(NamedTuple):
     rebuffer_s: jax.Array      # [P] f32
     level: jax.Array           # [P] i32 current ABR choice
     ewma: EwmaState            # fields [P] f32
-    avail: jax.Array           # [P, L, S] f32 0/1 cache map
+    avail: jax.Array           # [P, L, S] u8 0/1 cache map
     cdn_bytes: jax.Array       # [P] f32
     p2p_bytes: jax.Array       # [P] f32
     dl_active: jax.Array       # [P] bool
@@ -161,7 +243,7 @@ def init_swarm(config: SwarmConfig) -> SwarmState:
     return SwarmState(
         t_s=jnp.zeros((), jnp.float32),
         playhead_s=f0, buffer_s=f0, rebuffer_s=f0, level=i0,
-        ewma=init_state(P), avail=jnp.zeros((P, L, S), jnp.float32),
+        ewma=init_state(P), avail=jnp.zeros((P, L, S), jnp.uint8),
         cdn_bytes=f0, p2p_bytes=f0, dl_active=b0, dl_is_p2p=b0,
         dl_seg=i0, dl_level=i0, dl_done_bytes=f0, dl_total_bytes=f0,
         dl_elapsed_ms=f0, dl_budget_ms=f0)
@@ -180,7 +262,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     """One ``dt_ms`` tick for every peer at once."""
     dt_s = config.dt_ms / 1000.0
     seg = config.seg_duration_s
-    S = config.n_segments
+    P, S = config.n_peers, config.n_segments
     end_s = S * seg
     t = state.t_s
     present = (t >= scenario.join_s) & (t < scenario.leave_s)  # [P]
@@ -207,23 +289,44 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         # only fully published segments are downloadable
         wants = wants & ((next_seg.astype(jnp.float32) + 1.0) * seg <= t)
 
-    # ---- 2. eligibility: one [P, P] gather instead of the full ------
-    # adj @ avail product.  Column i of `have` is every peer j's
-    # availability of peer i's single segment of interest — the
-    # in-flight (level, seg) for active downloads (contention), the
-    # wanted (level, seg) for idle peers (start decision).
+    # ---- 2. eligibility ---------------------------------------------
+    # have[i, k] = neighbor k's availability of peer i's single
+    # segment of interest — the in-flight (level, seg) for active
+    # downloads (contention), the wanted (level, seg) for idle peers
+    # (start decision).
     gi_level = jnp.where(state.dl_active, state.dl_level, want_level)
     gi_seg = jnp.where(state.dl_active, state.dl_seg, next_seg)
-    flat_idx = gi_level * S + gi_seg                         # [P] over i
-    # bf16 for the [P, P] arrays: every element is exactly 0 or 1, and
-    # all reductions accumulate in f32, so the halved HBM traffic is
-    # numerically free
-    avail_flat = state.avail.reshape(
-        config.n_peers, config.n_levels * S).astype(jnp.bfloat16)
-    have_ji = jnp.take(avail_flat, flat_idx, axis=1)         # [j, i]
-    elig_ji = (scenario.adjacency.T.astype(jnp.bfloat16) * have_ji
-               * present.astype(jnp.bfloat16)[:, None])      # [j, i]
-    n_holders = jnp.sum(elig_ji, axis=0, dtype=jnp.float32)  # [i]
+    flat_idx = gi_level * S + gi_seg                         # [P]
+    avail_flat = state.avail.reshape(P, config.n_levels * S)
+    circulant = config.neighbor_offsets is not None
+    W = None
+    if circulant:
+        # circulant fast path: neighbor k of peer i is (i + off_k) %
+        # P, so "what does my k-th neighbor have" is a static ROW
+        # SHIFT of the (availability · presence) map, contracted
+        # against the one-hot of each peer's segment of interest —
+        # K stencil passes, zero gathers (see neighbor_offsets doc)
+        offs = _normalized_offsets(config.neighbor_offsets, P)
+        col = jnp.arange(config.n_levels * S, dtype=flat_idx.dtype)
+        W = (col[None, :] == flat_idx[:, None]).astype(jnp.uint8)
+        AP = avail_flat * present.astype(jnp.uint8)[:, None]  # [P, C]
+        elig_list = [jnp.sum(jnp.roll(AP, -o, axis=0) * W, axis=1,
+                             dtype=jnp.int32).astype(jnp.float32)
+                     for o in offs]                          # K × [P]
+        n_holders = (sum(elig_list) if elig_list
+                     else jnp.zeros((P,), jnp.float32))
+    else:
+        # general [P, K] neighbor-list path (arbitrary topologies):
+        # XLA gathers — correct everywhere, ~50× slower per edge on
+        # TPU, fine for small swarms and tests.  Self-index entries
+        # are padding (a peer never downloads from itself).
+        nbr = scenario.neighbors                             # [P, K]
+        peer_idx = jnp.arange(P, dtype=nbr.dtype)
+        valid = (nbr != peer_idx[:, None]).astype(jnp.float32)
+        have_ik = avail_flat[nbr, flat_idx[:, None]]         # [P, K] u8
+        elig_ik = (valid * have_ik.astype(jnp.float32)
+                   * present.astype(jnp.float32)[nbr])       # [P, K]
+        n_holders = jnp.sum(elig_ik, axis=1)                 # [P]
     have_neighbors = n_holders > 0.0
 
     # ---- start decisions (engine/scheduler.py decide()) -------------
@@ -233,23 +336,31 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     # and P2P attempts get a bounded time budget before conceding to
     # the CDN
     margin_s = next_seg.astype(jnp.float32) * seg - playhead
-    urgent = margin_s < config.urgent_margin_s
-    budget_ms = jnp.clip(margin_s * 1000.0 * config.p2p_budget_fraction,
-                         config.p2p_budget_floor_ms,
-                         config.p2p_budget_cap_ms)
-    if config.live and config.live_spread_s > 0.0:
+    urgent = margin_s < scenario.urgent_margin_s
+    budget_ms = jnp.clip(margin_s * 1000.0 * scenario.p2p_budget_fraction,
+                         scenario.p2p_budget_floor_ms,
+                         scenario.p2p_budget_cap_ms)
+    if config.live:
         # live-edge stagger: with no holder yet, only low-rank peers
         # hit the CDN now; the rest wait their stable fraction of the
-        # spread and usually catch the seeders' announcements instead
+        # spread and usually catch the seeders' announcements instead.
+        # (At spread 0 this is `t >= publish_t`, which `wants` already
+        # guarantees for idle peers — i.e. no stagger.)
         publish_t = (gi_seg.astype(jnp.float32) + 1.0) * seg
-        cdn_allowed = t >= publish_t + scenario.edge_rank * config.live_spread_s
+        cdn_allowed = (t >= publish_t
+                       + scenario.edge_rank * scenario.live_spread_s)
     else:
         cdn_allowed = jnp.ones_like(have_neighbors)
     start_p2p = wants & have_neighbors & ~urgent
     start_cdn = wants & ~start_p2p & (cdn_allowed | urgent)
     may_start = start_p2p | start_cdn
 
-    new_total = scenario.bitrates[want_level] * seg / 8.0
+    # one-hot contraction instead of bitrates[want_level]: even a
+    # gather from a 3-element table pays TPU's per-element gather cost
+    lvl_iota = jnp.arange(config.n_levels, dtype=want_level.dtype)
+    new_total = jnp.sum(
+        jnp.where(want_level[:, None] == lvl_iota[None, :],
+                  scenario.bitrates[None, :], 0.0), axis=1) * (seg / 8.0)
     dl_active = state.dl_active | may_start
     dl_is_p2p = jnp.where(may_start, start_p2p, state.dl_is_p2p)
     # a P2P download whose holders all departed flips to the CDN — the
@@ -267,22 +378,36 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     # each active P2P downloader splits unit demand across its
     # holders; a holder's uplink is shared across the demand on it
     # (engine/transport.py:126-132); a downloader's rate is its
-    # share-weighted service, capped by the downlink.  The share
-    # matrix ``elig · demand`` never materializes: its row-sum is the
-    # matvec ``elig @ demand`` and its service-weighted column-sum is
-    # ``demand · (service @ elig)`` — two MXU matvecs instead of two
-    # more [P, P] arrays through HBM.
+    # share-weighted service, capped by the downlink.
     active_p2p = dl_active & dl_is_p2p
     demand_i = active_p2p.astype(jnp.float32) / jnp.maximum(n_holders, 1.0)
-    load_j = jnp.einsum("ji,i->j", elig_ji,
-                        demand_i.astype(jnp.bfloat16),
-                        preferred_element_type=jnp.float32)  # [j]
-    service_j = scenario.uplink_bps / jnp.maximum(load_j, 1.0)
-    p2p_rate = jnp.minimum(
-        demand_i * jnp.einsum("j,ji->i", service_j.astype(jnp.bfloat16),
-                              elig_ji,
-                              preferred_element_type=jnp.float32),
-        config.p2p_bps)                                      # [i]
+    if circulant:
+        # holder load: the edge (i → i+off) contributes at row i of
+        # contrib_k, so the per-holder sum is the INVERSE shift;
+        # service readback is the forward shift — all [P] rolls
+        contrib_list = [e * demand_i for e in elig_list]
+        load_j = (sum(jnp.roll(c, o) for c, o in zip(contrib_list, offs))
+                  if offs else jnp.zeros((P,), jnp.float32))
+        service_j = scenario.uplink_bps / jnp.maximum(load_j, 1.0)
+        svc_sum = (sum(e * jnp.roll(service_j, -o)
+                       for e, o in zip(elig_list, offs))
+                   if offs else jnp.zeros((P,), jnp.float32))
+    else:
+        # general path: holder load sums each holder's INBOUND edge
+        # contributions via the precomputed inverse edge lists — a
+        # gather, because the equivalent scatter-add serializes on
+        # TPU (see in_edges docs); service readback is one more
+        # gather — O(P·K) total, the sparse equivalent of round 2's
+        # dense [P, P] matvec pair.
+        contrib_flat = (elig_ik * demand_i[:, None]).reshape(-1)  # [P·K]
+        in_e = scenario.in_edges                                  # [P, K_in]
+        load_j = jnp.sum(jnp.where(in_e >= 0,
+                                   contrib_flat[jnp.maximum(in_e, 0)],
+                                   0.0),
+                         axis=1)                                  # [P]
+        service_j = scenario.uplink_bps / jnp.maximum(load_j, 1.0)
+        svc_sum = jnp.sum(elig_ik * service_j[nbr], axis=1)
+    p2p_rate = jnp.minimum(demand_i * svc_sum, config.p2p_bps)   # [P]
     rate_bps = jnp.where(dl_is_p2p, p2p_rate, scenario.cdn_bps)
     progressing = dl_active & present
     dl_done = dl_done + jnp.where(progressing, rate_bps * dt_s / 8.0, 0.0)
@@ -299,10 +424,19 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     dl_done = jnp.where(p2p_expired, 0.0, dl_done)
     dl_elapsed = jnp.where(p2p_expired, 0.0, dl_elapsed)
 
-    # cache insert (scatter of 1s at completed (peer, level, seg))
-    peer_idx = jnp.arange(config.n_peers)
-    avail = state.avail.at[peer_idx, dl_level, dl_seg].max(
-        jnp.where(completed, 1.0, 0.0))
+    # cache insert: one-hot row max instead of a scatter — touches the
+    # whole [P, L·S] map (2 bytes/element r/w) but runs at vector
+    # throughput; TPU scatter serializes its updates (measured ~2×
+    # slower, and the dense pass fuses with the eligibility stencil).
+    # A peer can only complete the download it was gathered on, so
+    # the one-hot of flat_idx IS the insert position (the circulant
+    # path reuses its eligibility one-hot for free).
+    if W is None:
+        col = jnp.arange(config.n_levels * S, dtype=flat_idx.dtype)
+        W = (col[None, :] == flat_idx[:, None]).astype(jnp.uint8)
+    avail = jnp.maximum(avail_flat,
+                        W * completed.astype(jnp.uint8)[:, None]).reshape(
+        state.avail.shape)
 
     # estimator feeds on real (duration, bytes) pairs, same numerics
     # the player's ABR contract pins (tests/test_abr_contract.py)
@@ -356,20 +490,29 @@ def _run_swarm(config: SwarmConfig, scenario: SwarmScenario,
 
 
 def run_swarm(config: SwarmConfig, bitrates: jax.Array,
-              adjacency: jax.Array, cdn_bps: jax.Array,
+              neighbors: Optional[jax.Array], cdn_bps: jax.Array,
               state: SwarmState, n_steps: int,
               join_s: Optional[jax.Array] = None, *,
               uplink_bps: Optional[jax.Array] = None,
               leave_s: Optional[jax.Array] = None,
               edge_rank: Optional[jax.Array] = None,
+              urgent_margin_s=None, p2p_budget_fraction=None,
+              p2p_budget_cap_ms=None, p2p_budget_floor_ms=None,
+              live_spread_s=None,
               ) -> Tuple[SwarmState, jax.Array]:
     """Scan ``n_steps`` ticks; returns (final state, offload-over-time
-    ``[n_steps]``).  One compiled program regardless of T.  Optional
-    arrays default to: everyone at t=0, forever, serving at the
-    downlink cap, rank 0 (see :func:`make_scenario`)."""
-    scenario = make_scenario(config, bitrates, adjacency, cdn_bps, join_s,
-                             uplink_bps=uplink_bps, leave_s=leave_s,
-                             edge_rank=edge_rank)
+    ``[n_steps]``).  One compiled program regardless of T — and of any
+    policy-knob keyword, all of which are dynamic scenario fields.
+    Optional arrays default to: everyone at t=0, forever, serving at
+    the downlink cap, rank 0 (see :func:`make_scenario`)."""
+    scenario = make_scenario(
+        config, bitrates, neighbors, cdn_bps, join_s,
+        uplink_bps=uplink_bps, leave_s=leave_s, edge_rank=edge_rank,
+        urgent_margin_s=urgent_margin_s,
+        p2p_budget_fraction=p2p_budget_fraction,
+        p2p_budget_cap_ms=p2p_budget_cap_ms,
+        p2p_budget_floor_ms=p2p_budget_floor_ms,
+        live_spread_s=live_spread_s)
     return _run_swarm(config, scenario, state, n_steps)
 
 
@@ -380,36 +523,109 @@ def offload_ratio(state: SwarmState) -> jax.Array:
 
 
 def rebuffer_ratio(state: SwarmState, elapsed_s: float,
-                   join_s: jax.Array = None) -> jax.Array:
-    """Stall time over per-peer WATCH time (present time, not scenario
-    time) — same denominator contract as the discrete harness
-    (testing/swarm.py), so late joiners' stalls aren't diluted."""
-    if join_s is None:
+                   join_s: jax.Array = None,
+                   leave_s: jax.Array = None) -> jax.Array:
+    """Stall time over per-peer WATCH time — present time, not
+    scenario time, on BOTH ends: late joiners' stalls aren't diluted
+    by time before they arrived, and early leavers stop accruing
+    watch time at departure (their rebuffer froze there too) — same
+    denominator contract as the discrete harness (testing/swarm.py)."""
+    if join_s is None and leave_s is None:
         watched = state.rebuffer_s.shape[0] * elapsed_s
     else:
-        watched = jnp.sum(jnp.clip(elapsed_s - join_s, 0.0))
+        P = state.rebuffer_s.shape[0]
+        join_s = (jnp.zeros((P,), jnp.float32) if join_s is None
+                  else jnp.asarray(join_s, jnp.float32))
+        end = (jnp.full((P,), elapsed_s, jnp.float32) if leave_s is None
+               else jnp.minimum(jnp.asarray(leave_s, jnp.float32),
+                                elapsed_s))
+        watched = jnp.sum(jnp.clip(end - join_s, 0.0))
     return jnp.sum(state.rebuffer_s) / jnp.maximum(watched, 1e-9)
 
 
-def step_flops(config: SwarmConfig) -> float:
-    """Analytic arithmetic per step, dominated by the ``[P, P]``
-    eligibility/contention pipeline (gather + 2 muls + mask + 2
-    reductions + share/service ≈ 7 ops per (j, i) pair) plus the
-    O(P·L·S) cache-map update.  Used by bench.py for achieved-FLOPs /
-    utilization reporting."""
+def step_flops(config: SwarmConfig, n_neighbors: int = 8) -> float:
+    """Analytic arithmetic per step: the ``[P, K]`` eligibility +
+    contention pipeline (~7 ops per (i, k) edge: validity mask, two
+    eligibility muls, holder-count add, load contribution mul+add,
+    service mul+add), the cache map's one-hot insert (compare + max
+    per (peer, level, segment) cell), ~60 per-peer elementwise state
+    ops, and the O(P·L) ABR fit.  Used by bench.py for achieved-FLOPs
+    reporting — honestly tiny relative to the MXU peak: the sparse
+    step is memory-bound, not FLOPs-bound.  On the circulant fast
+    path the eligibility term is the K stencil passes' multiply-add
+    over the [P, L·S] map (2·P·L·S·K) rather than 7·P·K."""
     P, L, S = config.n_peers, config.n_levels, config.n_segments
-    return 7.0 * P * P + 4.0 * P * L * S
+    K = n_neighbors
+    if config.neighbor_offsets is not None:
+        K = len(_normalized_offsets(config.neighbor_offsets, P))
+        elig = 2.0 * P * L * S * K
+    else:
+        elig = 7.0 * P * K
+    return elig + 2.0 * P * L * S + 60.0 * P + 2.0 * P * L
 
 
-def step_hbm_bytes(config: SwarmConfig) -> float:
-    """Analytic main-memory traffic per step: the bf16 [P, P] arrays
-    (adjacency read; gathered availability written + read; eligibility
-    written + read three times by the reductions) plus the f32
-    [P, L, S] cache-map traffic (bf16 cast + scatter).  The step is
-    bandwidth-bound, so THIS is the roofline that bounds
-    peer-steps/s."""
+def step_hbm_bytes(config: SwarmConfig, n_neighbors: int = 8) -> float:
+    """Analytic main-memory traffic per step.
+
+    Circulant fast path (``neighbor_offsets`` set): each of the K
+    eligibility stencil passes streams the u8 (availability·presence)
+    map and the u8 one-hot (1 byte/element each over [P, L·S]), and
+    the cache insert reads + rewrites the map — 2·P·L·S·(K + 1) total,
+    deliberately traded for TPU-friendliness over per-element
+    gather/scatter (which measure ~50× slower per edge,
+    tools/profile_kernels.py).  General path: the O(P·K) edge
+    gathers dominate instead.  Both add per-peer state (17 f32/i32
+    [P] fields + 4 EWMA leaves, read and written each step as the
+    scan carry) and scenario reads.
+
+    This model counts only algorithmically-required traffic (perfect
+    fusion); fusion-boundary spills make the REAL traffic higher, so
+    the reported ``hbm_util`` is a lower bound on how hard the
+    memory system is actually working."""
     P, L, S = config.n_peers, config.n_levels, config.n_segments
-    return 2.0 * 7.0 * P * P + 8.0 * P * L * S
+    state_rw = 2.0 * 21.0 * 4.0 * P
+    scenario_reads = 5.0 * 4.0 * P
+    cache_onehot = 2.0 * P * L * S          # u8 map read + rewritten
+    if config.neighbor_offsets is not None:
+        K = len(_normalized_offsets(config.neighbor_offsets, P))
+        elig = 2.0 * P * L * S * K          # K × (AP + one-hot) u8
+        edges = 0.0
+    else:
+        K = n_neighbors
+        elig = 1.0 * P * K                  # u8 availability gather
+        edges = 2.0 * 4.0 * P * K + 3.0 * 4.0 * P * K
+    return cache_onehot + elig + edges + state_rw + scenario_reads
+
+
+def invert_neighbors(neighbors) -> jnp.ndarray:
+    """Host-side inverse of a ``[P, K]`` neighbor matrix: row j lists
+    the flat outbound-slot indices ``i·K + k`` with ``nbr[i, k] == j``
+    (and ``i ≠ j``), padded with -1 to ``K_in = max(max in-degree,
+    K)``.  Padding to at least K keeps the shape stable across
+    same-``k_pad`` sweep topologies, so varying ring degree under a
+    common pad does not recompile.
+
+    Why this exists: holder load is a segment-sum over edges.  As a
+    ``.at[nbr].add`` scatter it serializes on TPU (duplicate indices);
+    gathering each holder's inbound contributions instead runs at
+    vector throughput.  The inverse is computed once per scenario on
+    the host (O(P·K log P·K) numpy) and amortized over every step."""
+    nbr = np.asarray(neighbors)
+    P, K = nbr.shape
+    src = np.repeat(np.arange(P), K)
+    dst = nbr.reshape(-1)
+    real = dst != src
+    dst_r = dst[real]
+    flat_r = np.flatnonzero(real)
+    order = np.argsort(dst_r, kind="stable")
+    dst_s, flat_s = dst_r[order], flat_r[order]
+    counts = np.bincount(dst_s, minlength=P)
+    k_in = max(int(counts.max(initial=0)), K)
+    in_edges = np.full((P, k_in), -1, np.int64)
+    group_start = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(len(dst_s)) - group_start[dst_s]
+    in_edges[dst_s, pos] = flat_s
+    return jnp.asarray(in_edges, jnp.int32)
 
 
 def staggered_joins(n_peers: int, window_s: float = 60.0,
@@ -430,23 +646,108 @@ def stable_ranks(n_peers: int, seed: int = 0) -> jnp.ndarray:
     return jax.random.uniform(jax.random.PRNGKey(seed), (n_peers,))
 
 
-def ring_adjacency(n_peers: int, degree: int = 8) -> jnp.ndarray:
-    """Deterministic symmetric ring (each peer sees ``degree//2``
-    neighbors in each direction) — the default sweep topology.
-    Symmetry matters: with staggered joins, a peer's useful sources
-    are mostly EARLIER arrivals, whose caches are ahead of its
-    playhead."""
-    idx = jnp.arange(n_peers)
+def _normalized_offsets(offsets: Tuple[int, ...], n_peers: int) -> list:
+    """Drop padding (0 mod P) and duplicates (mod P) from a circulant
+    offset tuple, preserving order — matches the dense adjacency's
+    set-of-edges semantics for tiny swarms where offsets wrap."""
+    seen = set()
+    out = []
+    for off in offsets:
+        r = off % n_peers
+        if r == 0 or r in seen:
+            continue
+        seen.add(r)
+        out.append(off)
+    return out
+
+
+def ring_offsets(degree: int = 8,
+                 k_pad: Optional[int] = None) -> Tuple[int, ...]:
+    """Circulant offsets for the symmetric degree-``degree`` ring
+    (``degree//2`` neighbors in each direction) — the
+    :func:`ring_neighbors` topology in static-offset form for the
+    roll/stencil fast path.  ``k_pad`` pads with 0 (= no edge) so
+    sweeps over degree share a config SHAPE; note the offsets are
+    compile-time constants, so each distinct tuple still compiles
+    once (padding exists for symmetry with ``ring_neighbors``)."""
     half = max(degree // 2, 1)
-    offsets = jnp.concatenate([jnp.arange(1, half + 1),
-                               -jnp.arange(1, half + 1)])
-    neighbors = (idx[:, None] + offsets[None, :]) % n_peers
-    adj = jnp.zeros((n_peers, n_peers), jnp.float32)
-    return adj.at[idx[:, None], neighbors].set(1.0)
+    offs = tuple(range(1, half + 1)) + tuple(-o for o in range(1, half + 1))
+    if k_pad is not None and k_pad > len(offs):
+        offs = offs + (0,) * (k_pad - len(offs))
+    return offs
 
 
-def full_adjacency(n_peers: int) -> jnp.ndarray:
-    """Everyone sees everyone (minus self) — the small-swarm topology
-    the tracker-based harness produces, for parity tests."""
-    return (jnp.ones((n_peers, n_peers), jnp.float32)
-            - jnp.eye(n_peers, dtype=jnp.float32))
+def full_offsets(n_peers: int) -> Tuple[int, ...]:
+    """Everyone-sees-everyone as circulant offsets 1..P-1 — the
+    tracker topology (:func:`full_neighbors`) in static-offset form."""
+    return tuple(range(1, n_peers))
+
+
+def _pad_neighbors(nbr: np.ndarray, n_peers: int,
+                   k_pad: Optional[int]) -> jnp.ndarray:
+    """Pad a [P, K] neighbor matrix to ``k_pad`` columns with
+    self-indices (= no edge); lets sweeps treat topology degree as
+    data under ONE compiled shape."""
+    if k_pad is not None:
+        if k_pad < nbr.shape[1]:
+            raise ValueError(f"k_pad={k_pad} < degree {nbr.shape[1]}")
+        pad = np.repeat(np.arange(n_peers)[:, None],
+                        k_pad - nbr.shape[1], axis=1)
+        nbr = np.concatenate([nbr, pad], axis=1)
+    return jnp.asarray(nbr, jnp.int32)
+
+
+def ring_neighbors(n_peers: int, degree: int = 8,
+                   k_pad: Optional[int] = None) -> jnp.ndarray:
+    """Deterministic symmetric ring neighbor lists ``[P, degree]``
+    (each peer sees ``degree//2`` neighbors in each direction) — the
+    default sweep topology.  Symmetry matters: with staggered joins, a
+    peer's useful sources are mostly EARLIER arrivals, whose caches
+    are ahead of its playhead.  Duplicate offsets (degree ≥ P) and
+    self-hits collapse to self-padding, matching the dense form's
+    set-semantics."""
+    half = max(degree // 2, 1)
+    offsets = np.concatenate([np.arange(1, half + 1),
+                              -np.arange(1, half + 1)])
+    idx = np.arange(n_peers)
+    nbr = (idx[:, None] + offsets[None, :]) % n_peers
+    dup = np.zeros_like(nbr, dtype=bool)
+    for a in range(nbr.shape[1]):
+        for b in range(a):
+            dup[:, a] |= nbr[:, a] == nbr[:, b]
+    nbr = np.where(dup, idx[:, None], nbr)
+    return _pad_neighbors(nbr, n_peers, k_pad)
+
+
+def full_neighbors(n_peers: int,
+                   k_pad: Optional[int] = None) -> jnp.ndarray:
+    """Everyone sees everyone (minus self) as ``[P, P-1]`` neighbor
+    lists — the small-swarm topology the tracker-based harness
+    produces, for parity tests."""
+    idx = np.arange(n_peers)
+    nbr = (idx[:, None] + np.arange(1, n_peers)[None, :]) % n_peers
+    return _pad_neighbors(nbr, n_peers, k_pad)
+
+
+def isolated_neighbors(n_peers: int, k: int = 1) -> jnp.ndarray:
+    """No edges at all (every entry is self-padding): the all-CDN
+    control topology."""
+    return jnp.asarray(np.repeat(np.arange(n_peers)[:, None], k, axis=1),
+                       jnp.int32)
+
+
+def neighbors_from_adjacency(adjacency,
+                             k_pad: Optional[int] = None) -> jnp.ndarray:
+    """Convert a dense 0/1 ``[P, P]`` adjacency (row i = whom i
+    downloads from) into padded ``[P, K]`` neighbor lists, K = max row
+    degree (or ``k_pad``).  Host-side helper for tests and for
+    migrating round-2 scenario definitions."""
+    adj = np.asarray(adjacency) > 0
+    n_peers = adj.shape[0]
+    np.fill_diagonal(adj, False)  # self-edges are meaningless
+    degree = max(int(adj.sum(axis=1).max()), 1)
+    nbr = np.repeat(np.arange(n_peers)[:, None], degree, axis=1)
+    for i in range(n_peers):
+        cols = np.flatnonzero(adj[i])
+        nbr[i, :len(cols)] = cols
+    return _pad_neighbors(nbr, n_peers, k_pad)

@@ -4,13 +4,15 @@ Scaling model ("How to Scale Your Model" recipe): pick a mesh,
 annotate shardings, let XLA insert the collectives.  The simulator's
 natural data axis is **peers** — every per-peer field shards over it
 ("dp"-style), and the cache map's segment axis can shard over a second
-**segments** axis ("sp"-style) for very long timelines.  The one
-cross-peer op, the eligibility gather ``avail[j, seg_i]`` and its
-contention reductions over ``j``, contracts the full peer axis: under
-a sharded ``j``, XLA lowers it to gather/reduce collectives over ICI —
-the simulator's only cross-device traffic, riding the fast fabric by
-construction.
-"""
+**segments** axis ("sp"-style) for very long timelines.  The only
+cross-peer ops are the sparse neighbor ops.  On the circulant fast
+path they are static rolls over the peer axis, which XLA lowers to
+ICI collective-permutes — a halo exchange, the cheapest collective
+there is.  On the general [P, K] path the availability/presence/
+service/inverse-edge gathers reference *global* peer indices and
+lower to gather collectives.  Either way that is the simulator's
+only cross-device traffic, riding the fast fabric by construction,
+and O(P·K) on the wire instead of round 2's dense O(P²)."""
 
 from __future__ import annotations
 
@@ -62,15 +64,21 @@ def state_shardings(mesh: Mesh) -> SwarmState:
 
 def scenario_shardings(mesh: Mesh) -> SwarmScenario:
     """A ``SwarmScenario``-shaped pytree of NamedShardings: the bitrate
-    ladder is tiny and replicated; adjacency shards its ROW (requester)
-    axis so each device owns its peers' neighbor lists; every per-peer
-    vector shards over the peer axis."""
+    ladder and the policy scalars are tiny and replicated; the [P, K]
+    neighbor list shards its ROW (requester) axis so each device owns
+    its peers' neighbor lists; every per-peer vector shards over the
+    peer axis."""
     peer_vec = NamedSharding(mesh, P(PEER_AXIS))
+    rep = NamedSharding(mesh, P())
     return SwarmScenario(
-        bitrates=NamedSharding(mesh, P()),
-        adjacency=NamedSharding(mesh, P(PEER_AXIS, None)),
+        bitrates=rep,
+        neighbors=NamedSharding(mesh, P(PEER_AXIS, None)),
+        in_edges=NamedSharding(mesh, P(PEER_AXIS, None)),
         cdn_bps=peer_vec, uplink_bps=peer_vec, join_s=peer_vec,
-        leave_s=peer_vec, edge_rank=peer_vec)
+        leave_s=peer_vec, edge_rank=peer_vec,
+        urgent_margin_s=rep, p2p_budget_fraction=rep,
+        p2p_budget_cap_ms=rep, p2p_budget_floor_ms=rep,
+        live_spread_s=rep)
 
 
 def shard_swarm(mesh: Mesh, scenario: SwarmScenario, state: SwarmState):
@@ -83,14 +91,14 @@ def shard_swarm(mesh: Mesh, scenario: SwarmScenario, state: SwarmState):
     return scenario, state
 
 
-def sharded_run(mesh: Mesh, config: SwarmConfig, bitrates, adjacency,
+def sharded_run(mesh: Mesh, config: SwarmConfig, bitrates, neighbors,
                 cdn_bps, state: SwarmState, n_steps: int, join_s=None,
                 **scenario_kwargs):
     """jit the swarm scan with explicit input shardings over the mesh.
-    XLA inserts the ICI collectives for the eligibility gather and
-    contention reductions; all other ops stay local to their shard."""
+    XLA inserts the ICI collectives for the neighbor gathers and the
+    holder-load scatter; all other ops stay local to their shard."""
     from ..ops.swarm_sim import _run_swarm, make_scenario
-    scenario = make_scenario(config, bitrates, adjacency, cdn_bps, join_s,
+    scenario = make_scenario(config, bitrates, neighbors, cdn_bps, join_s,
                              **scenario_kwargs)
     scenario, state = shard_swarm(mesh, scenario, state)
     with mesh:

@@ -16,7 +16,7 @@ so the sim systematically overestimated offload under tight uplinks.
 
 import jax.numpy as jnp
 
-from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (SwarmConfig, full_adjacency,
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (SwarmConfig, full_neighbors,
                                                  init_swarm, offload_ratio,
                                                  run_swarm)
 from hlsjs_p2p_wrapper_tpu.testing.swarm import SwarmHarness
@@ -46,7 +46,7 @@ def sim_offload(uplink_bps):
     join = jnp.arange(N_PEERS, dtype=jnp.float32) * JOIN_SPACING_S
     uplink = jnp.full((N_PEERS,), float(uplink_bps))
     final, _ = run_swarm(config, jnp.array([BITRATE]),
-                         full_adjacency(N_PEERS),
+                         full_neighbors(N_PEERS),
                          jnp.full((N_PEERS,), CDN_BPS),
                          init_swarm(config),
                          int(400.0 * 1000.0 / config.dt_ms), join,

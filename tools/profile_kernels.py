@@ -1,0 +1,149 @@
+"""Candidate-kernel bakeoff for the sparse swarm step on TPU.
+
+Times the step's cross-peer ops with CARRY-DEPENDENT inputs (so XLA
+cannot hoist them out of the scan — an earlier version measured
+loop-invariant gathers and reported hoisted no-ops as fast):
+  have[i,k] : neighbor availability of peer i's segment of interest
+  load[j]   : sum of demand contributions onto holders
+  cache     : insert completed (level, seg) into the [P, L*S] map
+Variants: scalar gather/scatter (XLA GatherOp), one-hot contraction,
+and circulant (roll/stencil) forms.
+Usage: python tools/profile_kernels.py [--peers N] [--steps T]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def materialize(out):
+    jax.tree_util.tree_map(
+        lambda x: float(jnp.sum(jnp.asarray(x, jnp.float32))), out)
+
+
+def bench(name, jitted, args, base_dt, steps, repeats=3):
+    materialize(jitted(*args))
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        materialize(jitted(*args))
+    dt = (time.perf_counter() - t0) / repeats
+    per_step = (dt - base_dt) / steps * 1e3
+    print(f"{name:<48} {dt*1e3:9.2f} ms total  {per_step:8.4f} ms/step")
+    return dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=65536)
+    ap.add_argument("--cols", type=int, default=768)  # L*S
+    ap.add_argument("--steps", type=int, default=100)
+    args = ap.parse_args()
+    P, C, T = args.peers, args.cols, args.steps
+    K = 8
+    offs = [1, 2, 3, 4, -1, -2, -3, -4]
+
+    key = jax.random.PRNGKey(0)
+    avail0 = jax.random.bernoulli(key, 0.5, (P, C)).astype(jnp.uint8)
+    nbr = jnp.asarray((np.arange(P)[:, None] + np.array(offs)) % P,
+                      jnp.int32)
+    iota = jnp.arange(C, dtype=jnp.int32)
+    v0 = jax.random.uniform(key, (P,))
+
+    def scanned(fn):
+        def body(c, _):
+            return fn(c), None
+        return jax.jit(lambda c: jax.lax.scan(body, c, None, length=T)[0])
+
+    # baseline: carry chain with trivial work, to subtract dispatch
+    base = scanned(lambda c: c * 0.999 + 0.001)
+    materialize(base(v0))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        materialize(base(v0))
+    base_dt = (time.perf_counter() - t0) / 3
+    print(f"{'baseline trivial scan':<48} {base_dt*1e3:9.2f} ms total")
+
+    # carry-dependent index vector (changes every step, defeats hoist)
+    def idx_of(c):
+        return (jnp.abs(c * 1e4).astype(jnp.int32)) % C
+
+    # ---- have[i, k]: avail fixed, index carry-dependent -------------
+    f = scanned(lambda c: c + jnp.sum(
+        avail0[nbr, idx_of(c)[:, None]].astype(jnp.float32), axis=1) * 1e-9)
+    bench(f"have: scalar 2D gather x{T}", f, (v0,), base_dt, T)
+
+    def have_onehot(c):
+        W = (iota[None, :] == idx_of(c)[:, None]).astype(jnp.uint8)
+        h = sum(jnp.sum(jnp.roll(avail0, -o, axis=0) * W, axis=1,
+                        dtype=jnp.int32) for o in offs)
+        return c + h.astype(jnp.float32) * 1e-9
+    bench(f"have: circulant roll+onehot x{T}", scanned(have_onehot),
+          (v0,), base_dt, T)
+
+    # ---- [P] vector gather vs roll, carry-dependent -----------------
+    f = scanned(lambda c: c * 0.999 + jnp.sum(c[nbr], axis=1) * 1e-9)
+    bench(f"vec[nbr] gather (carry-dep) x{T}", f, (v0,), base_dt, T)
+    f = scanned(lambda c: c * 0.999
+                + sum(jnp.roll(c, -o) for o in offs) * 1e-9)
+    bench(f"vec rolls (carry-dep) x{T}", f, (v0,), base_dt, T)
+
+    # ---- load: scatter-add vs inverse-gather vs rolls ---------------
+    contrib_of = None  # noqa: F841
+
+    def load_scatter(c):
+        contrib = jnp.stack([c * (k + 1) for k in range(K)], 1) * 1e-9
+        return c * 0.999 + jnp.zeros((P,)).at[nbr].add(contrib)
+    bench(f"load: scatter-add x{T}", scanned(load_scatter), (v0,),
+          base_dt, T)
+
+    # inverse-edge gather: in_e[j, m] = flat outbound slot
+    src = np.repeat(np.arange(P), K)
+    dst = np.asarray(nbr).ravel()
+    order = np.argsort(dst, kind="stable")
+    in_e = np.full((P, K), -1, np.int64)
+    counts = np.bincount(dst, minlength=P)
+    start = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(len(dst)) - start[dst[order]]
+    in_e[dst[order], pos] = np.flatnonzero(np.ones_like(src))[order]
+    in_e = jnp.asarray(in_e, jnp.int32)
+
+    def load_gather(c):
+        contrib = jnp.stack([c * (k + 1) for k in range(K)], 1) * 1e-9
+        flat = contrib.reshape(-1)
+        return c * 0.999 + jnp.sum(
+            jnp.where(in_e >= 0, flat[jnp.maximum(in_e, 0)], 0.0), axis=1)
+    bench(f"load: inverse-edge gather x{T}", scanned(load_gather), (v0,),
+          base_dt, T)
+
+    def load_rolls(c):
+        return c * 0.999 + sum(
+            jnp.roll(c * (k + 1), offs[k]) for k in range(K)) * 1e-9
+    bench(f"load: circulant rolls x{T}", scanned(load_rolls), (v0,),
+          base_dt, T)
+
+    # ---- cache insert, carry-dependent ------------------------------
+    def cache_scatter(c):
+        a, x = c
+        pidx = jnp.arange(P)
+        a = a.at[pidx, idx_of(x)].max(jnp.uint8(1))
+        return (a, x * 0.999)
+    bench(f"cache: scatter x{T}", scanned(cache_scatter), ((avail0, v0),),
+          base_dt, T)
+
+    def cache_onehot(c):
+        a, x = c
+        W = (iota[None, :] == idx_of(x)[:, None]).astype(jnp.uint8)
+        return (jnp.maximum(a, W), x * 0.999)
+    bench(f"cache: one-hot max x{T}", scanned(cache_onehot),
+          ((avail0, v0),), base_dt, T)
+
+
+if __name__ == "__main__":
+    main()

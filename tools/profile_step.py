@@ -1,0 +1,96 @@
+"""Micro-profile of the swarm step's sparse pipeline on the current
+device: times isolated variants of the step's suspicious ops (neighbor
+gather, holder-load scatter-add, cache-map gather/scatter) to find
+what dominates.  Usage: python tools/profile_step.py [--peers N]"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
+    SwarmConfig, init_swarm, make_scenario, ring_neighbors, run_swarm)
+
+
+def timeit(name, fn, *args, repeats=3):
+    out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: float(jnp.sum(jnp.asarray(x, jnp.float32))), out)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn(*args)
+        jax.tree_util.tree_map(
+            lambda x: float(jnp.sum(jnp.asarray(x, jnp.float32))), out)
+    dt = (time.perf_counter() - t0) / repeats
+    print(f"{name:<44} {dt*1e3:9.2f} ms")
+    return dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=65536)
+    ap.add_argument("--segments", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=50)
+    args = ap.parse_args()
+    P, S, T = args.peers, args.segments, args.steps
+    L, K = 3, 8
+
+    config = SwarmConfig(n_peers=P, n_segments=S, n_levels=L)
+    nbr = ring_neighbors(P, K)
+    scenario = make_scenario(
+        config, jnp.array([300_000.0, 800_000.0, 2_000_000.0]), nbr,
+        jnp.full((P,), 8_000_000.0))
+    state = init_swarm(config)
+    key = jax.random.PRNGKey(0)
+    avail_flat = jax.random.bernoulli(key, 0.5, (P, L * S)).astype(jnp.uint8)
+    flat_idx = jax.random.randint(key, (P,), 0, L * S)
+    contrib = jax.random.uniform(key, (P, K))
+    vec = jax.random.uniform(key, (P,))
+
+    def scanned(fn, n=T):
+        def body(c, _):
+            return fn(c), None
+        return jax.jit(lambda c: jax.lax.scan(body, c, None, length=n))
+
+    # 1. full simulator step
+    timeit(f"full step x{T} (scan)",
+           lambda: run_swarm(config, scenario.bitrates, nbr,
+                             scenario.cdn_bps, state, T)[0])
+
+    # 2. the avail gather alone: [P, K] from [P, L*S] u8
+    g = scanned(lambda c: (c[0],
+                           c[1] + jnp.sum(c[0][nbr, flat_idx[:, None]]
+                                          .astype(jnp.float32))))
+    timeit(f"avail 2D gather x{T}", g, (avail_flat, 0.0))
+
+    # 3. per-peer vector gather: [P, K] from [P] f32
+    g2 = scanned(lambda c: c + jnp.sum(vec[nbr], axis=1))
+    timeit(f"[P] vector gather via nbr x{T}", g2, jnp.zeros((P,)))
+
+    # 4. scatter-add holder load: [P,K] contributions into [P]
+    sc = scanned(lambda c: c + jnp.zeros((P,)).at[nbr].add(contrib))
+    timeit(f"scatter-add load x{T}", sc, jnp.zeros((P,)))
+
+    # 5. cache scatter: P updates into [P, L, S] u8
+    pidx = jnp.arange(P)
+    lvl = jnp.zeros((P,), jnp.int32)
+    seg = jax.random.randint(key, (P,), 0, S)
+    cs = scanned(lambda c: c.at[pidx, lvl, seg].max(jnp.uint8(1)))
+    timeit(f"cache-map scatter x{T}", cs, state.avail)
+
+    # 6. elementwise state pipeline proxy (~40 vector ops)
+    def ew(c):
+        x = c
+        for _ in range(20):
+            x = jnp.where(x > 0.5, x * 0.99 + 0.01, x + 0.001)
+        return x
+    timeit(f"40 elementwise [P] ops x{T}", scanned(ew), vec)
+
+
+if __name__ == "__main__":
+    main()

