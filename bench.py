@@ -159,14 +159,22 @@ def numpy_baseline_throughput(config, n_steps, join):
         dl_ms = np.where(may, 0.0, dl_ms)
         dl_budget = np.where(may, budget, dl_budget)
         active_p2p = dl_active & dl_p2p
-        demand = active_p2p / np.maximum(n_holders, 1.0)
-        contrib = elig * demand[:, None]
+        # single-holder transfers with the holders[0] pile-on
+        # (ops/swarm_sim.py nth_holder_only): unit demand on the
+        # lowest-id eligible holder
+        masked = np.where(elig > 0, nbr, P)
+        first_id = masked.min(axis=1)
+        elig_first = ((elig > 0) & (nbr == first_id[:, None])).astype(
+            np.float32)
+        demand = active_p2p.astype(np.float32)
+        contrib = elig_first * demand[:, None]
         # bincount is NumPy's fastest segment-sum (4.5× np.add.at here)
         load = np.bincount(nbr.ravel(), weights=contrib.ravel(),
                            minlength=P).astype(np.float32)
         service = uplink / np.maximum(load, 1.0)
-        p2p_rate = np.minimum(demand * (elig * service[nbr]).sum(axis=1),
-                              config.p2p_bps)
+        p2p_rate = np.minimum(
+            demand * (elig_first * service[nbr]).sum(axis=1),
+            config.p2p_bps)
         rate = np.where(dl_p2p, p2p_rate, cdn)
         prog = dl_active & present
         dl_done = dl_done + np.where(prog, rate * dt_s / 8.0, 0.0)

@@ -105,6 +105,18 @@ class SwarmConfig(NamedTuple):
     #: ``scenario.neighbors`` [P, K] gather path is used (arbitrary
     #: topologies; slower, fine for small swarms).
     neighbor_offsets: Optional[Tuple[int, ...]] = None
+    #: concurrent transfers per peer: slot 0 is the FOREGROUND
+    #: download (CDN-capable, urgency + budget failover — the
+    #: player's fLoader path); slots 1..C-1 are P2P-ONLY PREFETCHES
+    #: of upcoming in-window segments at the current ABR level, which
+    #: land in the cache, not the buffer — the playback path absorbs
+    #: cached segments instantly.  Mirrors the agent's foreground +
+    #: max_concurrent_prefetch=2 transfer model
+    #: (engine/p2p_agent.py:60, _schedule_prefetch) so the device sim
+    #: and the discrete harness agree under contention; cost scales
+    #: ~linearly in C, so the default keeps the flagship single-slot
+    #: model.
+    max_concurrency: int = 1
     seg_duration_s: float = 4.0
     dt_ms: float = 250.0
     max_buffer_s: float = 30.0
@@ -121,6 +133,18 @@ class SwarmConfig(NamedTuple):
     p2p_budget_fraction: float = 0.5     # budget = margin × fraction...
     p2p_budget_cap_ms: float = 6_000.0   # ...capped here
     p2p_budget_floor_ms: float = 500.0   # ...floored here
+    #: per-attempt P2P request timeout; a prefetch that outlives it is
+    #: dropped, discarding partials (the mesh's
+    #: DEFAULT_REQUEST_TIMEOUT_MS, engine/mesh.py:39 — the agent's
+    #: on_error path for prefetches)
+    request_timeout_ms: float = 8_000.0
+    #: live mode: holder knowledge of a just-published segment
+    #: propagates via HAVE/announce messages
+    #: (announce_interval_ms, engine/p2p_agent.py) — P2P starts on an
+    #: edge segment are possible only this long after publish.  0 =
+    #: instant propagation (the VOD steady state, where announce lag
+    #: is negligible against the prefetch window).
+    announce_delay_s: float = 0.0
 
 
 class SwarmScenario(NamedTuple):
@@ -150,14 +174,17 @@ class SwarmScenario(NamedTuple):
     p2p_budget_cap_ms: jax.Array    # [] budget ceiling
     p2p_budget_floor_ms: jax.Array  # [] budget floor
     live_spread_s: jax.Array        # [] live-edge CDN stagger window
+    request_timeout_ms: jax.Array   # [] per-attempt P2P timeout
+    announce_delay_s: jax.Array     # [] live HAVE-propagation lag
 
 
 def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
                   join_s=None, *, uplink_bps=None, leave_s=None,
                   edge_rank=None, urgent_margin_s=None,
                   p2p_budget_fraction=None, p2p_budget_cap_ms=None,
-                  p2p_budget_floor_ms=None,
-                  live_spread_s=None) -> SwarmScenario:
+                  p2p_budget_floor_ms=None, live_spread_s=None,
+                  request_timeout_ms=None,
+                  announce_delay_s=None) -> SwarmScenario:
     """Normalize optional arrays to their defaults (everyone joins at
     t=0, never leaves, serves at the downlink cap, rank 0) and policy
     scalars to the config's values.  Also precomputes the inbound
@@ -177,9 +204,11 @@ def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
                              "config.neighbor_offsets (circulant mode)")
         neighbors = jnp.zeros((P, 0), jnp.int32)
         in_edges = jnp.zeros((P, 0), jnp.int32)
-    elif config.neighbor_offsets is not None:
+    elif (config.neighbor_offsets is not None
+          and jnp.asarray(neighbors).shape[-1] > 0):
         # refuse the ambiguous case: with offsets set the step takes
-        # the circulant path and would silently ignore the array
+        # the circulant path and would silently ignore a real
+        # neighbor array (the [P, 0] placeholder round-trips fine)
         raise ValueError(
             "both config.neighbor_offsets and a neighbors array were "
             "given; pass neighbors=None for circulant mode, or unset "
@@ -209,7 +238,11 @@ def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
                                  config.p2p_budget_cap_ms),
         p2p_budget_floor_ms=scalar(p2p_budget_floor_ms,
                                    config.p2p_budget_floor_ms),
-        live_spread_s=scalar(live_spread_s, config.live_spread_s))
+        live_spread_s=scalar(live_spread_s, config.live_spread_s),
+        request_timeout_ms=scalar(request_timeout_ms,
+                                  config.request_timeout_ms),
+        announce_delay_s=scalar(announce_delay_s,
+                                config.announce_delay_s))
 
 
 class SwarmState(NamedTuple):
@@ -225,28 +258,33 @@ class SwarmState(NamedTuple):
     avail: jax.Array           # [P, L, S] u8 0/1 cache map
     cdn_bytes: jax.Array       # [P] f32
     p2p_bytes: jax.Array       # [P] f32
-    dl_active: jax.Array       # [P] bool
-    dl_is_p2p: jax.Array       # [P] bool
-    dl_seg: jax.Array          # [P] i32
-    dl_level: jax.Array        # [P] i32
-    dl_done_bytes: jax.Array   # [P] f32
-    dl_total_bytes: jax.Array  # [P] f32
-    dl_elapsed_ms: jax.Array   # [P] f32
-    dl_budget_ms: jax.Array    # [P] f32 P2P time budget before CDN failover
+    # transfer slots, all [P, C] (C = config.max_concurrency; slot 0
+    # = foreground, slots 1.. = P2P prefetches):
+    dl_active: jax.Array       # [P, C] bool
+    dl_is_p2p: jax.Array       # [P, C] bool
+    dl_seg: jax.Array          # [P, C] i32
+    dl_level: jax.Array        # [P, C] i32
+    dl_done_bytes: jax.Array   # [P, C] f32
+    dl_total_bytes: jax.Array  # [P, C] f32
+    dl_elapsed_ms: jax.Array   # [P, C] f32
+    dl_budget_ms: jax.Array    # [P, C] f32 P2P budget before CDN failover
 
 
 def init_swarm(config: SwarmConfig) -> SwarmState:
     P, L, S = config.n_peers, config.n_levels, config.n_segments
+    C = config.max_concurrency
     f0 = jnp.zeros((P,), jnp.float32)
     i0 = jnp.zeros((P,), jnp.int32)
-    b0 = jnp.zeros((P,), bool)
+    fc = jnp.zeros((P, C), jnp.float32)
+    ic = jnp.zeros((P, C), jnp.int32)
+    bc = jnp.zeros((P, C), bool)
     return SwarmState(
         t_s=jnp.zeros((), jnp.float32),
         playhead_s=f0, buffer_s=f0, rebuffer_s=f0, level=i0,
         ewma=init_state(P), avail=jnp.zeros((P, L, S), jnp.uint8),
-        cdn_bytes=f0, p2p_bytes=f0, dl_active=b0, dl_is_p2p=b0,
-        dl_seg=i0, dl_level=i0, dl_done_bytes=f0, dl_total_bytes=f0,
-        dl_elapsed_ms=f0, dl_budget_ms=f0)
+        cdn_bytes=f0, p2p_bytes=f0, dl_active=bc, dl_is_p2p=bc,
+        dl_seg=ic, dl_level=ic, dl_done_bytes=fc, dl_total_bytes=fc,
+        dl_elapsed_ms=fc, dl_budget_ms=fc)
 
 
 def _abr_pick(estimate_bps: jax.Array, bitrates: jax.Array) -> jax.Array:
@@ -259,13 +297,19 @@ def _abr_pick(estimate_bps: jax.Array, bitrates: jax.Array) -> jax.Array:
 
 def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                state: SwarmState) -> SwarmState:
-    """One ``dt_ms`` tick for every peer at once."""
+    """One ``dt_ms`` tick for every peer at once.  Transfer slots
+    (``config.max_concurrency``) are unrolled at trace time: slot 0 is
+    the foreground download, slots 1.. are P2P-only prefetches (see
+    the ``max_concurrency`` field docs)."""
     dt_s = config.dt_ms / 1000.0
     seg = config.seg_duration_s
-    P, S = config.n_peers, config.n_segments
+    P, S, L = config.n_peers, config.n_segments, config.n_levels
+    C = config.max_concurrency
     end_s = S * seg
     t = state.t_s
     present = (t >= scenario.join_s) & (t < scenario.leave_s)  # [P]
+    zeros = jnp.zeros((P,), jnp.float32)
+    never = jnp.zeros((P,), bool)
 
     playhead = state.playhead_s
     if config.live:
@@ -283,23 +327,18 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     next_seg = jnp.minimum(
         ((playhead + state.buffer_s) / seg).astype(jnp.int32), S - 1)
     timeline_left = (playhead + state.buffer_s) < end_s
-    wants = (present & ~state.dl_active & timeline_left
-             & (state.buffer_s < config.max_buffer_s))
+    fg_idle = ~state.dl_active[:, 0]
+    fg_wants = (present & fg_idle & timeline_left
+                & (state.buffer_s < config.max_buffer_s))
     if config.live:
         # only fully published segments are downloadable
-        wants = wants & ((next_seg.astype(jnp.float32) + 1.0) * seg <= t)
+        fg_wants = fg_wants & ((next_seg.astype(jnp.float32) + 1.0) * seg
+                               <= t)
 
-    # ---- 2. eligibility ---------------------------------------------
-    # have[i, k] = neighbor k's availability of peer i's single
-    # segment of interest — the in-flight (level, seg) for active
-    # downloads (contention), the wanted (level, seg) for idle peers
-    # (start decision).
-    gi_level = jnp.where(state.dl_active, state.dl_level, want_level)
-    gi_seg = jnp.where(state.dl_active, state.dl_seg, next_seg)
-    flat_idx = gi_level * S + gi_seg                         # [P]
-    avail_flat = state.avail.reshape(P, config.n_levels * S)
+    # ---- 2. eligibility machinery -----------------------------------
+    avail_flat = state.avail.reshape(P, L * S)
     circulant = config.neighbor_offsets is not None
-    W = None
+    col = jnp.arange(L * S, dtype=next_seg.dtype)
     if circulant:
         # circulant fast path: neighbor k of peer i is (i + off_k) %
         # P, so "what does my k-th neighbor have" is a static ROW
@@ -307,14 +346,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         # against the one-hot of each peer's segment of interest —
         # K stencil passes, zero gathers (see neighbor_offsets doc)
         offs = _normalized_offsets(config.neighbor_offsets, P)
-        col = jnp.arange(config.n_levels * S, dtype=flat_idx.dtype)
-        W = (col[None, :] == flat_idx[:, None]).astype(jnp.uint8)
-        AP = avail_flat * present.astype(jnp.uint8)[:, None]  # [P, C]
-        elig_list = [jnp.sum(jnp.roll(AP, -o, axis=0) * W, axis=1,
-                             dtype=jnp.int32).astype(jnp.float32)
-                     for o in offs]                          # K × [P]
-        n_holders = (sum(elig_list) if elig_list
-                     else jnp.zeros((P,), jnp.float32))
+        AP = avail_flat * present.astype(jnp.uint8)[:, None]  # [P, L·S]
     else:
         # general [P, K] neighbor-list path (arbitrary topologies):
         # XLA gathers — correct everywhere, ~50× slower per edge on
@@ -322,136 +354,316 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         # are padding (a peer never downloads from itself).
         nbr = scenario.neighbors                             # [P, K]
         peer_idx = jnp.arange(P, dtype=nbr.dtype)
-        valid = (nbr != peer_idx[:, None]).astype(jnp.float32)
-        have_ik = avail_flat[nbr, flat_idx[:, None]]         # [P, K] u8
-        elig_ik = (valid * have_ik.astype(jnp.float32)
-                   * present.astype(jnp.float32)[nbr])       # [P, K]
-        n_holders = jnp.sum(elig_ik, axis=1)                 # [P]
-    have_neighbors = n_holders > 0.0
+        nbr_valid = (nbr != peer_idx[:, None]).astype(jnp.float32)
+        present_nbr = present.astype(jnp.float32)[nbr]       # [P, K]
+
+    def eligibility(gi_flat):
+        """(one-hot W, per-edge eligibility, holder count) for each
+        peer's [P] flat (level, seg) target."""
+        W = (col[None, :] == gi_flat[:, None]).astype(jnp.uint8)
+        if circulant:
+            elig = [jnp.sum(jnp.roll(AP, -o, axis=0) * W, axis=1,
+                            dtype=jnp.int32).astype(jnp.float32)
+                    for o in offs]                           # K × [P]
+            n = sum(elig) if elig else zeros
+        else:
+            have = avail_flat[nbr, gi_flat[:, None]]         # [P, K] u8
+            elig = nbr_valid * have.astype(jnp.float32) * present_nbr
+            n = jnp.sum(elig, axis=1)
+        return W, elig, n
+
+    def nth_holder_only(elig, skip: int):
+        """Restrict eligibility to the single (skip+1)-th-lowest-id
+        eligible holder (clamped to however many exist).  Models the
+        agent's SINGLE-HOLDER transfers: the mesh lists holders in
+        announce order (earliest cacher first — lowest peer id in
+        aggregate), prefetches request ``holders[0]``
+        (engine/p2p_agent.py:458), and the foreground's
+        least-loaded-by-LOCAL-knowledge selection lands on the next
+        holder its own prefetches aren't occupying.  All peers share
+        the announce order, so each rank is a swarm-wide pile-on
+        point — its uplink saturates while later holders idle, which
+        is THE contention-collapse mechanism the dense demand-split
+        model of rounds 1-2 could not reproduce."""
+        big = jnp.int32(P)
+        if circulant:
+            ids = [(jnp.arange(P, dtype=jnp.int32)
+                    + jnp.int32(o % P)) % P for o in offs]
+            masked = [jnp.where(e > 0, i, big)
+                      for e, i in zip(elig, ids)]
+            # rank-walk: after r iterations, prev = r-th-lowest
+            # eligible id (stays put when fewer than r exist)
+            prev = jnp.full((P,), -1, jnp.int32)
+            for _ in range(skip + 1):
+                nxt = jnp.full((P,), big, jnp.int32)
+                for m in masked:
+                    nxt = jnp.minimum(nxt, jnp.where(m > prev, m, big))
+                prev = jnp.where(nxt < big, nxt, prev)
+            return [((e > 0) & (i == prev)).astype(jnp.float32)
+                    for e, i in zip(elig, ids)]
+        if nbr.shape[1] == 0:        # degenerate no-edge topology
+            return jnp.zeros_like(elig)
+        pos = elig > 0                                       # [P, K]
+        masked = jnp.where(pos, nbr, big)
+        prev = jnp.full((P,), -1, nbr.dtype)
+        for _ in range(skip + 1):
+            nxt = jnp.min(jnp.where(masked > prev[:, None], masked, big),
+                          axis=1)
+            prev = jnp.where(nxt < big, nxt, prev)
+        return (pos & (nbr == prev[:, None])).astype(jnp.float32)
+
+    def own_cache(W):
+        """Does each peer already hold its own target? (u8 one-hot
+        contraction — the local cache-hit check for absorb/prefetch)"""
+        return jnp.sum(avail_flat * W, axis=1, dtype=jnp.int32) > 0
 
     # ---- start decisions (engine/scheduler.py decide()) -------------
     # margin = playback slack until the wanted segment is needed
     # (segment start time minus playhead, the agent's
     # _playback_margin_s); urgent requests must not gamble on peers,
     # and P2P attempts get a bounded time budget before conceding to
-    # the CDN
+    # the CDN.  (Foreground only: prefetches are pure P2P
+    # opportunism, engine/p2p_agent.py _schedule_prefetch.)
     margin_s = next_seg.astype(jnp.float32) * seg - playhead
     urgent = margin_s < scenario.urgent_margin_s
     budget_ms = jnp.clip(margin_s * 1000.0 * scenario.p2p_budget_fraction,
                          scenario.p2p_budget_floor_ms,
                          scenario.p2p_budget_cap_ms)
-    if config.live:
-        # live-edge stagger: with no holder yet, only low-rank peers
-        # hit the CDN now; the rest wait their stable fraction of the
-        # spread and usually catch the seeders' announcements instead.
-        # (At spread 0 this is `t >= publish_t`, which `wants` already
-        # guarantees for idle peers — i.e. no stagger.)
-        publish_t = (gi_seg.astype(jnp.float32) + 1.0) * seg
-        cdn_allowed = (t >= publish_t
-                       + scenario.edge_rank * scenario.live_spread_s)
-    else:
-        cdn_allowed = jnp.ones_like(have_neighbors)
-    start_p2p = wants & have_neighbors & ~urgent
-    start_cdn = wants & ~start_p2p & (cdn_allowed | urgent)
-    may_start = start_p2p | start_cdn
 
     # one-hot contraction instead of bitrates[want_level]: even a
     # gather from a 3-element table pays TPU's per-element gather cost
-    lvl_iota = jnp.arange(config.n_levels, dtype=want_level.dtype)
-    new_total = jnp.sum(
+    lvl_iota = jnp.arange(L, dtype=want_level.dtype)
+    want_bytes = jnp.sum(
         jnp.where(want_level[:, None] == lvl_iota[None, :],
                   scenario.bitrates[None, :], 0.0), axis=1) * (seg / 8.0)
-    dl_active = state.dl_active | may_start
-    dl_is_p2p = jnp.where(may_start, start_p2p, state.dl_is_p2p)
-    # a P2P download whose holders all departed flips to the CDN — the
-    # aggregate analogue of the agent's holders-exhausted failover
-    dl_is_p2p = dl_is_p2p & (n_holders > 0.0)
-    dl_seg = jnp.where(may_start, next_seg, state.dl_seg)
-    dl_level = jnp.where(may_start, want_level, state.dl_level)
-    dl_total = jnp.where(may_start, new_total, state.dl_total_bytes)
-    dl_done = jnp.where(may_start, 0.0, state.dl_done_bytes)
-    dl_elapsed = jnp.where(may_start, 0.0, state.dl_elapsed_ms)
-    dl_budget = jnp.where(may_start, budget_ms, state.dl_budget_ms)
-    level = jnp.where(may_start, want_level, state.level)
 
-    # ---- 3. uplink contention + progress ----------------------------
-    # each active P2P downloader splits unit demand across its
-    # holders; a holder's uplink is shared across the demand on it
-    # (engine/transport.py:126-132); a downloader's rate is its
+    # ---- per-slot phase A: targets, starts, eligibility -------------
+    # python-unrolled over C (static, small); slot records collect the
+    # updated columns, contention couples them in phase B
+    slots = []
+    # in-flight (active, flat-id) per slot: pre-update for slots not
+    # yet processed, post-update for processed ones — the prefetch
+    # dedup guard (`key in self._prefetches`, p2p_agent.py:453)
+    pre_flight = [(state.dl_active[:, c],
+                   state.dl_level[:, c] * S + state.dl_seg[:, c])
+                  for c in range(C)]
+    post_flight = []
+    absorb = never
+    level = state.level
+    for c in range(C):
+        a0 = state.dl_active[:, c]
+        if c == 0:
+            target_seg = next_seg
+            wants_c = fg_wants
+        else:
+            raw = next_seg + c
+            target_seg = jnp.minimum(raw, S - 1)
+            in_timeline = raw <= S - 1
+            # agent prefetch window = playhead → +get_buffer_level_max
+            in_window = (raw.astype(jnp.float32) * seg
+                         < playhead + config.max_buffer_s)
+            wants_c = present & ~a0 & in_timeline & in_window
+            if config.live:
+                wants_c = wants_c & ((raw.astype(jnp.float32) + 1.0) * seg
+                                     <= t)
+        target_flat = want_level * S + target_seg
+        if config.live:
+            # HAVE/announce propagation lag: freshly published
+            # segments are P2P-fetchable only announce_delay_s after
+            # publish — before that the swarm doesn't know who holds
+            # them and the edge rides the CDN (stagger permitting)
+            p2p_visible = (t >= (target_seg.astype(jnp.float32) + 1.0)
+                           * seg + scenario.announce_delay_s)
+        else:
+            p2p_visible = jnp.ones((P,), bool)
+        if c > 0:
+            # prefetch dedup guard (`key in self._prefetches`,
+            # p2p_agent.py:453): not already in flight on another
+            # slot.  The FOREGROUND deliberately has no such guard —
+            # the agent's get_segment consults only the cache.
+            conflict = never
+            for (a_o, f_o) in post_flight + pre_flight[c + 1:]:
+                conflict = conflict | (a_o & (f_o == target_flat))
+        gi_seg = jnp.where(a0, state.dl_seg[:, c], target_seg)
+        gi_level = jnp.where(a0, state.dl_level[:, c], want_level)
+        gi_flat = gi_level * S + gi_seg
+        W_c, elig_c, n_holders_c = eligibility(gi_flat)
+        have_n = n_holders_c > 0.0
+        if c == 0:
+            if C > 1:
+                # prefetched-cache absorb: the loader's request is
+                # served from the local cache instantly (the agent's
+                # cache-hit path) — buffer advances, no transfer, no
+                # new bytes (they were counted at prefetch time)
+                absorb = fg_wants & own_cache(W_c)
+                wants_dl = fg_wants & ~absorb
+            else:
+                wants_dl = fg_wants
+            if config.live:
+                # live-edge stagger: with no holder yet, only
+                # low-rank peers hit the CDN now; the rest wait
+                # their stable fraction of the spread and usually
+                # catch the seeders' announcements instead.  (At
+                # spread 0 this is `t >= publish_t`, which `wants`
+                # already guarantees for idle peers — no stagger.)
+                publish_t = (gi_seg.astype(jnp.float32) + 1.0) * seg
+                cdn_allowed = (t >= publish_t
+                               + scenario.edge_rank
+                               * scenario.live_spread_s)
+            else:
+                cdn_allowed = jnp.ones_like(have_n)
+            start_p2p = wants_dl & have_n & ~urgent & p2p_visible
+            start_cdn = wants_dl & ~start_p2p & (cdn_allowed | urgent)
+            may = start_p2p | start_cdn
+            is_p2p = jnp.where(may, start_p2p, state.dl_is_p2p[:, c])
+            # a P2P download whose holders all departed flips to the
+            # CDN — the aggregate analogue of the agent's
+            # holders-exhausted failover
+            is_p2p = is_p2p & have_n
+            active = a0 | may
+            level = jnp.where(may, want_level, level)
+        else:
+            # prefetch start: P2P only, in-window, uncached, holders
+            # known (and announced, in live mode), not already in
+            # flight on another slot
+            start_p2p = (wants_c & have_n & ~conflict & p2p_visible
+                         & ~own_cache(W_c))
+            may = start_p2p
+            is_p2p = state.dl_is_p2p[:, c] | may
+            active = a0 | may
+        slots.append({
+            "may": may, "active": active, "is_p2p": is_p2p,
+            "have_n": have_n, "n_holders": n_holders_c,
+            "W": W_c,
+            # single-holder transfers (see nth_holder_only): the
+            # foreground rides the holder after its own prefetches'
+            # pile-on point; prefetches ride holders[0]
+            "elig": nth_holder_only(elig_c,
+                                    1 if (c == 0 and C > 1) else 0),
+            "seg": jnp.where(may, target_seg, state.dl_seg[:, c]),
+            "level": jnp.where(may, want_level, state.dl_level[:, c]),
+            "total": jnp.where(may, want_bytes,
+                               state.dl_total_bytes[:, c]),
+            "done": jnp.where(may, 0.0, state.dl_done_bytes[:, c]),
+            "elapsed": jnp.where(may, 0.0, state.dl_elapsed_ms[:, c]),
+            "budget": jnp.where(may, budget_ms,
+                                state.dl_budget_ms[:, c]),
+        })
+        post_flight.append((active, slots[-1]["level"] * S
+                            + slots[-1]["seg"]))
+
+    # ---- 3. uplink contention + progress (phase B) ------------------
+    # every active P2P transfer — foreground or prefetch, any slot —
+    # splits unit demand across its holders; a holder's uplink is
+    # shared across the TOTAL demand on it
+    # (engine/transport.py:126-132); a transfer's rate is its
     # share-weighted service, capped by the downlink.
-    active_p2p = dl_active & dl_is_p2p
-    demand_i = active_p2p.astype(jnp.float32) / jnp.maximum(n_holders, 1.0)
+    for s in slots:
+        s["demand"] = (s["active"] & s["is_p2p"] & present).astype(
+            jnp.float32)
     if circulant:
         # holder load: the edge (i → i+off) contributes at row i of
         # contrib_k, so the per-holder sum is the INVERSE shift;
         # service readback is the forward shift — all [P] rolls
-        contrib_list = [e * demand_i for e in elig_list]
-        load_j = (sum(jnp.roll(c, o) for c, o in zip(contrib_list, offs))
-                  if offs else jnp.zeros((P,), jnp.float32))
+        load_j = zeros
+        for s in slots:
+            for e, o in zip(s["elig"], offs):
+                load_j = load_j + jnp.roll(e * s["demand"], o)
         service_j = scenario.uplink_bps / jnp.maximum(load_j, 1.0)
-        svc_sum = (sum(e * jnp.roll(service_j, -o)
-                       for e, o in zip(elig_list, offs))
-                   if offs else jnp.zeros((P,), jnp.float32))
+        rolled_svc = [jnp.roll(service_j, -o) for o in offs]
+        for s in slots:
+            s["svc"] = sum((e * r for e, r in zip(s["elig"], rolled_svc)),
+                           zeros)
     else:
         # general path: holder load sums each holder's INBOUND edge
         # contributions via the precomputed inverse edge lists — a
         # gather, because the equivalent scatter-add serializes on
         # TPU (see in_edges docs); service readback is one more
-        # gather — O(P·K) total, the sparse equivalent of round 2's
+        # gather — O(P·K·C) total, the sparse equivalent of round 2's
         # dense [P, P] matvec pair.
-        contrib_flat = (elig_ik * demand_i[:, None]).reshape(-1)  # [P·K]
-        in_e = scenario.in_edges                                  # [P, K_in]
-        load_j = jnp.sum(jnp.where(in_e >= 0,
-                                   contrib_flat[jnp.maximum(in_e, 0)],
-                                   0.0),
-                         axis=1)                                  # [P]
+        in_e = scenario.in_edges                             # [P, K_in]
+        in_ok = in_e >= 0
+        in_idx = jnp.maximum(in_e, 0)
+        load_j = zeros
+        for s in slots:
+            contrib_flat = (s["elig"] * s["demand"][:, None]).reshape(-1)
+            load_j = load_j + jnp.sum(
+                jnp.where(in_ok, contrib_flat[in_idx], 0.0), axis=1)
         service_j = scenario.uplink_bps / jnp.maximum(load_j, 1.0)
-        svc_sum = jnp.sum(elig_ik * service_j[nbr], axis=1)
-    p2p_rate = jnp.minimum(demand_i * svc_sum, config.p2p_bps)   # [P]
-    rate_bps = jnp.where(dl_is_p2p, p2p_rate, scenario.cdn_bps)
-    progressing = dl_active & present
-    dl_done = dl_done + jnp.where(progressing, rate_bps * dt_s / 8.0, 0.0)
-    dl_elapsed = dl_elapsed + jnp.where(progressing, config.dt_ms, 0.0)
-    completed = progressing & (dl_done >= dl_total)
+        svc_nbr = service_j[nbr]                             # [P, K]
+        for s in slots:
+            s["svc"] = jnp.sum(s["elig"] * svc_nbr, axis=1)
 
-    # budget failover (engine/p2p_agent.py _start_p2p_leg → to_cdn): a
-    # P2P attempt that outlives its budget concedes to the CDN,
-    # DISCARDING partial bytes — the uplink it consumed meanwhile was
-    # real, which is how contention collapse propagates
-    p2p_expired = (dl_active & dl_is_p2p & ~completed
-                   & (dl_elapsed >= dl_budget))
-    dl_is_p2p = dl_is_p2p & ~p2p_expired
-    dl_done = jnp.where(p2p_expired, 0.0, dl_done)
-    dl_elapsed = jnp.where(p2p_expired, 0.0, dl_elapsed)
+    insert = jnp.zeros_like(avail_flat)
+    ewma = state.ewma
+    cdn_bytes = state.cdn_bytes
+    p2p_bytes = state.p2p_bytes
+    buffer_add = jnp.where(absorb, seg, 0.0)
+    new_cols = {k: [] for k in ("active", "is_p2p", "seg", "level",
+                                "done", "elapsed", "total", "budget")}
+    for c, s in enumerate(slots):
+        p2p_rate = jnp.minimum(s["demand"] * s["svc"], config.p2p_bps)
+        rate_bps = (jnp.where(s["is_p2p"], p2p_rate, scenario.cdn_bps)
+                    if c == 0 else p2p_rate)
+        progressing = s["active"] & present
+        done = s["done"] + jnp.where(progressing, rate_bps * dt_s / 8.0,
+                                     0.0)
+        elapsed = s["elapsed"] + jnp.where(progressing, config.dt_ms, 0.0)
+        completed = progressing & (done >= s["total"])
+        active = s["active"] & ~completed
+        is_p2p = s["is_p2p"]
+        if c == 0:
+            # budget failover (engine/p2p_agent.py _start_p2p_leg →
+            # to_cdn): a P2P attempt that outlives its budget
+            # concedes to the CDN, DISCARDING partial bytes — the
+            # uplink it consumed meanwhile was real, which is how
+            # contention collapse propagates
+            expired = (active & is_p2p & (elapsed >= s["budget"]))
+            is_p2p = is_p2p & ~expired
+            done = jnp.where(expired, 0.0, done)
+            elapsed = jnp.where(expired, 0.0, elapsed)
+            cdn_bytes = cdn_bytes + jnp.where(completed & ~is_p2p,
+                                              s["total"], 0.0)
+            p2p_bytes = p2p_bytes + jnp.where(completed & is_p2p,
+                                              s["total"], 0.0)
+            buffer_add = buffer_add + jnp.where(completed, seg, 0.0)
+        else:
+            # a prefetch whose holders vanished OR whose per-attempt
+            # request timeout expired is dropped (the agent's
+            # on_error path discards the attempt; no CDN leg)
+            aborted = (active & ~s["have_n"]) | (
+                active & (elapsed >= scenario.request_timeout_ms))
+            active = active & ~aborted
+            done = jnp.where(aborted, 0.0, done)
+            elapsed = jnp.where(aborted, 0.0, elapsed)
+            p2p_bytes = p2p_bytes + jnp.where(completed, s["total"], 0.0)
+        # cache insert: one-hot row max instead of a scatter — touches
+        # the whole [P, L·S] map but runs at vector throughput; TPU
+        # scatter serializes its updates.  A slot can only complete
+        # the transfer it was gathered on, so its eligibility one-hot
+        # IS the insert position.
+        insert = jnp.maximum(insert,
+                             s["W"] * completed.astype(jnp.uint8)[:, None])
+        # estimator feeds on real (duration, bytes) pairs — both
+        # foreground transfers and prefetches, matching the loader's
+        # trequest back-dating contract for instant cache hits
+        # (tests/test_abr_contract.py)
+        sample_ms = jnp.maximum(elapsed, MIN_SAMPLE_DURATION_MS)
+        ewma = update(ewma,
+                      jnp.where(completed, sample_ms, 0.0),
+                      jnp.where(completed, s["total"], 0.0),
+                      config.fast_half_life_s, config.slow_half_life_s)
+        new_cols["active"].append(active)
+        new_cols["is_p2p"].append(is_p2p)
+        new_cols["seg"].append(s["seg"])
+        new_cols["level"].append(s["level"])
+        new_cols["done"].append(done)
+        new_cols["elapsed"].append(elapsed)
+        new_cols["total"].append(s["total"])
+        new_cols["budget"].append(s["budget"])
 
-    # cache insert: one-hot row max instead of a scatter — touches the
-    # whole [P, L·S] map (2 bytes/element r/w) but runs at vector
-    # throughput; TPU scatter serializes its updates (measured ~2×
-    # slower, and the dense pass fuses with the eligibility stencil).
-    # A peer can only complete the download it was gathered on, so
-    # the one-hot of flat_idx IS the insert position (the circulant
-    # path reuses its eligibility one-hot for free).
-    if W is None:
-        col = jnp.arange(config.n_levels * S, dtype=flat_idx.dtype)
-        W = (col[None, :] == flat_idx[:, None]).astype(jnp.uint8)
-    avail = jnp.maximum(avail_flat,
-                        W * completed.astype(jnp.uint8)[:, None]).reshape(
-        state.avail.shape)
-
-    # estimator feeds on real (duration, bytes) pairs, same numerics
-    # the player's ABR contract pins (tests/test_abr_contract.py)
-    sample_ms = jnp.maximum(dl_elapsed, MIN_SAMPLE_DURATION_MS)
-    ewma = update(state.ewma,
-                  jnp.where(completed, sample_ms, 0.0),
-                  jnp.where(completed, dl_total, 0.0),
-                  config.fast_half_life_s, config.slow_half_life_s)
-
-    cdn_bytes = state.cdn_bytes + jnp.where(completed & ~dl_is_p2p,
-                                            dl_total, 0.0)
-    p2p_bytes = state.p2p_bytes + jnp.where(completed & dl_is_p2p,
-                                            dl_total, 0.0)
-    buffer_s = state.buffer_s + jnp.where(completed, seg, 0.0)
-    dl_active = dl_active & ~completed
+    avail = jnp.maximum(avail_flat, insert).reshape(state.avail.shape)
+    buffer_s = state.buffer_s + buffer_add
 
     # ---- 4. playback ------------------------------------------------
     can_play = present & (playhead < end_s)
@@ -467,14 +679,16 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     rebuffer = state.rebuffer_s + jnp.where(can_play, dt_s - advance, 0.0)
     buffer_s = buffer_s - advance
 
+    stack = lambda key: jnp.stack(new_cols[key], axis=1)  # noqa: E731
     return SwarmState(
         t_s=t + dt_s,
         playhead_s=playhead, buffer_s=buffer_s, rebuffer_s=rebuffer,
         level=level, ewma=ewma, avail=avail, cdn_bytes=cdn_bytes,
-        p2p_bytes=p2p_bytes, dl_active=dl_active, dl_is_p2p=dl_is_p2p,
-        dl_seg=dl_seg, dl_level=dl_level, dl_done_bytes=dl_done,
-        dl_total_bytes=dl_total, dl_elapsed_ms=dl_elapsed,
-        dl_budget_ms=dl_budget)
+        p2p_bytes=p2p_bytes, dl_active=stack("active"),
+        dl_is_p2p=stack("is_p2p"), dl_seg=stack("seg"),
+        dl_level=stack("level"), dl_done_bytes=stack("done"),
+        dl_total_bytes=stack("total"), dl_elapsed_ms=stack("elapsed"),
+        dl_budget_ms=stack("budget"))
 
 
 @partial(jax.jit, static_argnames=("config", "n_steps"))
@@ -498,7 +712,8 @@ def run_swarm(config: SwarmConfig, bitrates: jax.Array,
               edge_rank: Optional[jax.Array] = None,
               urgent_margin_s=None, p2p_budget_fraction=None,
               p2p_budget_cap_ms=None, p2p_budget_floor_ms=None,
-              live_spread_s=None,
+              live_spread_s=None, request_timeout_ms=None,
+              announce_delay_s=None,
               ) -> Tuple[SwarmState, jax.Array]:
     """Scan ``n_steps`` ticks; returns (final state, offload-over-time
     ``[n_steps]``).  One compiled program regardless of T — and of any
@@ -512,7 +727,9 @@ def run_swarm(config: SwarmConfig, bitrates: jax.Array,
         p2p_budget_fraction=p2p_budget_fraction,
         p2p_budget_cap_ms=p2p_budget_cap_ms,
         p2p_budget_floor_ms=p2p_budget_floor_ms,
-        live_spread_s=live_spread_s)
+        live_spread_s=live_spread_s,
+        request_timeout_ms=request_timeout_ms,
+        announce_delay_s=announce_delay_s)
     return _run_swarm(config, scenario, state, n_steps)
 
 

@@ -78,7 +78,8 @@ def scenario_shardings(mesh: Mesh) -> SwarmScenario:
         leave_s=peer_vec, edge_rank=peer_vec,
         urgent_margin_s=rep, p2p_budget_fraction=rep,
         p2p_budget_cap_ms=rep, p2p_budget_floor_ms=rep,
-        live_spread_s=rep)
+        live_spread_s=rep, request_timeout_ms=rep,
+        announce_delay_s=rep)
 
 
 def shard_swarm(mesh: Mesh, scenario: SwarmScenario, state: SwarmState):

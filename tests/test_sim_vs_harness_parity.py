@@ -1,18 +1,33 @@
-"""Device sim ↔ discrete harness parity (VERDICT r1 #3).
+"""Device sim ↔ discrete harness parity (VERDICT r1 #3, r2 #5).
 
 The TPU simulator exists to sweep policy/topology at scales the
 discrete-event harness can't reach — which is only trustworthy if the
-two models agree where they overlap.  This runs the SAME small
-scenario through both: N fully-connected peers (the tracker topology),
-staggered joins, one-level ladder (removes ABR-path differences),
-shared per-peer CDN rate and seeder uplink — and requires the
-swarm-wide offload ratios to land close.
+two models agree where they overlap.  This runs the SAME scenarios
+through both: N fully-connected peers (the tracker topology),
+staggered joins, shared per-peer CDN rate and seeder uplink — VOD and
+live, one- and two-level ladders, ample through collapsed uplinks —
+and asserts QUANTITATIVE offload agreement at every point.
 
-The round-1 gap this pins down: the device sim gave every P2P
-download a flat ``p2p_bps`` regardless of seeder load, while the
-harness serializes a seeder's uplink (engine/transport.py:126-132) —
-so the sim systematically overestimated offload under tight uplinks.
+What closed the round-2 gap (±0.15 ample-only, direction-only under
+contention): the sim now models the harness's actual transfer
+anatomy —
+
+- ``max_concurrency=3``: one CDN-capable foreground + two P2P-only
+  prefetches per peer (engine/p2p_agent.py:60), prefetches landing in
+  the cache and the playback path absorbing cached segments,
+- SINGLE-holder transfers with the swarm-wide ``holders[0]`` pile-on
+  (announce order is shared, so everyone converges on the earliest
+  announcer — ops/swarm_sim.py nth_holder_only) instead of the
+  round-2 demand-split-across-all-holders fluid model, which pooled
+  uplinks the real agent never pools,
+- per-attempt request timeouts that DISCARD partial bytes
+  (engine/mesh.py:39) — the waste mechanism behind contention
+  collapse (measured: the harness uploads ~7× the bytes that count
+  as delivered P2P at 2.4 Mbps uplinks),
+- live HAVE/announce propagation lag (``announce_delay_s``).
 """
+
+from functools import lru_cache
 
 import jax.numpy as jnp
 
@@ -27,12 +42,14 @@ SEG_S = 4.0
 BITRATE = 800_000.0
 CDN_BPS = 8_000_000.0
 JOIN_SPACING_S = 6.0
+CONCURRENCY = 3  # foreground + DEFAULT_MAX_CONCURRENT_PREFETCH
 
 
-def harness_offload(uplink_bps):
+@lru_cache(maxsize=None)
+def harness_offload(uplink_bps, levels=(int(BITRATE),), cdn_bps=CDN_BPS):
     harness = SwarmHarness(seg_duration=SEG_S, frag_count=FRAGS,
-                           level_bitrates=(int(BITRATE),),
-                           cdn_bandwidth_bps=CDN_BPS)
+                           level_bitrates=levels,
+                           cdn_bandwidth_bps=cdn_bps)
     for i in range(N_PEERS):
         harness.add_peer(f"p{i}", uplink_bps=uplink_bps)
         harness.run(JOIN_SPACING_S * 1000.0)
@@ -40,53 +57,117 @@ def harness_offload(uplink_bps):
     return harness.offload_ratio
 
 
-def sim_offload(uplink_bps):
-    config = SwarmConfig(n_peers=N_PEERS, n_segments=FRAGS, n_levels=1,
-                         seg_duration_s=SEG_S)
+@lru_cache(maxsize=None)
+def sim_offload(uplink_bps, levels=(BITRATE,), cdn_bps=CDN_BPS,
+                require_finish=True):
+    config = SwarmConfig(n_peers=N_PEERS, n_segments=FRAGS,
+                         n_levels=len(levels), seg_duration_s=SEG_S,
+                         max_concurrency=CONCURRENCY)
     join = jnp.arange(N_PEERS, dtype=jnp.float32) * JOIN_SPACING_S
     uplink = jnp.full((N_PEERS,), float(uplink_bps))
-    final, _ = run_swarm(config, jnp.array([BITRATE]),
+    final, _ = run_swarm(config, jnp.array(levels),
                          full_neighbors(N_PEERS),
-                         jnp.full((N_PEERS,), CDN_BPS),
+                         jnp.full((N_PEERS,), float(cdn_bps)),
                          init_swarm(config),
-                         int(400.0 * 1000.0 / config.dt_ms), join,
+                         int(500.0 * 1000.0 / config.dt_ms), join,
                          uplink_bps=uplink)
-    # every peer must actually finish the timeline, like the harness
-    assert float(jnp.min(final.playhead_s)) >= FRAGS * SEG_S - 0.5
-    return float(offload_ratio(final))
+    if require_finish:
+        # every peer must actually finish the timeline, like the harness
+        assert float(jnp.min(final.playhead_s)) >= FRAGS * SEG_S - 0.5
+    return float(offload_ratio(final)), final
 
 
 def test_offload_parity_ample_uplink():
-    """With uplink ≫ demand both models should report the same
-    high offload for a staggered audience."""
+    """With uplink ≫ demand both models must report the same high
+    offload for a staggered audience, within 0.05 absolute (r2
+    allowed 0.15)."""
     h = harness_offload(50_000_000.0)
-    s = sim_offload(50_000_000.0)
-    assert abs(h - s) < 0.15, (h, s)
+    s, _ = sim_offload(50_000_000.0)
+    assert abs(h - s) < 0.05, (h, s)
     assert h > 0.5 and s > 0.5  # and it's genuinely a P2P-served swarm
 
 
-def test_offload_drops_under_tight_uplink_in_both_models():
-    """With seeder uplinks barely above the bitrate, contention must
-    push BOTH models' offload down substantially from their ample
-    values — the round-1 sim stayed at its ample value here.
+def test_offload_parity_collapsed_uplink_quantitative():
+    """Uplink barely above bitrate: the holders[0] pile-on saturates
+    one uplink while attempts time out and discard partials — BOTH
+    models must collapse to near-zero offload, and agree within 0.05
+    absolute.  Round 2 asserted only a ranking here; round 2's sim
+    reported 0.61 where the harness measured 0.04."""
+    h = harness_offload(1_200_000.0)
+    s, _ = sim_offload(1_200_000.0)
+    assert h < 0.1 and s < 0.1, (h, s)
+    assert abs(h - s) < 0.05, (h, s)
 
-    Point equality is NOT asserted in this regime, deliberately: past
-    the contention cliff the harness collapses harder than the sim
-    because each harness peer runs up to three concurrent transfers
-    (foreground + 2 prefetches) from its single least-loaded holder,
-    and every timed-out attempt discards its partial bytes — while
-    the sim models one download per peer spread across all holders.
-    In the supply-adequate regime (the ample test above) the two
-    agree closely; under extreme contention the sim is a documented
-    OPTIMISTIC bound, and the property a design sweep needs is that
-    both models rank the scenarios the same way."""
+
+def test_offload_parity_mid_contention():
+    """The in-between regime (uplink 3× bitrate, supply ≈ demand) is
+    the hardest to model — partial collapse driven by timeout churn.
+    Bound the divergence at 0.12 absolute (measured ≈ 0.07)."""
+    h = harness_offload(2_400_000.0)
+    s, _ = sim_offload(2_400_000.0)
+    assert abs(h - s) < 0.12, (h, s)
+    # and both models place the point strictly between the regimes
     h_ample = harness_offload(50_000_000.0)
-    s_ample = sim_offload(50_000_000.0)
-    h_tight = harness_offload(1_200_000.0)
-    s_tight = sim_offload(1_200_000.0)
-    # both models lose a meaningful share of offload to contention
-    assert h_ample - h_tight > 0.15, (h_ample, h_tight)
-    assert s_ample - s_tight > 0.15, (s_ample, s_tight)
-    # same ranking; the sim errs on the optimistic side only
-    assert s_tight >= h_tight - 0.05
-    assert s_ample >= s_tight  # tight uplink can't raise offload
+    s_ample, _ = sim_offload(50_000_000.0)
+    assert harness_offload(1_200_000.0) < h < h_ample
+    assert sim_offload(1_200_000.0)[0] < s < s_ample
+
+
+def test_live_mode_parity():
+    """Live broadcast (the harness's LiveFeeder vs config.live=True):
+    same audience, same sync target (the player's forced
+    liveSyncDuration=30, core/session.py), sim joins shifted past the
+    feeder's pre-published window so both start 30 s behind a real
+    edge.  Offload must agree within 0.10 absolute."""
+    harness = SwarmHarness(seg_duration=SEG_S, frag_count=40,
+                           level_bitrates=(int(BITRATE),),
+                           cdn_bandwidth_bps=CDN_BPS, live=True)
+    for i in range(N_PEERS):
+        harness.add_peer(f"p{i}", uplink_bps=50_000_000.0)
+        harness.run(JOIN_SPACING_S * 1000.0)
+    harness.run(180_000.0)
+    h = harness.offload_ratio
+
+    window_s = 40 * SEG_S  # feeder pre-publishes a full live window
+    config = SwarmConfig(n_peers=N_PEERS, n_segments=140, n_levels=1,
+                         seg_duration_s=SEG_S, live=True,
+                         live_sync_s=30.0, max_concurrency=CONCURRENCY,
+                         announce_delay_s=2.0)
+    join = window_s + jnp.arange(N_PEERS, dtype=jnp.float32) * JOIN_SPACING_S
+    T = int((window_s + N_PEERS * JOIN_SPACING_S + 180.0)
+            * 1000.0 / config.dt_ms)
+    final, _ = run_swarm(config, jnp.array([BITRATE]),
+                         full_neighbors(N_PEERS),
+                         jnp.full((N_PEERS,), CDN_BPS),
+                         init_swarm(config), T, join,
+                         uplink_bps=jnp.full((N_PEERS,), 50_000_000.0))
+    s = float(offload_ratio(final))
+    assert abs(h - s) < 0.10, (h, s)
+    assert h > 0.4 and s > 0.4  # live swarms genuinely offload
+
+
+def test_abr_parity_two_levels_ample():
+    """2-level ladder with an ample CDN: both models converge every
+    peer to the top level and agree on offload within 0.05."""
+    levels = (300_000, 800_000)
+    h = harness_offload(50_000_000.0, levels=levels)
+    s, final = sim_offload(50_000_000.0,
+                           levels=(300_000.0, 800_000.0))
+    assert abs(h - s) < 0.05, (h, s)
+    assert int(jnp.min(final.level)) == 1  # everyone reached the top
+
+
+def test_abr_parity_two_levels_constrained_cdn():
+    """2-level ladder with the CDN pinned just above the top bitrate
+    (0.9 Mbps): the ABR paths diverge across peers in both models —
+    some pin low, some climb — and offload agrees within 0.15
+    (measured ≈ 0.11; the residual is the harness's per-transfer
+    stat-shaping granularity vs the sim's per-step EWMA feed)."""
+    levels = (300_000, 800_000)
+    h = harness_offload(50_000_000.0, levels=levels, cdn_bps=900_000.0)
+    s, final = sim_offload(50_000_000.0, levels=(300_000.0, 800_000.0),
+                           cdn_bps=900_000.0)
+    assert abs(h - s) < 0.15, (h, s)
+    # both models must show the SPREAD: top level reachable, floor hit
+    assert int(jnp.max(final.level)) == 1
+    assert int(jnp.min(final.level)) == 0
