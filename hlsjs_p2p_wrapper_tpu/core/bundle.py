@@ -12,6 +12,7 @@ where the reference excludes Safari/mobile by user agent).
 
 from __future__ import annotations
 
+import importlib
 import platform
 
 from .utils import StaticProxyMeta, inherit_static_properties_readonly
@@ -22,9 +23,25 @@ from ..player import SimPlayer
 class P2PBundle(metaclass=StaticProxyMeta):
     """``P2PBundle(player_config, p2p_config)`` → wired player."""
 
-    #: runtimes the bundle refuses to run on (the reference's
-    #: Safari/mobile exclusion analog; extend per deployment)
-    UNSUPPORTED_RUNTIMES: frozenset = frozenset()
+    #: Runtimes the bundle refuses to run on — the reference's
+    #: Safari/mobile exclusion (bundle.js:49-60: platforms that CAN
+    #: run the player but where the P2P transport is not trusted).
+    #: The analog here: interpreters whose threading/socket fidelity
+    #: the engine's timer wheel and real-TCP fabric (engine/net.py)
+    #: have not been validated on.  Deployments extend this via
+    #: subclassing, exactly as the reference ships its own policy.
+    UNSUPPORTED_RUNTIMES: frozenset = frozenset({
+        "IronPython",    # .NET threading semantics untested
+        "Jython",        # JVM socket/timer semantics untested
+        "MicroPython",   # no full threading/select support
+    })
+
+    #: Capability probes — the feature-detection half of the
+    #: reference's gate (``Hlsjs.isSupported()`` checks MSE the same
+    #: way): modules the engine's transport and integrity layers
+    #: cannot run without.
+    REQUIRED_MODULES: tuple = ("threading", "socket", "hashlib",
+                               "struct")
 
     def __new__(cls, player_config=None, p2p_config=None):
         # Inject the bundled player class, create and bootstrap an
@@ -39,9 +56,18 @@ class P2PBundle(metaclass=StaticProxyMeta):
     @classmethod
     def is_supported(cls) -> bool:
         """Own feature detection overriding the player's
-        (bundle.js:49-60)."""
-        return (SimPlayer.is_supported()
-                and cls.get_runtime_name() not in cls.UNSUPPORTED_RUNTIMES)
+        (bundle.js:49-60): player support AND a runtime not on the
+        exclusion list AND every required capability importable."""
+        if not SimPlayer.is_supported():
+            return False
+        if cls.get_runtime_name() in cls.UNSUPPORTED_RUNTIMES:
+            return False
+        for module in cls.REQUIRED_MODULES:
+            try:
+                importlib.import_module(module)
+            except ImportError:
+                return False
+        return True
 
     @staticmethod
     def get_runtime_name() -> str:

@@ -52,6 +52,9 @@ DEFAULT_BAN_MS = 600_000.0
 #: contention that waste storm collapses the swarm to CDN.
 PACE_BACKLOG_MS = 200.0
 PACE_RETRY_MS = 50.0
+#: concurrent serves one requesting peer may hold open (foreground +
+#: prefetches + slack); excess requests are denied BUSY
+MAX_SERVES_PER_PEER = 4
 #: give up on an upload that can't make progress (partitioned peer)
 UPLOAD_TTL_MS = 30_000.0
 
@@ -340,6 +343,18 @@ class PeerMesh:
             return
         key = (src_id, msg.request_id)
         self._drop_upload(key)  # a duplicate request restarts cleanly
+        # bounded serves per requesting peer: without a cap, one
+        # handshaked peer issuing many request_ids pins a payload
+        # reference + a repeating pump timer each for up to
+        # UPLOAD_TTL_MS — a memory/timer amplification vector.  The
+        # honest downloader never needs more than its foreground +
+        # prefetch slots; excess is denied BUSY (which the requester's
+        # multi-holder failover handles like any other deny).
+        active_for_peer = sum(1 for (sid, _rid) in self._uploads
+                              if sid == src_id)
+        if active_for_peer >= MAX_SERVES_PER_PEER:
+            self._send(src_id, P.Deny(msg.request_id, P.DenyReason.BUSY))
+            return
         self._uploads[key] = _Upload(src_id, msg.request_id, payload,
                                      self.clock.now() + UPLOAD_TTL_MS)
         self._pump_upload(key)
@@ -357,7 +372,12 @@ class PeerMesh:
             del self._uploads[key]  # peer unreachable; stop retrying
             return
         total = len(upload.payload)
-        backlog = getattr(self.endpoint, "backlog_ms", lambda: 0.0)
+        # per-destination where the fabric distinguishes links (TCP:
+        # one stalled peer must not head-of-line-block other serves);
+        # the loopback fabric ignores the argument (one shared uplink)
+        backlog_fn = getattr(self.endpoint, "backlog_ms", None)
+        backlog = ((lambda: backlog_fn(upload.src_id))
+                   if backlog_fn is not None else (lambda: 0.0))
         while upload.offset < total and backlog() < PACE_BACKLOG_MS:
             piece = upload.payload[upload.offset:
                                    upload.offset + self.chunk_bytes]
@@ -449,6 +469,11 @@ class PeerMesh:
     def _on_deny(self, src_id: str, msg: P.Deny) -> None:
         download = self._downloads.get(msg.request_id)
         if download is None or download.peer_id != src_id:
+            return
+        if msg.reason == P.DenyReason.BUSY:
+            # transient overload: the peer still HAS the key — keep
+            # the holder knowledge so failover can come back later
+            self._fail_download(msg.request_id, {"status": 503})
             return
         # a denying peer can't serve this key now — stop asking it
         state = self.peers.get(src_id)

@@ -138,6 +138,11 @@ class _Connection:
 
     MAX_QUEUED_FRAMES = 4096
 
+    #: drain-rate assumption before any send completes (connection
+    #: still connecting / first frame in flight): pessimistic enough
+    #: that a connect stall registers as backlog and pauses pacing
+    ASSUMED_DRAIN_BPS = 8_000_000.0
+
     def __init__(self, endpoint: "TcpEndpoint", remote_id: str,
                  sock: Optional[socket.socket] = None):
         self.endpoint = endpoint
@@ -145,6 +150,9 @@ class _Connection:
         self.sock = sock  # None → outbound; writer thread connects
         self.closed = False
         self._queue: list = []
+        self._queued_bytes = 0   # enqueued but not yet handed to the OS
+        self._drain_bps = 0.0    # EWMA of observed sendall throughput
+        self._send_started: Optional[float] = None  # in-flight sendall t0
         self._cond = threading.Condition()
         self._writer = threading.Thread(target=self._write_loop, daemon=True,
                                         name=f"p2p-writer-{remote_id}")
@@ -163,8 +171,34 @@ class _Connection:
             if self.closed or len(self._queue) >= self.MAX_QUEUED_FRAMES:
                 return False
             self._queue.append(frame)
+            self._queued_bytes += len(frame)
             self._cond.notify()
             return True
+
+    def backlog_ms(self) -> float:
+        """Estimated time for the unsent queue to drain, from the
+        observed ``sendall`` throughput (the OS absorbs sends at
+        link speed until its buffers fill, so the EWMA converges on
+        the real bottleneck rate once the socket pushes back).
+        Before any send completes, a pessimistic assumed rate makes a
+        connect stall register as backlog.
+
+        The EWMA alone is blind to a HARD stall: it only updates when
+        a send completes, so a receiver that stops reading after the
+        connection warmed up would leave a stale multi-Gbps estimate
+        while ``sendall`` blocks.  The in-flight send's own elapsed
+        time is therefore a floor on the reported backlog — a blocked
+        send reads as backlog within one pacing interval."""
+        with self._cond:
+            queued = self._queued_bytes
+            started = self._send_started
+        stall_ms = ((time.monotonic() - started) * 1000.0
+                    if started is not None else 0.0)
+        if queued <= 0:
+            return stall_ms
+        rate = self._drain_bps if self._drain_bps > 0 else \
+            self.ASSUMED_DRAIN_BPS
+        return max(queued * 8.0 / rate * 1000.0, stall_ms)
 
     def _write_loop(self) -> None:
         if self.sock is None:
@@ -195,12 +229,23 @@ class _Connection:
                 if self.closed:
                     return
                 frame = self._queue.pop(0)
+                self._send_started = time.monotonic()
             try:
+                t0 = self._send_started
                 self.sock.sendall(_LEN.pack(len(frame)) + frame)
+                elapsed = time.monotonic() - t0
                 self.endpoint.bytes_sent += len(frame)
             except OSError:
                 self.close()
                 return
+            with self._cond:
+                self._send_started = None
+                self._queued_bytes -= len(frame)
+            if elapsed > 0.0:
+                inst_bps = len(frame) * 8.0 / elapsed
+                self._drain_bps = (inst_bps if self._drain_bps == 0.0
+                                   else 0.8 * self._drain_bps
+                                   + 0.2 * inst_bps)
 
     def _connect_with_preamble(self) -> Optional[socket.socket]:
         try:
@@ -219,6 +264,8 @@ class _Connection:
                 return
             self.closed = True
             self._queue.clear()
+            self._queued_bytes = 0
+            self._send_started = None
             self._cond.notify_all()
         if self.sock is not None:
             try:
@@ -286,6 +333,24 @@ class TcpEndpoint:
         self.peer_id = f"{host}:{self._listener.getsockname()[1]}"
         threading.Thread(target=self._accept_loop, daemon=True,
                          name=f"p2p-accept-{self.peer_id}").start()
+
+    def backlog_ms(self, dest_id: Optional[str] = None) -> float:
+        """Uplink backlog estimate for the mesh's serve pacing
+        (engine/mesh.py _pump_upload) — previously only the loopback
+        fabric implemented this, silently disabling pacing on real
+        sockets and letting a whole segment burst into the write
+        queue where CANCEL could no longer reclaim it.
+
+        With ``dest_id``, reports that destination's OWN link (TCP
+        links drain independently, so one stalled peer must not
+        head-of-line-block serves to healthy ones); without, the
+        most-backlogged link."""
+        with self._conn_lock:
+            if dest_id is not None:
+                conn = self._conns.get(dest_id)
+                return conn.backlog_ms() if conn is not None else 0.0
+            conns = list(self._conns.values()) + list(self._extra_conns)
+        return max((conn.backlog_ms() for conn in conns), default=0.0)
 
     # -- outbound ------------------------------------------------------
     def send(self, dest_id: str, frame: bytes) -> bool:
@@ -411,6 +476,10 @@ class TcpNetwork:
     peer id is ignored — on a real fabric the listener address IS the
     identity; callers must adopt ``endpoint.peer_id``."""
 
+    #: minimum seconds between resolver refreshes per claimed host
+    #: (bounds attacker-driven DNS traffic; see _host_matches)
+    RESOLVE_REFRESH_S = 30.0
+
     def __init__(self, host: str = "127.0.0.1",
                  loop: Optional[NetLoop] = None,
                  verify_inbound_host: bool = True):
@@ -423,7 +492,8 @@ class TcpNetwork:
         #: a peer's outbound source address legitimately differs from
         #: its listener address.
         self.verify_inbound_host = verify_inbound_host
-        self._resolve_cache: Dict[str, frozenset] = {}
+        #: claimed-host → (resolved addresses, refresh timestamp)
+        self._resolve_cache: Dict[str, tuple] = {}
         self._resolve_lock = threading.Lock()
         self._endpoints: list = []
         self._endpoints_lock = threading.Lock()
@@ -432,23 +502,38 @@ class TcpNetwork:
         """Does the claimed listener host resolve to the observed
         remote address?  Runs on a per-handshake thread, so the
         (cached) blocking DNS lookup never stalls the dispatch loop.
-        Unresolvable claims are rejected."""
+        Unresolvable claims are rejected.
+
+        A cached MISS re-resolves before rejecting — a host that
+        legitimately re-resolves to a new address (DNS change, lease
+        renewal) must not be rejected for the process lifetime on
+        stale cache, the mirror image of the failure-caching hazard
+        below — but at most once per RESOLVE_REFRESH_S per hostname:
+        without that bound, an attacker flooding handshakes with a
+        never-matching claimed host would drive one blocking resolver
+        call per connection."""
         if claimed_host == observed_host:
             return True
+        now = time.monotonic()
         with self._resolve_lock:
-            addrs = self._resolve_cache.get(claimed_host)
-        if addrs is None:
-            try:
-                infos = socket.getaddrinfo(claimed_host, None)
-                addrs = frozenset(info[4][0] for info in infos)
-            except OSError:
-                # do NOT cache failures: one transient resolver hiccup
-                # must not permanently reject every inbound connection
-                # claiming this host for the process lifetime
-                return False
-            with self._resolve_lock:
-                self._resolve_cache[claimed_host] = addrs
-        return observed_host in addrs
+            cached = self._resolve_cache.get(claimed_host)
+        if cached is not None:
+            addrs, refreshed_at = cached
+            if observed_host in addrs:
+                return True
+            if now - refreshed_at < self.RESOLVE_REFRESH_S:
+                return False  # recently refreshed: a real mismatch
+        try:
+            infos = socket.getaddrinfo(claimed_host, None)
+            fresh = frozenset(info[4][0] for info in infos)
+        except OSError:
+            # do NOT cache failures: one transient resolver hiccup
+            # must not permanently reject every inbound connection
+            # claiming this host for the process lifetime
+            return False
+        with self._resolve_lock:
+            self._resolve_cache[claimed_host] = (fresh, now)
+        return observed_host in fresh
 
     def register(self, peer_id: Optional[str] = None,
                  uplink_bps: Optional[float] = None) -> TcpEndpoint:

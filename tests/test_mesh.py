@@ -388,3 +388,43 @@ def test_forged_chunk_total_bounded_by_cache_budget(duo):
     mesh_b.endpoint.send("a", evil_frame)
     clock.advance(6.0)  # evil frame (t=5) lands before b's serve (t=10)
     assert errors == [{"status": 0}]
+
+
+def test_per_peer_serve_cap_denies_excess():
+    """One requester may hold at most MAX_SERVES_PER_PEER concurrent
+    serves; excess distinct request_ids are denied BUSY instead of
+    each pinning a payload + pump timer for UPLOAD_TTL_MS (the
+    memory/timer amplification vector)."""
+    from hlsjs_p2p_wrapper_tpu.engine.mesh import MAX_SERVES_PER_PEER
+
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    mesh_a, cache_a = make_mesh(net, clock, "a")
+    # throttle b's uplink so serves stay open instead of completing
+    # within one dispatch round
+    endpoint_b = net.register("b", uplink_bps=100_000.0)
+    cache_b = SegmentCache(max_bytes=1 << 22)
+    mesh_b = PeerMesh(endpoint_b, "s", clock, cache_b)
+    endpoint_b.on_receive = \
+        lambda src, frame: mesh_b.handle_frame(src, P.decode(frame))
+    payload = bytes(200_000)
+    for sn in range(1, MAX_SERVES_PER_PEER + 2):
+        cache_b.put(key(sn), payload)
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+
+    denies = []
+    results = []
+    for sn in range(1, MAX_SERVES_PER_PEER + 2):
+        mesh_a.request("b", key(sn),
+                       on_success=lambda p, sn=sn: results.append(sn),
+                       on_error=lambda e, sn=sn: denies.append((sn, e)))
+    # long enough for the Deny to drain past the paced chunk queue,
+    # short enough that the capped serves haven't timed out yet
+    clock.advance(2_000.0)
+    # the cap held: exactly one excess request was denied...
+    assert len(mesh_b._uploads) == MAX_SERVES_PER_PEER
+    assert denies == [(MAX_SERVES_PER_PEER + 1, {"status": 503})]
+    # ...and BUSY is transient: the requester keeps its knowledge
+    # that b holds the key, so failover can come back later
+    assert "b" in mesh_a.holders_of(key(MAX_SERVES_PER_PEER + 1))

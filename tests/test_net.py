@@ -326,3 +326,69 @@ def test_cross_process_swarm():
         child.stdin.close()
         child.wait(timeout=10)
         net.close()
+
+
+def test_tcp_backlog_registers_unsent_bytes(net):
+    """ADVICE r2 #1: TcpEndpoint must implement backlog_ms — without
+    it the mesh's getattr fallback returned 0.0 forever and serve
+    pacing was silently disabled on the real-socket fabric."""
+    from hlsjs_p2p_wrapper_tpu.engine.net import _Connection
+
+    endpoint = net.register()
+    try:
+        assert endpoint.backlog_ms() == 0.0  # idle: nothing queued
+        # a connection whose writer hasn't drained anything yet:
+        # queued bytes must register as positive backlog under the
+        # pessimistic assumed rate (a connect stall looks like this)
+        conn = _Connection(endpoint, "10.255.255.1:1")  # writer not started
+        with endpoint._conn_lock:
+            endpoint._conns["10.255.255.1:1"] = conn
+        conn.enqueue(b"x" * 100_000)
+        assert conn.backlog_ms() > 0.0
+        assert endpoint.backlog_ms() == conn.backlog_ms()
+        # the mesh's pacing hook resolves to the real method now
+        assert getattr(endpoint, "backlog_ms", None) is not None
+        conn.close()
+        assert endpoint.backlog_ms() == 0.0  # close reclaims the queue
+    finally:
+        endpoint.close()
+
+
+def test_resolve_cache_refreshes_on_mismatch(monkeypatch):
+    """ADVICE r2 #3: a peer whose hostname legitimately re-resolves
+    to a new address must not be rejected forever on a stale cache
+    entry — a mismatch triggers one fresh resolution."""
+    import socket as socket_mod
+
+    from hlsjs_p2p_wrapper_tpu.engine.net import TcpNetwork
+
+    network = TcpNetwork()
+    try:
+        answers = [
+            [(0, 0, 0, "", ("10.0.0.1", 0))],   # first lease
+            [(0, 0, 0, "", ("10.0.0.2", 0))],   # host moved
+        ]
+        calls = []
+
+        def fake_getaddrinfo(host, port):
+            calls.append(host)
+            return answers[min(len(calls) - 1, len(answers) - 1)]
+
+        monkeypatch.setattr(socket_mod, "getaddrinfo", fake_getaddrinfo)
+        # cache warms on the first lease...
+        assert network._host_matches("peer.example", "10.0.0.1") is True
+        # ...a mismatch inside the refresh window is rejected WITHOUT
+        # a resolver call (bounds attacker-driven DNS traffic)
+        assert network._host_matches("peer.example", "10.0.0.2") is False
+        assert len(calls) == 1
+        # once the window passes, the stale entry refreshes and the
+        # host's new address is accepted instead of rejected forever
+        addrs, refreshed_at = network._resolve_cache["peer.example"]
+        network._resolve_cache["peer.example"] = (
+            addrs, refreshed_at - network.RESOLVE_REFRESH_S - 1.0)
+        assert network._host_matches("peer.example", "10.0.0.2") is True
+        assert len(calls) == 2
+        # and a genuinely wrong address still gets rejected
+        assert network._host_matches("peer.example", "10.9.9.9") is False
+    finally:
+        network.close()
