@@ -479,6 +479,12 @@ class TcpNetwork:
     #: minimum seconds between resolver refreshes per claimed host
     #: (bounds attacker-driven DNS traffic; see _host_matches)
     RESOLVE_REFRESH_S = 30.0
+    #: global resolver budget per RESOLVE_REFRESH_S window — the
+    #: per-host limit alone is bypassable by varying the claimed
+    #: host, so total lookups are token-bucketed too
+    MAX_RESOLVES_PER_WINDOW = 32
+    #: bound on distinct cached hostnames (attacker-claimable state)
+    MAX_RESOLVE_CACHE = 1024
 
     def __init__(self, host: str = "127.0.0.1",
                  loop: Optional[NetLoop] = None,
@@ -495,6 +501,8 @@ class TcpNetwork:
         #: claimed-host → (resolved addresses, refresh timestamp)
         self._resolve_cache: Dict[str, tuple] = {}
         self._resolve_lock = threading.Lock()
+        self._resolve_window_start = 0.0
+        self._resolve_window_count = 0
         self._endpoints: list = []
         self._endpoints_lock = threading.Lock()
 
@@ -508,21 +516,32 @@ class TcpNetwork:
         legitimately re-resolves to a new address (DNS change, lease
         renewal) must not be rejected for the process lifetime on
         stale cache, the mirror image of the failure-caching hazard
-        below — but at most once per RESOLVE_REFRESH_S per hostname:
-        without that bound, an attacker flooding handshakes with a
-        never-matching claimed host would drive one blocking resolver
-        call per connection."""
+        below.  Resolver traffic is bounded on TWO axes: at most one
+        refresh per RESOLVE_REFRESH_S per hostname, AND at most
+        MAX_RESOLVES_PER_WINDOW lookups per window in total (the
+        per-host limit alone is bypassable by flooding handshakes
+        with ever-changing claimed hosts); the cache itself is
+        size-capped for the same reason.  Over budget → reject
+        without resolving: under attack, unverifiable claims fail
+        closed."""
         if claimed_host == observed_host:
             return True
         now = time.monotonic()
         with self._resolve_lock:
             cached = self._resolve_cache.get(claimed_host)
-        if cached is not None:
-            addrs, refreshed_at = cached
-            if observed_host in addrs:
-                return True
-            if now - refreshed_at < self.RESOLVE_REFRESH_S:
-                return False  # recently refreshed: a real mismatch
+            if cached is not None:
+                addrs, refreshed_at = cached
+                if observed_host in addrs:
+                    return True
+                if now - refreshed_at < self.RESOLVE_REFRESH_S:
+                    return False  # recently refreshed: a real mismatch
+            # global token bucket, charged BEFORE the blocking lookup
+            if now - self._resolve_window_start >= self.RESOLVE_REFRESH_S:
+                self._resolve_window_start = now
+                self._resolve_window_count = 0
+            if self._resolve_window_count >= self.MAX_RESOLVES_PER_WINDOW:
+                return False  # resolver budget exhausted: fail closed
+            self._resolve_window_count += 1
         try:
             infos = socket.getaddrinfo(claimed_host, None)
             fresh = frozenset(info[4][0] for info in infos)
@@ -532,6 +551,13 @@ class TcpNetwork:
             # claiming this host for the process lifetime
             return False
         with self._resolve_lock:
+            if (claimed_host not in self._resolve_cache
+                    and len(self._resolve_cache) >= self.MAX_RESOLVE_CACHE):
+                # evict the stalest entry: bounded attacker-claimable
+                # state, and the evictee is the least likely to recur
+                oldest = min(self._resolve_cache,
+                             key=lambda h: self._resolve_cache[h][1])
+                del self._resolve_cache[oldest]
             self._resolve_cache[claimed_host] = (fresh, now)
         return observed_host in fresh
 

@@ -6,16 +6,17 @@ from .swarm_sim import (SwarmConfig, SwarmScenario, SwarmState,
                         full_neighbors, full_offsets, init_swarm,
                         invert_neighbors, isolated_neighbors,
                         make_scenario, neighbors_from_adjacency,
-                        offload_ratio, rebuffer_ratio, ring_neighbors,
-                        ring_offsets, run_swarm, stable_ranks,
-                        staggered_joins, step_flops, step_hbm_bytes,
-                        swarm_step)
+                        offload_ratio, packed_words, rebuffer_ratio,
+                        ring_neighbors, ring_offsets, run_swarm,
+                        stable_ranks, staggered_joins, step_flops,
+                        step_hbm_bytes, swarm_step, unpack_avail)
 
 __all__ = ["EwmaState", "get_estimate", "init_state", "scan_samples",
            "update", "SwarmConfig", "SwarmScenario", "SwarmState",
            "full_neighbors", "full_offsets", "init_swarm",
            "invert_neighbors", "isolated_neighbors", "make_scenario",
            "neighbors_from_adjacency", "offload_ratio",
-           "rebuffer_ratio", "ring_neighbors", "ring_offsets",
-           "run_swarm", "stable_ranks", "staggered_joins", "step_flops",
-           "step_hbm_bytes", "swarm_step"]
+           "packed_words", "rebuffer_ratio", "ring_neighbors",
+           "ring_offsets", "run_swarm", "stable_ranks",
+           "staggered_joins", "step_flops", "step_hbm_bytes",
+           "swarm_step", "unpack_avail"]

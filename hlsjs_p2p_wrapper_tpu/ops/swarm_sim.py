@@ -255,7 +255,13 @@ class SwarmState(NamedTuple):
     rebuffer_s: jax.Array      # [P] f32
     level: jax.Array           # [P] i32 current ABR choice
     ewma: EwmaState            # fields [P] f32
-    avail: jax.Array           # [P, L, S] u8 0/1 cache map
+    #: BIT-PACKED cache map: [P, ceil(L·S/32)] u32, bit (l·S + s) of
+    #: row i set ⇔ peer i holds (level l, segment s).  Packing cuts
+    #: the eligibility stencil's dominant HBM traffic 8× vs a u8 map
+    #: (each pass streams 1 bit/cell instead of 1 byte) and shrinks
+    #: swarm state enough for million-peer scenarios.  Read it
+    #: through :func:`unpack_avail`.
+    avail: jax.Array
     cdn_bytes: jax.Array       # [P] f32
     p2p_bytes: jax.Array       # [P] f32
     # transfer slots, all [P, C] (C = config.max_concurrency; slot 0
@@ -270,8 +276,26 @@ class SwarmState(NamedTuple):
     dl_budget_ms: jax.Array    # [P, C] f32 P2P budget before CDN failover
 
 
-def init_swarm(config: SwarmConfig) -> SwarmState:
+def packed_words(config: SwarmConfig) -> int:
+    """u32 words per peer in the bit-packed cache map."""
+    return -(-(config.n_levels * config.n_segments) // 32)
+
+
+def unpack_avail(state: SwarmState, config: SwarmConfig) -> jax.Array:
+    """Expand the bit-packed cache map to a ``[P, L, S]`` u8 0/1
+    array (analysis/test convenience; the step never materializes
+    this)."""
     P, L, S = config.n_peers, config.n_levels, config.n_segments
+    words = state.avail  # [P, W] u32
+    bit = jnp.arange(L * S, dtype=jnp.uint32)
+    word_idx = (bit >> 5).astype(jnp.int32)
+    mask = jnp.uint32(1) << (bit & 31)
+    cells = (words[:, word_idx] & mask[None, :]) != 0
+    return cells.astype(jnp.uint8).reshape(P, L, S)
+
+
+def init_swarm(config: SwarmConfig) -> SwarmState:
+    P = config.n_peers
     C = config.max_concurrency
     f0 = jnp.zeros((P,), jnp.float32)
     i0 = jnp.zeros((P,), jnp.int32)
@@ -281,7 +305,8 @@ def init_swarm(config: SwarmConfig) -> SwarmState:
     return SwarmState(
         t_s=jnp.zeros((), jnp.float32),
         playhead_s=f0, buffer_s=f0, rebuffer_s=f0, level=i0,
-        ewma=init_state(P), avail=jnp.zeros((P, L, S), jnp.uint8),
+        ewma=init_state(P),
+        avail=jnp.zeros((P, packed_words(config)), jnp.uint32),
         cdn_bytes=f0, p2p_bytes=f0, dl_active=bc, dl_is_p2p=bc,
         dl_seg=ic, dl_level=ic, dl_done_bytes=fc, dl_total_bytes=fc,
         dl_elapsed_ms=fc, dl_budget_ms=fc)
@@ -336,17 +361,18 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                                <= t)
 
     # ---- 2. eligibility machinery -----------------------------------
-    avail_flat = state.avail.reshape(P, L * S)
+    avail_p = state.avail                       # [P, W] u32 bit-packed
     circulant = config.neighbor_offsets is not None
-    col = jnp.arange(L * S, dtype=next_seg.dtype)
+    wcol = jnp.arange(packed_words(config), dtype=jnp.int32)
     if circulant:
         # circulant fast path: neighbor k of peer i is (i + off_k) %
         # P, so "what does my k-th neighbor have" is a static ROW
-        # SHIFT of the (availability · presence) map, contracted
-        # against the one-hot of each peer's segment of interest —
-        # K stencil passes, zero gathers (see neighbor_offsets doc)
+        # SHIFT of the (availability · presence) bitmap, ANDed with
+        # the one-hot BIT of each peer's segment of interest — K
+        # stencil passes over 1 bit/cell, zero gathers (see
+        # neighbor_offsets doc)
         offs = _normalized_offsets(config.neighbor_offsets, P)
-        AP = avail_flat * present.astype(jnp.uint8)[:, None]  # [P, L·S]
+        AP = jnp.where(present[:, None], avail_p, jnp.uint32(0))
     else:
         # general [P, K] neighbor-list path (arbitrary topologies):
         # XLA gathers — correct everywhere, ~50× slower per edge on
@@ -358,19 +384,24 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         present_nbr = present.astype(jnp.float32)[nbr]       # [P, K]
 
     def eligibility(gi_flat):
-        """(one-hot W, per-edge eligibility, holder count) for each
-        peer's [P] flat (level, seg) target."""
-        W = (col[None, :] == gi_flat[:, None]).astype(jnp.uint8)
+        """(one-hot bit mask, per-edge eligibility, holder count) for
+        each peer's [P] flat (level, seg) target."""
+        word_idx = gi_flat >> 5                              # [P] i32
+        bitmask = jnp.uint32(1) << (gi_flat & 31).astype(jnp.uint32)
+        Wm = jnp.where(wcol[None, :] == word_idx[:, None],
+                       bitmask[:, None], jnp.uint32(0))      # [P, W]
         if circulant:
-            elig = [jnp.sum(jnp.roll(AP, -o, axis=0) * W, axis=1,
+            elig = [jnp.sum((jnp.roll(AP, -o, axis=0) & Wm) != 0,
+                            axis=1,
                             dtype=jnp.int32).astype(jnp.float32)
                     for o in offs]                           # K × [P]
             n = sum(elig) if elig else zeros
         else:
-            have = avail_flat[nbr, gi_flat[:, None]]         # [P, K] u8
+            got = avail_p[nbr, word_idx[:, None]]            # [P, K] u32
+            have = (got & bitmask[:, None]) != 0
             elig = nbr_valid * have.astype(jnp.float32) * present_nbr
             n = jnp.sum(elig, axis=1)
-        return W, elig, n
+        return Wm, elig, n
 
     def nth_holder_only(elig, skip: int):
         """Restrict eligibility to the single (skip+1)-th-lowest-id
@@ -412,10 +443,10 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
             prev = jnp.where(nxt < big, nxt, prev)
         return (pos & (nbr == prev[:, None])).astype(jnp.float32)
 
-    def own_cache(W):
-        """Does each peer already hold its own target? (u8 one-hot
-        contraction — the local cache-hit check for absorb/prefetch)"""
-        return jnp.sum(avail_flat * W, axis=1, dtype=jnp.int32) > 0
+    def own_cache(Wm):
+        """Does each peer already hold its own target? (bit test —
+        the local cache-hit check for absorb/prefetch)"""
+        return jnp.any((avail_p & Wm) != 0, axis=1)
 
     # ---- start decisions (engine/scheduler.py decide()) -------------
     # margin = playback slack until the wanted segment is needed
@@ -594,7 +625,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         for s in slots:
             s["svc"] = jnp.sum(s["elig"] * svc_nbr, axis=1)
 
-    insert = jnp.zeros_like(avail_flat)
+    insert = jnp.zeros_like(avail_p)
     ewma = state.ewma
     cdn_bytes = state.cdn_bytes
     p2p_bytes = state.p2p_bytes
@@ -637,13 +668,13 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
             done = jnp.where(aborted, 0.0, done)
             elapsed = jnp.where(aborted, 0.0, elapsed)
             p2p_bytes = p2p_bytes + jnp.where(completed, s["total"], 0.0)
-        # cache insert: one-hot row max instead of a scatter — touches
-        # the whole [P, L·S] map but runs at vector throughput; TPU
+        # cache insert: one-hot bit OR instead of a scatter — touches
+        # the whole packed bitmap but runs at vector throughput; TPU
         # scatter serializes its updates.  A slot can only complete
-        # the transfer it was gathered on, so its eligibility one-hot
-        # IS the insert position.
-        insert = jnp.maximum(insert,
-                             s["W"] * completed.astype(jnp.uint8)[:, None])
+        # the transfer it was gathered on, so its eligibility bit
+        # mask IS the insert position.
+        insert = insert | jnp.where(completed[:, None], s["W"],
+                                    jnp.uint32(0))
         # estimator feeds on real (duration, bytes) pairs — both
         # foreground transfers and prefetches, matching the loader's
         # trequest back-dating contract for instant cache hits
@@ -662,7 +693,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         new_cols["total"].append(s["total"])
         new_cols["budget"].append(s["budget"])
 
-    avail = jnp.maximum(avail_flat, insert).reshape(state.avail.shape)
+    avail = avail_p | insert
     buffer_s = state.buffer_s + buffer_add
 
     # ---- 4. playback ------------------------------------------------
@@ -769,49 +800,58 @@ def step_flops(config: SwarmConfig, n_neighbors: int = 8) -> float:
     ops, and the O(P·L) ABR fit.  Used by bench.py for achieved-FLOPs
     reporting — honestly tiny relative to the MXU peak: the sparse
     step is memory-bound, not FLOPs-bound.  On the circulant fast
-    path the eligibility term is the K stencil passes' multiply-add
-    over the [P, L·S] map (2·P·L·S·K) rather than 7·P·K."""
-    P, L, S = config.n_peers, config.n_levels, config.n_segments
+    path the eligibility term is the K stencil passes' AND +
+    zero-test over the PACKED [P, ⌈L·S/32⌉] bitmap (2·P·W·K word
+    ops) rather than 7·P·K — and both run once per transfer slot
+    (C = max_concurrency), matching :func:`step_hbm_bytes`."""
+    P, L = config.n_peers, config.n_levels
+    W = packed_words(config)
+    C = config.max_concurrency
     K = n_neighbors
     if config.neighbor_offsets is not None:
         K = len(_normalized_offsets(config.neighbor_offsets, P))
-        elig = 2.0 * P * L * S * K
+        elig = 2.0 * P * W * K * C
     else:
-        elig = 7.0 * P * K
-    return elig + 2.0 * P * L * S + 60.0 * P + 2.0 * P * L
+        elig = 7.0 * P * K * C
+    return elig + 2.0 * P * W + 60.0 * P + 2.0 * P * L
 
 
 def step_hbm_bytes(config: SwarmConfig, n_neighbors: int = 8) -> float:
     """Analytic main-memory traffic per step.
 
     Circulant fast path (``neighbor_offsets`` set): each of the K
-    eligibility stencil passes streams the u8 (availability·presence)
-    map and the u8 one-hot (1 byte/element each over [P, L·S]), and
-    the cache insert reads + rewrites the map — 2·P·L·S·(K + 1) total,
-    deliberately traded for TPU-friendliness over per-element
-    gather/scatter (which measure ~50× slower per edge,
-    tools/profile_kernels.py).  General path: the O(P·K) edge
-    gathers dominate instead.  Both add per-peer state (17 f32/i32
-    [P] fields + 4 EWMA leaves, read and written each step as the
-    scan carry) and scenario reads.
+    eligibility stencil passes streams the BIT-PACKED
+    (availability·presence) map and the one-hot bit mask (4 bytes per
+    u32 word each over [P, ⌈L·S/32⌉]), and the cache insert reads +
+    rewrites the packed map — 8·P·W·(K·C + 1) total (C =
+    max_concurrency transfer slots, each running its own eligibility
+    pass), 8× less than the u8 formulation and deliberately traded for
+    TPU-friendliness over per-element gather/scatter (which measure
+    ~50× slower per edge, tools/profile_kernels.py).  General path:
+    the O(P·K) edge gathers dominate instead.  Both add per-peer
+    state (17 f32/i32 [P] fields + 4 EWMA leaves + C transfer-slot
+    columns, read and written each step as the scan carry) and
+    scenario reads.
 
     This model counts only algorithmically-required traffic (perfect
     fusion); fusion-boundary spills make the REAL traffic higher, so
     the reported ``hbm_util`` is a lower bound on how hard the
     memory system is actually working."""
-    P, L, S = config.n_peers, config.n_levels, config.n_segments
-    state_rw = 2.0 * 21.0 * 4.0 * P
+    P = config.n_peers
+    W = packed_words(config)
+    C = config.max_concurrency
+    state_rw = 2.0 * (13.0 + 8.0 * C) * 4.0 * P
     scenario_reads = 5.0 * 4.0 * P
-    cache_onehot = 2.0 * P * L * S          # u8 map read + rewritten
+    cache_insert = 2.0 * 4.0 * P * W        # packed map read + rewritten
     if config.neighbor_offsets is not None:
         K = len(_normalized_offsets(config.neighbor_offsets, P))
-        elig = 2.0 * P * L * S * K          # K × (AP + one-hot) u8
+        elig = 2.0 * 4.0 * P * W * K * C    # K × (AP + bit mask) u32
         edges = 0.0
     else:
         K = n_neighbors
-        elig = 1.0 * P * K                  # u8 availability gather
-        edges = 2.0 * 4.0 * P * K + 3.0 * 4.0 * P * K
-    return cache_onehot + elig + edges + state_rw + scenario_reads
+        elig = 4.0 * P * K * C              # u32 word gather
+        edges = (2.0 * 4.0 * P * K + 3.0 * 4.0 * P * K) * C
+    return cache_insert + elig + edges + state_rw + scenario_reads
 
 
 def invert_neighbors(neighbors) -> jnp.ndarray:

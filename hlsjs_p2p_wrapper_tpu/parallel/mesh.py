@@ -44,12 +44,17 @@ def make_mesh(devices: Optional[Sequence] = None,
 
 def state_shardings(mesh: Mesh) -> SwarmState:
     """A ``SwarmState``-shaped pytree of NamedShardings: per-peer
-    vectors shard over the peer axis; the cache map shards peers x
-    segments; estimator state follows its [P] leaves."""
+    vectors (and the [P, C] transfer slots) shard over the peer axis.
+    The bit-packed cache map shards over peers ONLY: packing shrank
+    the per-peer row to ⌈L·S/32⌉ u32 words (≤ ~100 bytes even for
+    very long timelines), so splitting it buys nothing and its word
+    count is not generally divisible by a mesh axis.  The ``segments``
+    mesh axis remains for workloads that add genuinely segment-major
+    state."""
     from ..ops.ewma import EwmaState
     peer_vec = NamedSharding(mesh, P(PEER_AXIS))
     scalar = NamedSharding(mesh, P())
-    avail = NamedSharding(mesh, P(PEER_AXIS, None, SEGMENT_AXIS))
+    avail = NamedSharding(mesh, P(PEER_AXIS, None))
     return SwarmState(
         t_s=scalar,
         playhead_s=peer_vec, buffer_s=peer_vec, rebuffer_s=peer_vec,

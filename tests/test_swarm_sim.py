@@ -15,7 +15,7 @@ from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (NEVER_S, SwarmConfig,
                                                  rebuffer_ratio,
                                                  ring_neighbors,
                                                  ring_offsets, run_swarm,
-                                                 stable_ranks)
+                                                 stable_ranks, unpack_avail)
 from hlsjs_p2p_wrapper_tpu.parallel import make_mesh, sharded_run
 
 BITRATES = jnp.array([300_000.0, 800_000.0, 2_000_000.0])
@@ -113,7 +113,7 @@ def test_byte_accounting_consistent():
     total = float(jnp.sum(final.cdn_bytes) + jnp.sum(final.p2p_bytes))
     # every completed segment contributed its exact ladder size
     seg_bytes = BITRATES * config.seg_duration_s / 8.0
-    completions = float(jnp.sum(final.avail * 1.0))
+    completions = float(jnp.sum(unpack_avail(final, config) * 1.0))
     expected_min = completions * float(seg_bytes[0])
     expected_max = completions * float(seg_bytes[-1])
     assert expected_min <= total <= expected_max
@@ -267,7 +267,8 @@ def test_live_mode_respects_publish_times():
                          steps_for(config, 60.0))
     S = config.n_segments
     published = int(60.0 / config.seg_duration_s)
-    cached_segs = jnp.any(final.avail > 0, axis=(0, 1))  # [S]
+    cached_segs = jnp.any(unpack_avail(final, config) > 0,
+                          axis=(0, 1))  # [S]
     assert not bool(jnp.any(cached_segs[published:]))
     # viewers track the edge: playheads advanced with the broadcast
     assert float(jnp.min(final.playhead_s)) > 30.0

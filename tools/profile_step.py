@@ -76,12 +76,21 @@ def main():
     sc = scanned(lambda c: c + jnp.zeros((P,)).at[nbr].add(contrib))
     timeit(f"scatter-add load x{T}", sc, jnp.zeros((P,)))
 
-    # 5. cache scatter: P updates into [P, L, S] u8
-    pidx = jnp.arange(P)
-    lvl = jnp.zeros((P,), jnp.int32)
-    seg = jax.random.randint(key, (P,), 0, S)
-    cs = scanned(lambda c: c.at[pidx, lvl, seg].max(jnp.uint8(1)))
-    timeit(f"cache-map scatter x{T}", cs, state.avail)
+    # 5. cache insert: one-hot bit OR into the packed [P, W] u32 map
+    # (what the step actually does; scatter variants are in
+    # tools/profile_kernels.py).  The mask derives from the carry so
+    # XLA cannot hoist it out of the scan.
+    W = state.avail.shape[1]
+    wcol = jnp.arange(W, dtype=jnp.int32)
+
+    def packed_insert(c):
+        widx = (c[:, 0] % jnp.uint32(W)).astype(jnp.int32)
+        bit = jnp.uint32(1) << (c[:, -1] % jnp.uint32(32))
+        mask = jnp.where(wcol[None, :] == widx[:, None], bit[:, None],
+                         jnp.uint32(0))
+        return c | mask
+    timeit(f"packed cache insert x{T}", scanned(packed_insert),
+           state.avail)
 
     # 6. elementwise state pipeline proxy (~40 vector ops)
     def ew(c):
