@@ -37,12 +37,26 @@ def main():
               f"cdn={total['cdn']/1e6:6.1f}MB p2p={total['p2p']/1e6:6.1f}MB  "
               f"rebuffer={swarm.rebuffer_ratio:.2%}")
 
-    print("\nper-peer:")
+    print("\nper-peer (peerStat):")
     for peer in swarm.peers:
         stats = peer.stats
         print(f"  {peer.peer_id:>10}  pos={peer.position_s:6.1f}s  "
               f"cdn={stats['cdn']/1e6:6.1f}MB  p2p={stats['p2p']/1e6:6.1f}MB  "
               f"up={stats['upload']/1e6:6.1f}MB  peers={stats['peers']}")
+
+    # the p2pGraph analog: mesh edges weighted by bytes pulled over
+    # each one (reference demo pages load p2pGraph.js for this view,
+    # example/bundle/index.html:13-14)
+    print("\nmesh graph (<= MB pulled per edge):")
+    for peer in swarm.peers:
+        agent = peer.agent
+        if agent is None or agent.mesh is None:
+            continue
+        edges = sorted(agent.mesh.downloaded_from.items(),
+                       key=lambda kv: -kv[1])
+        rendered = "  ".join(f"{src}:{nbytes/1e6:.1f}MB"
+                             for src, nbytes in edges if nbytes > 0)
+        print(f"  {peer.peer_id:>10} <= {rendered or '(cdn only)'}")
 
 
 if __name__ == "__main__":

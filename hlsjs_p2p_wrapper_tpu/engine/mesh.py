@@ -154,6 +154,11 @@ class PeerMesh:
         # (requester id, request id) -> in-flight paced serve
         self._uploads: Dict[tuple, _Upload] = {}
         self.upload_bytes = 0
+        # per-edge transfer attribution (the reference demo pages'
+        # p2pGraph edge weights, example/bundle/index.html:13-14):
+        # cumulative payload bytes pulled from / served to each peer
+        self.downloaded_from: Dict[str, int] = {}
+        self.uploaded_to: Dict[str, int] = {}
         self._downloads: Dict[int, _Download] = {}
         self._request_ids = itertools.count(1)
         self.closed = False
@@ -389,6 +394,8 @@ class PeerMesh:
             # conservation metric, not an intent metric; offset only
             # advances on acceptance, so the receiver never sees a gap
             self.upload_bytes += len(piece)
+            self.uploaded_to[upload.src_id] = (
+                self.uploaded_to.get(upload.src_id, 0) + len(piece))
             upload.offset += len(piece)
         if upload.offset >= total:
             del self._uploads[key]
@@ -431,6 +438,9 @@ class PeerMesh:
             return
         download.buf[msg.offset:msg.offset + len(msg.payload)] = msg.payload
         download.received += len(msg.payload)
+        if msg.payload:  # empty serves create no edge on either side
+            self.downloaded_from[src_id] = (
+                self.downloaded_from.get(src_id, 0) + len(msg.payload))
         if download.on_progress is not None:
             download.on_progress(download.received)
         if download.received >= download.total:

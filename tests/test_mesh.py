@@ -428,3 +428,22 @@ def test_per_peer_serve_cap_denies_excess():
     # ...and BUSY is transient: the requester keeps its knowledge
     # that b holds the key, so failover can come back later
     assert "b" in mesh_a.holders_of(key(MAX_SERVES_PER_PEER + 1))
+
+
+def test_per_edge_transfer_attribution(duo):
+    """The p2pGraph-analog counters: bytes pulled over each edge are
+    attributed to the serving peer on the downloader and to the
+    requesting peer on the server, and the two views agree."""
+    clock, net, (mesh_a, cache_a), (mesh_b, cache_b) = duo
+    payload = bytes(50_000)
+    cache_b.put(key(1), payload)
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    got = []
+    mesh_a.request("b", key(1), on_success=got.append,
+                   on_error=lambda e: got.append(e))
+    clock.advance(500.0)
+    assert got == [payload]
+    assert mesh_a.downloaded_from == {"b": len(payload)}
+    assert mesh_b.uploaded_to == {"a": len(payload)}
+    assert mesh_a.uploaded_to == {} and mesh_b.downloaded_from == {}
