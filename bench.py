@@ -162,13 +162,17 @@ def numpy_baseline_throughput(config, n_steps, join):
         dl_ms = np.where(may, 0.0, dl_ms)
         dl_budget = np.where(may, budget, dl_budget)
         active_p2p = dl_active & dl_p2p
-        # single-holder transfers with the holders[0] pile-on
-        # (ops/swarm_sim.py nth_holder_only): unit demand on the
-        # lowest-id eligible holder
-        masked = np.where(elig > 0, nbr, P)
-        first_id = masked.min(axis=1)
-        elig_first = ((elig > 0) & (nbr == first_id[:, None])).astype(
-            np.float32)
+        # single-holder transfers, "spread" selection (the default —
+        # ops/swarm_sim.py spread_holder_only): unit demand on the
+        # hash-picked eligible holder, same hash as the device step
+        gi_seg = np.where(dl_active, dl_seg, nxt).astype(np.uint64)
+        hh = ((np.arange(P, dtype=np.uint64) * 2654435761
+               + gi_seg * 40503 + 97) % (1 << 32))
+        rank = (hh % np.maximum(n_holders, 1.0).astype(np.uint64)) \
+            .astype(np.int64)
+        pos = elig > 0
+        cum = np.cumsum(pos, axis=1) - pos
+        elig_first = (pos & (cum == rank[:, None])).astype(np.float32)
         demand = active_p2p.astype(np.float32)
         contrib = elig_first * demand[:, None]
         # bincount is NumPy's fastest segment-sum (4.5× np.add.at here)

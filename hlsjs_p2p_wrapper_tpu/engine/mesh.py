@@ -55,6 +55,17 @@ PACE_RETRY_MS = 50.0
 #: concurrent serves one requesting peer may hold open (foreground +
 #: prefetches + slack); excess requests are denied BUSY
 MAX_SERVES_PER_PEER = 4
+#: concurrent serves across ALL requesters (admission control): an
+#: uplink split N ways makes every transfer N× slower, and past the
+#: requesters' timeouts each serve becomes pure waste — the
+#: timeout-retry congestion collapse measured in the swarm harness
+#: (~7× more bytes uploaded than delivered at tight uplinks, offload
+#: 0.22).  Refusing early (BUSY) costs one RTT and redirects the
+#: requester to an idler holder or the CDN; serving 2 at a time keeps
+#: the uplink saturated with transfers that actually finish (same
+#: scenario: offload 0.65, waste 1.6×).  Tune per deployment via
+#: ``max_total_serves``.
+MAX_TOTAL_SERVES = 2
 #: give up on an upload that can't make progress (partitioned peer)
 UPLOAD_TTL_MS = 30_000.0
 
@@ -137,7 +148,14 @@ class PeerMesh:
                  request_timeout_ms: float = DEFAULT_REQUEST_TIMEOUT_MS,
                  is_upload_on: Callable[[], bool] = lambda: True,
                  chunk_bytes: int = CHUNK_PAYLOAD_BYTES,
-                 ban_ms: float = DEFAULT_BAN_MS):
+                 ban_ms: float = DEFAULT_BAN_MS,
+                 holder_selection: str = "spread",
+                 max_total_serves: int = MAX_TOTAL_SERVES):
+        if holder_selection not in ("spread", "ranked"):
+            raise ValueError(f"unknown holder_selection "
+                             f"{holder_selection!r}")
+        self.holder_selection = holder_selection
+        self.max_total_serves = max_total_serves
         self.endpoint = endpoint
         self.swarm_id = swarm_id
         self.clock = clock
@@ -156,7 +174,9 @@ class PeerMesh:
         self.upload_bytes = 0
         # per-edge transfer attribution (the reference demo pages'
         # p2pGraph edge weights, example/bundle/index.html:13-14):
-        # cumulative payload bytes pulled from / served to each peer
+        # cumulative payload bytes pulled from / served to each peer.
+        # Size-bounded via _bump_edge — churning neighbors over a
+        # long live session must not grow these for the mesh lifetime
         self.downloaded_from: Dict[str, int] = {}
         self.uploaded_to: Dict[str, int] = {}
         self._downloads: Dict[int, _Download] = {}
@@ -201,9 +221,37 @@ class PeerMesh:
             self._drop_upload(key)
 
     # -- availability --------------------------------------------------
+    #: edge-attribution dicts keep at most this many peers; beyond
+    #: it the smallest edges are pruned (all the graph view renders
+    #: is the heavy edges anyway)
+    MAX_EDGE_ENTRIES = 256
+
+    @staticmethod
+    def _bump_edge(edges: Dict[str, int], peer_id: str, n: int) -> None:
+        edges[peer_id] = edges.get(peer_id, 0) + n
+        if len(edges) > PeerMesh.MAX_EDGE_ENTRIES:
+            for victim, _bytes in sorted(edges.items(),
+                                         key=lambda kv: kv[1])[
+                    :len(edges) - PeerMesh.MAX_EDGE_ENTRIES // 2]:
+                del edges[victim]
+
     def holders_of(self, key: bytes) -> list:
         """Handshaked neighbors announcing this segment, least-loaded
-        first so concurrent fetches spread across the swarm."""
+        first so concurrent fetches spread across the swarm.
+
+        Load is LOCAL knowledge (my own in-flight requests), so ties
+        are the common case — and under the old announce-order
+        tie-break every peer in the swarm ordered ties identically,
+        herding all requests onto the earliest announcer: its uplink
+        became the swarm-wide bottleneck while other holders idled,
+        collapsing offload under tight uplinks (measured 0.04 at
+        1.2 Mbps uplinks, with ~7× more bytes uploaded than delivered
+        — found by the device sim's contention model,
+        ops/swarm_sim.py holder_selection).  The default "spread"
+        policy breaks ties with a rendezvous hash over (my id, holder
+        id, key): each (requester, segment) lands on an effectively
+        uniform holder, so demand covers every uplink.
+        ``holder_selection="ranked"`` restores announce order."""
         key = bytes(key)
         holders = [p for p in self.peers.values()
                    if p.handshaked and key in p.have]
@@ -211,7 +259,17 @@ class PeerMesh:
         for d in self._downloads.values():
             if d.peer_id in load:
                 load[d.peer_id] += 1
-        holders.sort(key=lambda p: load[p.peer_id])
+        if self.holder_selection == "spread":
+            me = self.endpoint.peer_id.encode()
+
+            def rendezvous(p):
+                return hashlib.sha256(
+                    me + b"\x00" + p.peer_id.encode() + b"\x00" + key
+                ).digest()
+
+            holders.sort(key=lambda p: (load[p.peer_id], rendezvous(p)))
+        else:
+            holders.sort(key=lambda p: load[p.peer_id])
         return [p.peer_id for p in holders]
 
     @property
@@ -348,16 +406,28 @@ class PeerMesh:
             return
         key = (src_id, msg.request_id)
         self._drop_upload(key)  # a duplicate request restarts cleanly
-        # bounded serves per requesting peer: without a cap, one
-        # handshaked peer issuing many request_ids pins a payload
-        # reference + a repeating pump timer each for up to
-        # UPLOAD_TTL_MS — a memory/timer amplification vector.  The
-        # honest downloader never needs more than its foreground +
-        # prefetch slots; excess is denied BUSY (which the requester's
-        # multi-holder failover handles like any other deny).
+        # admission control (see MAX_TOTAL_SERVES): refuse work this
+        # uplink cannot finish before the requesters' timeouts —
+        # BUSY redirects them to idler holders instead of letting
+        # every transfer crawl to a timeout and discard
+        if len(self._uploads) >= self.max_total_serves:
+            self._send(src_id, P.Deny(msg.request_id, P.DenyReason.BUSY))
+            return
+        # bounded serves per requesting peer, on two grounds: (a)
+        # abuse — without a cap, one handshaked peer issuing many
+        # request_ids pins a payload reference + a repeating pump
+        # timer each for up to UPLOAD_TTL_MS, a memory/timer
+        # amplification vector (MAX_SERVES_PER_PEER); (b) fairness —
+        # one requester must not monopolize the whole admission
+        # budget, so a single peer gets at most half of
+        # max_total_serves (floor 1).  Excess is denied BUSY (which
+        # the requester's multi-holder failover handles like any
+        # other deny).
+        per_peer_cap = min(MAX_SERVES_PER_PEER,
+                           max(1, self.max_total_serves // 2))
         active_for_peer = sum(1 for (sid, _rid) in self._uploads
                               if sid == src_id)
-        if active_for_peer >= MAX_SERVES_PER_PEER:
+        if active_for_peer >= per_peer_cap:
             self._send(src_id, P.Deny(msg.request_id, P.DenyReason.BUSY))
             return
         self._uploads[key] = _Upload(src_id, msg.request_id, payload,
@@ -394,8 +464,7 @@ class PeerMesh:
             # conservation metric, not an intent metric; offset only
             # advances on acceptance, so the receiver never sees a gap
             self.upload_bytes += len(piece)
-            self.uploaded_to[upload.src_id] = (
-                self.uploaded_to.get(upload.src_id, 0) + len(piece))
+            self._bump_edge(self.uploaded_to, upload.src_id, len(piece))
             upload.offset += len(piece)
         if upload.offset >= total:
             del self._uploads[key]
@@ -439,8 +508,8 @@ class PeerMesh:
         download.buf[msg.offset:msg.offset + len(msg.payload)] = msg.payload
         download.received += len(msg.payload)
         if msg.payload:  # empty serves create no edge on either side
-            self.downloaded_from[src_id] = (
-                self.downloaded_from.get(src_id, 0) + len(msg.payload))
+            self._bump_edge(self.downloaded_from, src_id,
+                            len(msg.payload))
         if download.on_progress is not None:
             download.on_progress(download.received)
         if download.received >= download.total:

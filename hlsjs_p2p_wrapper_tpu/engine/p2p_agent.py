@@ -49,7 +49,7 @@ from .cache import DEFAULT_MAX_BYTES as DEFAULT_CACHE_MAX_BYTES
 from .cache import SegmentCache
 from .cdn import CdnTransport, HttpCdnTransport
 from .cdn_agent import StreamTypes
-from .mesh import DEFAULT_REQUEST_TIMEOUT_MS, PeerMesh
+from .mesh import DEFAULT_REQUEST_TIMEOUT_MS, MAX_TOTAL_SERVES, PeerMesh
 from .scheduler import SchedulingPolicy, decide
 from .stats import AgentStats
 from .tracker import (DEFAULT_ANNOUNCE_INTERVAL_MS, TRACKER_PEER_ID,
@@ -148,6 +148,10 @@ class P2PAgent:
         self._live_steered = False
         self._is_live: Optional[bool] = None  # unknown until manifest
         self._prefetches: Dict[bytes, object] = {}
+        # per-key failed-attempt counts: retries rotate to the NEXT
+        # holder instead of deterministically re-asking the one that
+        # just denied/timed out (holders_of is stable per key)
+        self._prefetch_failures: Dict[bytes, int] = {}
         self._prefetch_timer = None
 
         network = cfg.get("network")
@@ -161,7 +165,14 @@ class P2PAgent:
                 self.endpoint, self.swarm_id, self.clock, self.cache,
                 request_timeout_ms=cfg.get("request_timeout_ms",
                                            DEFAULT_REQUEST_TIMEOUT_MS),
-                is_upload_on=lambda: self.p2p_upload_on and not self.disposed)
+                is_upload_on=lambda: self.p2p_upload_on and not self.disposed,
+                # "spread" rendezvous-hash holder choice by default —
+                # announce-order ("ranked") herds the whole swarm onto
+                # one uplink under contention (mesh.holders_of)
+                holder_selection=cfg.get("holder_selection", "spread"),
+                # serve admission control (mesh.MAX_TOTAL_SERVES)
+                max_total_serves=cfg.get("max_total_serves",
+                                         MAX_TOTAL_SERVES))
             self.mesh.on_remote_have = lambda _peer: self._schedule_prefetch()
             self.tracker_client = TrackerClient(
                 self.endpoint, self.swarm_id, self.peer_id, self.clock,
@@ -455,19 +466,31 @@ class P2PAgent:
             holders = self.mesh.holders_of(key)
             if not holders:
                 continue
-            self._start_prefetch(key, holders[0])
+            # rotate past holders that already failed this key —
+            # holders_of is deterministic per (requester, key), so an
+            # unrotated retry would re-ask the same overloaded peer
+            # forever
+            attempt = self._prefetch_failures.get(key, 0)
+            self._start_prefetch(key, holders[attempt % len(holders)])
 
     def _start_prefetch(self, key: bytes, peer_id: str) -> None:
         t_start = self.clock.now()
 
         def on_success(payload: bytes) -> None:
             self._prefetches.pop(key, None)
+            self._prefetch_failures.pop(key, None)
             self._stats.p2p += len(payload)
             self._store(key, payload, self.clock.now() - t_start)
             self._schedule_prefetch()
 
         def on_error(_error: Dict) -> None:
             self._prefetches.pop(key, None)
+            if len(self._prefetch_failures) > 512:
+                # stale keys (played past, evicted elsewhere) must not
+                # accumulate for the session lifetime
+                self._prefetch_failures.clear()
+            self._prefetch_failures[key] = (
+                self._prefetch_failures.get(key, 0) + 1)
 
         # reserve the slot BEFORE issuing the request: under a
         # SystemClock the callbacks can fire on a timer thread before

@@ -117,6 +117,17 @@ class SwarmConfig(NamedTuple):
     #: ~linearly in C, so the default keeps the flagship single-slot
     #: model.
     max_concurrency: int = 1
+    #: which single holder a transfer rides (transfers are always
+    #: single-holder, like the agent's):
+    #: - "spread" (default, matching the agent's default): per-(peer,
+    #:   segment, slot) hash pick — demand distributes across all
+    #:   holders (the rendezvous-hash tie-break in
+    #:   engine/mesh.py PeerMesh.holders_of).
+    #: - "ranked": the shared announce-order head — prefetches on
+    #:   holders[0], the foreground one rank later.  Faithful to the
+    #:   round-2 agent, and the cause of its contention collapse:
+    #:   every requester herds onto the same uplink.
+    holder_selection: str = "spread"
     seg_duration_s: float = 4.0
     dt_ms: float = 250.0
     max_buffer_s: float = 30.0
@@ -326,6 +337,11 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     (``config.max_concurrency``) are unrolled at trace time: slot 0 is
     the foreground download, slots 1.. are P2P-only prefetches (see
     the ``max_concurrency`` field docs)."""
+    if config.holder_selection not in ("spread", "ranked"):
+        # mirror PeerMesh's validation: a typo must not silently
+        # simulate the ranked pile-on and fake a zero-gain A/B
+        raise ValueError(f"unknown holder_selection "
+                         f"{config.holder_selection!r}")
     dt_s = config.dt_ms / 1000.0
     seg = config.seg_duration_s
     P, S, L = config.n_peers, config.n_segments, config.n_levels
@@ -335,6 +351,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     present = (t >= scenario.join_s) & (t < scenario.leave_s)  # [P]
     zeros = jnp.zeros((P,), jnp.float32)
     never = jnp.zeros((P,), bool)
+    peer_idx32 = jnp.arange(P, dtype=jnp.uint32)
 
     playhead = state.playhead_s
     if config.live:
@@ -442,6 +459,38 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                           axis=1)
             prev = jnp.where(nxt < big, nxt, prev)
         return (pos & (nbr == prev[:, None])).astype(jnp.float32)
+
+    def spread_holder_only(elig, n_holders, gi_seg, salt: int):
+        """Restrict eligibility to ONE eligible holder chosen by a
+        per-(peer, segment, slot) hash — the 'spread' selection
+        policy (config.holder_selection): each requester lands on an
+        effectively uniform-random holder, so demand distributes
+        across ALL holders' uplinks instead of herding onto the
+        shared announce-order head.  Models the mesh's
+        rendezvous-hash holder tie-break
+        (engine/mesh.py PeerMesh.holders_of)."""
+        h = (peer_idx32 * jnp.uint32(2654435761)
+             + gi_seg.astype(jnp.uint32) * jnp.uint32(40503)
+             + jnp.uint32((salt * 2246822519 + 97) % (1 << 32)))
+        rank = (h % jnp.maximum(n_holders, 1.0).astype(jnp.uint32)) \
+            .astype(jnp.int32)
+        if circulant:
+            cum = jnp.zeros((P,), jnp.int32)
+            out = []
+            for e in elig:
+                is_e = e > 0
+                out.append((is_e & (cum == rank)).astype(jnp.float32))
+                cum = cum + is_e.astype(jnp.int32)
+            return out
+        pos = elig > 0                                       # [P, K]
+        cum = jnp.cumsum(pos, axis=1) - pos  # eligibles before slot k
+        return (pos & (cum == rank[:, None])).astype(jnp.float32)
+
+    def select_holder(elig, n_holders, gi_seg, c: int):
+        if config.holder_selection == "spread":
+            return spread_holder_only(elig, n_holders, gi_seg, c)
+        # "ranked": the announce-order pile-on (see nth_holder_only)
+        return nth_holder_only(elig, 1 if (c == 0 and C > 1) else 0)
 
     def own_cache(Wm):
         """Does each peer already hold its own target? (bit test —
@@ -566,11 +615,9 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
             "may": may, "active": active, "is_p2p": is_p2p,
             "have_n": have_n, "n_holders": n_holders_c,
             "W": W_c,
-            # single-holder transfers (see nth_holder_only): the
-            # foreground rides the holder after its own prefetches'
-            # pile-on point; prefetches ride holders[0]
-            "elig": nth_holder_only(elig_c,
-                                    1 if (c == 0 and C > 1) else 0),
+            # single-holder transfers; which holder depends on
+            # config.holder_selection (see select_holder)
+            "elig": select_holder(elig_c, n_holders_c, gi_seg, c),
             "seg": jnp.where(may, target_seg, state.dl_seg[:, c]),
             "level": jnp.where(may, want_level, state.dl_level[:, c]),
             "total": jnp.where(may, want_bytes,

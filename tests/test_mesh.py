@@ -404,7 +404,10 @@ def test_per_peer_serve_cap_denies_excess():
     # within one dispatch round
     endpoint_b = net.register("b", uplink_bps=100_000.0)
     cache_b = SegmentCache(max_bytes=1 << 22)
-    mesh_b = PeerMesh(endpoint_b, "s", clock, cache_b)
+    # total-serve admission control is off here: this test isolates
+    # the PER-PEER cap (see test_total_serve_admission_control)
+    mesh_b = PeerMesh(endpoint_b, "s", clock, cache_b,
+                      max_total_serves=10_000)
     endpoint_b.on_receive = \
         lambda src, frame: mesh_b.handle_frame(src, P.decode(frame))
     payload = bytes(200_000)
@@ -447,3 +450,82 @@ def test_per_edge_transfer_attribution(duo):
     assert mesh_a.downloaded_from == {"b": len(payload)}
     assert mesh_b.uploaded_to == {"a": len(payload)}
     assert mesh_a.uploaded_to == {} and mesh_b.downloaded_from == {}
+
+
+def test_total_serve_admission_control():
+    """A holder refuses serves beyond max_total_serves with BUSY —
+    an uplink split too many ways makes every transfer miss its
+    requester's timeout, turning the whole uplink into waste (the
+    timeout-retry congestion collapse the device sim diagnosed)."""
+    from hlsjs_p2p_wrapper_tpu.engine.mesh import MAX_TOTAL_SERVES
+
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    # throttled holder so accepted serves stay open
+    endpoint_b = net.register("b", uplink_bps=100_000.0)
+    cache_b = SegmentCache(max_bytes=1 << 22)
+    mesh_b = PeerMesh(endpoint_b, "s", clock, cache_b)
+    endpoint_b.on_receive = \
+        lambda src, frame: mesh_b.handle_frame(src, P.decode(frame))
+    for sn in range(1, MAX_TOTAL_SERVES + 3):
+        cache_b.put(key(sn), bytes(200_000))
+
+    # several DISTINCT requesters (the per-peer cap can't be what
+    # binds), each asking for a different segment
+    requesters = []
+    for i in range(MAX_TOTAL_SERVES + 2):
+        mesh, _cache = make_mesh(net, clock, f"r{i}")
+        mesh.connect_to("b")
+        requesters.append(mesh)
+    clock.advance(50.0)
+    denies = []
+    for i, mesh in enumerate(requesters):
+        mesh.request("b", key(i + 1), on_success=lambda p: None,
+                     on_error=lambda e, i=i: denies.append((i, e)))
+    clock.advance(2_000.0)
+    assert len(mesh_b._uploads) == MAX_TOTAL_SERVES
+    assert len(denies) == 2
+    assert all(e == {"status": 503} for _i, e in denies)
+
+
+def test_spread_policy_breaks_holder_ties_differently():
+    """With "spread" (the default), two requesters with identical
+    local load order the same holder set differently (rendezvous
+    hash); with "ranked" they herd onto the same announce-order head."""
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+
+    def build(policy, name):
+        endpoint = net.register(name)
+        cache = SegmentCache(max_bytes=1 << 20)
+        mesh = PeerMesh(endpoint, "s", clock, cache,
+                        holder_selection=policy)
+        endpoint.on_receive = \
+            lambda src, frame: mesh.handle_frame(src, P.decode(frame))
+        return mesh, cache
+
+    holders = []
+    for i in range(6):
+        mesh, cache = build("spread", f"h{i}")
+        cache.put(key(1), b"x")
+        cache.put(key(2), b"y")
+        holders.append(mesh)
+    spread_a, _ = build("spread", "ra")
+    spread_b, _ = build("spread", "rb")
+    ranked_a, _ = build("ranked", "rc")
+    ranked_b, _ = build("ranked", "rd")
+    for requester in (spread_a, spread_b, ranked_a, ranked_b):
+        for i in range(6):
+            requester.connect_to(f"h{i}")
+    clock.advance(100.0)
+
+    # ranked: both requesters see the identical announce-order list
+    assert ranked_a.holders_of(key(1)) == ranked_b.holders_of(key(1))
+    # spread: orders differ between requesters AND between keys
+    # (hash over requester id, holder id, AND key)
+    orders = {tuple(spread_a.holders_of(key(1))),
+              tuple(spread_b.holders_of(key(1))),
+              tuple(spread_a.holders_of(key(2)))}
+    assert len(orders) >= 2, orders
+    # same requester+key is deterministic (retries stay analyzable)
+    assert spread_a.holders_of(key(1)) == spread_a.holders_of(key(1))

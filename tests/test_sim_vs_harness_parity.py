@@ -9,22 +9,22 @@ live, one- and two-level ladders, ample through collapsed uplinks —
 and asserts QUANTITATIVE offload agreement at every point.
 
 What closed the round-2 gap (±0.15 ample-only, direction-only under
-contention): the sim now models the harness's actual transfer
-anatomy —
+contention): the sim models the harness's actual transfer anatomy —
+``max_concurrency=3`` (CDN-capable foreground + two P2P-only
+prefetches landing in the cache), SINGLE-holder transfers, per-attempt
+timeouts that DISCARD partial bytes, and live HAVE/announce lag.
 
-- ``max_concurrency=3``: one CDN-capable foreground + two P2P-only
-  prefetches per peer (engine/p2p_agent.py:60), prefetches landing in
-  the cache and the playback path absorbing cached segments,
-- SINGLE-holder transfers with the swarm-wide ``holders[0]`` pile-on
-  (announce order is shared, so everyone converges on the earliest
-  announcer — ops/swarm_sim.py nth_holder_only) instead of the
-  round-2 demand-split-across-all-holders fluid model, which pooled
-  uplinks the real agent never pools,
-- per-attempt request timeouts that DISCARD partial bytes
-  (engine/mesh.py:39) — the waste mechanism behind contention
-  collapse (measured: the harness uploads ~7× the bytes that count
-  as delivered P2P at 2.4 Mbps uplinks),
-- live HAVE/announce propagation lag (``announce_delay_s``).
+The round-3 punchline this file also pins: the sim's contention model
+DIAGNOSED a real scheduling defect in the agent (announce-order holder
+selection herds every requester onto one uplink; measured ~7× more
+bytes uploaded than delivered, offload 0.23 at 2.4 Mbps uplinks) and
+PREDICTED the fix's payoff.  The agent now ships rendezvous-hash
+"spread" selection + serve admission control (mesh.MAX_TOTAL_SERVES) +
+attempt-rotated prefetch retries, and lands within 0.01 of the sim's
+prediction at the mid-contention point it was tuned for.  The old
+behavior remains reachable (``holder_selection="ranked"`` +
+uncapped serves) and the sim's "ranked" mode still matches it — both
+directions of the A/B are held quantitatively.
 """
 
 from functools import lru_cache
@@ -44,14 +44,20 @@ CDN_BPS = 8_000_000.0
 JOIN_SPACING_S = 6.0
 CONCURRENCY = 3  # foreground + DEFAULT_MAX_CONCURRENT_PREFETCH
 
+#: the agent's pre-fix behavior: announce-order holder herding with
+#: no serve admission control (round-2 defaults)
+LEGACY = (("holder_selection", "ranked"), ("max_total_serves", 10_000))
+
 
 @lru_cache(maxsize=None)
-def harness_offload(uplink_bps, levels=(int(BITRATE),), cdn_bps=CDN_BPS):
+def harness_offload(uplink_bps, levels=(int(BITRATE),), cdn_bps=CDN_BPS,
+                    p2p=()):
     harness = SwarmHarness(seg_duration=SEG_S, frag_count=FRAGS,
                            level_bitrates=levels,
                            cdn_bandwidth_bps=cdn_bps)
     for i in range(N_PEERS):
-        harness.add_peer(f"p{i}", uplink_bps=uplink_bps)
+        harness.add_peer(f"p{i}", uplink_bps=uplink_bps,
+                         p2p_config=dict(p2p))
         harness.run(JOIN_SPACING_S * 1000.0)
     assert harness.run_until_all_finished(), "harness swarm stalled"
     return harness.offload_ratio
@@ -59,10 +65,11 @@ def harness_offload(uplink_bps, levels=(int(BITRATE),), cdn_bps=CDN_BPS):
 
 @lru_cache(maxsize=None)
 def sim_offload(uplink_bps, levels=(BITRATE,), cdn_bps=CDN_BPS,
-                require_finish=True):
+                policy="spread", require_finish=True):
     config = SwarmConfig(n_peers=N_PEERS, n_segments=FRAGS,
                          n_levels=len(levels), seg_duration_s=SEG_S,
-                         max_concurrency=CONCURRENCY)
+                         max_concurrency=CONCURRENCY,
+                         holder_selection=policy)
     join = jnp.arange(N_PEERS, dtype=jnp.float32) * JOIN_SPACING_S
     uplink = jnp.full((N_PEERS,), float(uplink_bps))
     final, _ = run_swarm(config, jnp.array(levels),
@@ -87,30 +94,57 @@ def test_offload_parity_ample_uplink():
     assert h > 0.5 and s > 0.5  # and it's genuinely a P2P-served swarm
 
 
-def test_offload_parity_collapsed_uplink_quantitative():
-    """Uplink barely above bitrate: the holders[0] pile-on saturates
-    one uplink while attempts time out and discard partials — BOTH
-    models must collapse to near-zero offload, and agree within 0.05
-    absolute.  Round 2 asserted only a ranking here; round 2's sim
-    reported 0.61 where the harness measured 0.04."""
-    h = harness_offload(1_200_000.0)
-    s, _ = sim_offload(1_200_000.0)
+def test_offload_parity_mid_contention():
+    """Uplink 3× bitrate (supply ≈ demand) — the regime the sim's
+    fluid contention model was built for.  With the agent's spread +
+    admission-control fixes the harness lands within 0.05 of the
+    sim's prediction (measured ≈ 0.007)."""
+    h = harness_offload(2_400_000.0)
+    s, _ = sim_offload(2_400_000.0)
+    assert abs(h - s) < 0.05, (h, s)
+    # and the point sits strictly between the regimes in both models
+    assert h < harness_offload(50_000_000.0)
+    assert s < sim_offload(50_000_000.0)[0]
+
+
+def test_offload_parity_collapsed_uplink_legacy_quantitative():
+    """The DIAGNOSED pathology, held quantitatively: under the
+    round-2 behavior (announce-order herding, uncapped serves) and
+    uplink barely above bitrate, BOTH models collapse to near-zero
+    offload and agree within 0.05 absolute.  Round 2's sim reported
+    0.61 where the harness measured 0.04."""
+    h = harness_offload(1_200_000.0, p2p=LEGACY)
+    s, _ = sim_offload(1_200_000.0, policy="ranked")
     assert h < 0.1 and s < 0.1, (h, s)
     assert abs(h - s) < 0.05, (h, s)
 
 
-def test_offload_parity_mid_contention():
-    """The in-between regime (uplink 3× bitrate, supply ≈ demand) is
-    the hardest to model — partial collapse driven by timeout churn.
-    Bound the divergence at 0.12 absolute (measured ≈ 0.07)."""
-    h = harness_offload(2_400_000.0)
-    s, _ = sim_offload(2_400_000.0)
-    assert abs(h - s) < 0.12, (h, s)
-    # and both models place the point strictly between the regimes
-    h_ample = harness_offload(50_000_000.0)
-    s_ample, _ = sim_offload(50_000_000.0)
-    assert harness_offload(1_200_000.0) < h < h_ample
-    assert sim_offload(1_200_000.0)[0] < s < s_ample
+def test_offload_parity_collapsed_uplink_spread():
+    """Same collapsed regime under the fixed policy: the sim's fluid
+    single-holder model is a documented OPTIMISTIC bound here (it has
+    no queueing variance, so transfers that fluid-share exactly at
+    the timeout boundary complete; real ones straggle and discard).
+    Pin the direction, the improvement, and the bound width."""
+    h_fix = harness_offload(1_200_000.0)
+    h_old = harness_offload(1_200_000.0, p2p=LEGACY)
+    s_fix, _ = sim_offload(1_200_000.0)
+    assert h_fix > h_old * 2.0, (h_old, h_fix)  # the fix genuinely helps
+    assert s_fix >= h_fix, (s_fix, h_fix)       # optimism, never pessimism
+    assert s_fix - h_fix < 0.25, (s_fix, h_fix)
+
+
+def test_policy_ab_agreement():
+    """The design-tool property: the sim's predicted A/B outcome for
+    the holder-selection fix matches the harness's measured outcome —
+    both show the spread+admission policy recovering most of the
+    offload that announce-order herding destroys at mid contention."""
+    h_gain = (harness_offload(2_400_000.0)
+              - harness_offload(2_400_000.0, p2p=LEGACY))
+    s_gain = (sim_offload(2_400_000.0)[0]
+              - sim_offload(2_400_000.0, policy="ranked")[0])
+    assert h_gain > 0.3, h_gain
+    assert s_gain > 0.3, s_gain
+    assert abs(h_gain - s_gain) < 0.15, (h_gain, s_gain)
 
 
 def test_live_mode_parity():
