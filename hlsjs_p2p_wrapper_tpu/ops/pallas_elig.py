@@ -1,0 +1,120 @@
+"""Pallas TPU kernel for the swarm step's hot op: fused circulant
+eligibility over the bit-packed availability map.
+
+The XLA formulation (ops/swarm_sim.py eligibility) evaluates, for
+each circulant offset ``o``::
+
+    elig_o[i] = Σ_w popcount_nonzero(AP[(i + o) % P, w] & Wm[i, w])
+
+as K separate roll+AND+reduce passes — each streaming the [P, W]
+bitmap (and the one-hot mask) from HBM.  This kernel computes ALL K
+offsets in one pass: a tile of AP rows (plus an H-row ring halo on
+each side, H = max |offset|) and the matching Wm tile are loaded to
+VMEM once, and the K shifted AND-reduces run on-chip — the
+algorithmic HBM traffic drops from ~2K streams to ~2.
+
+Layout notes (guide: /opt/skills/guides/pallas_guide.md): W (packed
+words, e.g. 24) sits in the lane dimension — underfilled lanes, but
+the op is bandwidth-bound, not VPU-bound, so tile rows are what
+matter; the [K, P] output keeps P in lanes.  The grid tiles the peer
+axis; halos wrap mod P (the ring topology's seam), prepared as tiny
+[G, H, W] gathers outside the kernel.
+
+Status (measured on TPU v5e through the axon toolchain): the kernel
+is CORRECT — tests/test_pallas_elig.py pins it bit-identical to the
+jnp formulation, including the ring seam — and compiles standalone in
+~14 s at the benchmark shapes, but embedding it in the simulator's
+400-step ``lax.scan`` pushes XLA compile time past several minutes
+(the whole jnp step compiles in ~40 s), so ``SwarmConfig.use_pallas``
+leaves it OPT-IN rather than default.  XLA already fuses the jnp
+stencil well (hbm_util ≈ 0.72 end-to-end), which caps the realistic
+runtime win at ~1.5-2×; revisit when pallas-in-scan compile cost
+drops.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is TPU-only functionality; import lazily/defensively
+    from jax.experimental import pallas as pl
+    # probe the TPU backend too: its absence means "no kernel"
+    import jax.experimental.pallas.tpu  # noqa: F401
+    HAVE_PALLAS = True
+except Exception:  # noqa: BLE001 — any import failure means "no kernel"
+    HAVE_PALLAS = False
+
+#: preferred peer-axis tile sizes (rows); first divisor of P wins
+_TILE_CANDIDATES = (8192, 4096, 2048, 1024, 512, 256)
+
+
+def pick_tile(n_peers: int) -> int:
+    """Largest candidate tile that divides the peer count (0 = no
+    whole-tile decomposition; caller falls back to the jnp path)."""
+    for tile in _TILE_CANDIDATES:
+        if n_peers % tile == 0 and n_peers // tile >= 2:
+            return tile
+    return 0
+
+
+def _kernel(offsets: Tuple[int, ...], halo: int, ap_ref, top_ref,
+            bot_ref, wm_ref, out_ref):
+    ap = ap_ref[...]                                   # [T, W] u32
+    wm = wm_ref[...]                                   # [T, W] u32
+    # halo blocks carry a leading grid axis of 1; [0] drops it
+    ext = jnp.concatenate([top_ref[0], ap, bot_ref[0]], axis=0)
+    tile = ap.shape[0]
+    for k, off in enumerate(offsets):                  # static unroll
+        shifted = ext[halo + off: halo + off + tile, :]
+        hits = (shifted & wm) != 0                     # [T, W]
+        out_ref[k, :] = jnp.sum(hits, axis=1).astype(jnp.int32)
+
+
+def eligibility_call(ap: jax.Array, wm: jax.Array,
+                     offsets: Tuple[int, ...], tile: int,
+                     interpret: bool = False) -> jax.Array:
+    """All-offsets eligibility in one fused pass (traceable — call
+    from inside a jitted step).
+
+    ``ap``/``wm``: [P, W] u32 (availability·presence bitmap, one-hot
+    bit mask).  Returns [K, P] i32 with row k = elig for offsets[k].
+    ``tile`` must divide P (see :func:`pick_tile`).  ``interpret``
+    runs the kernel in the Pallas interpreter (CPU-testable).
+    """
+    P, W = ap.shape
+    grid = P // tile
+    halo = max(abs(o) for o in offsets)
+    assert halo <= tile, "halo exceeds tile"
+    # ring halos: rows just above/below each tile, wrapped mod P —
+    # [G, H, W] gathers of G·H rows total (negligible next to the map)
+    row = jnp.arange(grid)[:, None] * tile
+    top_idx = (row - jnp.arange(halo, 0, -1)[None, :]) % P
+    bot_idx = (row + tile + jnp.arange(halo)[None, :]) % P
+    top = ap[top_idx]                                  # [G, H, W]
+    bot = ap[bot_idx]                                  # [G, H, W]
+
+    return pl.pallas_call(
+        partial(_kernel, offsets, halo),
+        out_shape=jax.ShapeDtypeStruct((len(offsets), P), jnp.int32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((tile, W), lambda g: (g, 0)),
+            pl.BlockSpec((1, halo, W), lambda g: (g, 0, 0)),
+            pl.BlockSpec((1, halo, W), lambda g: (g, 0, 0)),
+            pl.BlockSpec((tile, W), lambda g: (g, 0)),
+        ],
+        out_specs=pl.BlockSpec((len(offsets), tile), lambda g: (0, g)),
+        interpret=interpret,
+    )(ap, top, bot, wm)
+
+
+@partial(jax.jit, static_argnames=("offsets", "tile", "interpret"))
+def fused_eligibility(ap: jax.Array, wm: jax.Array,
+                      offsets: Tuple[int, ...], tile: int,
+                      interpret: bool = False) -> jax.Array:
+    """Standalone jitted wrapper around :func:`eligibility_call`."""
+    return eligibility_call(ap, wm, offsets, tile, interpret)

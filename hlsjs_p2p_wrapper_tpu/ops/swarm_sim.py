@@ -128,6 +128,18 @@ class SwarmConfig(NamedTuple):
     #:   round-2 agent, and the cause of its contention collapse:
     #:   every requester herds onto the same uplink.
     holder_selection: str = "spread"
+    #: fused Pallas kernel for the circulant eligibility stencil
+    #: (ops/pallas_elig.py) — OPT-IN (default off; honored only on a
+    #: real TPU, silently falling back to the jnp stencil anywhere
+    #: else).  The kernel is correct (pinned bit-identical to the
+    #: jnp formulation by tests/test_pallas_elig.py) and compiles
+    #: standalone in ~14 s, but embedding it in the simulator's
+    #: lax.scan blows XLA compile time past several MINUTES on the
+    #: current toolchain (jnp step: ~40 s), so the default stays the
+    #: jnp stencil — which XLA already fuses well (hbm_util ≈ 0.72
+    #: at the bench shapes).  Flip to True to experiment on short
+    #: scans.
+    use_pallas: bool = False
     seg_duration_s: float = 4.0
     dt_ms: float = 250.0
     max_buffer_s: float = 30.0
@@ -390,6 +402,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         # neighbor_offsets doc)
         offs = _normalized_offsets(config.neighbor_offsets, P)
         AP = jnp.where(present[:, None], avail_p, jnp.uint32(0))
+        kernel_tile = _pallas_tile(config, offs)
     else:
         # general [P, K] neighbor-list path (arbitrary topologies):
         # XLA gathers — correct everywhere, ~50× slower per edge on
@@ -408,10 +421,17 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         Wm = jnp.where(wcol[None, :] == word_idx[:, None],
                        bitmask[:, None], jnp.uint32(0))      # [P, W]
         if circulant:
-            elig = [jnp.sum((jnp.roll(AP, -o, axis=0) & Wm) != 0,
-                            axis=1,
-                            dtype=jnp.int32).astype(jnp.float32)
-                    for o in offs]                           # K × [P]
+            if kernel_tile and offs:
+                from .pallas_elig import eligibility_call
+                fused = eligibility_call(AP, Wm, tuple(offs),
+                                         kernel_tile)       # [K, P]
+                elig = [fused[k].astype(jnp.float32)
+                        for k in range(len(offs))]
+            else:
+                elig = [jnp.sum((jnp.roll(AP, -o, axis=0) & Wm) != 0,
+                                axis=1,
+                                dtype=jnp.int32).astype(jnp.float32)
+                        for o in offs]                       # K × [P]
             n = sum(elig) if elig else zeros
         else:
             got = avail_p[nbr, word_idx[:, None]]            # [P, K] u32
@@ -948,6 +968,27 @@ def stable_ranks(n_peers: int, seed: int = 0) -> jnp.ndarray:
     stagger — the device-side analogue of the agent's hashed
     ``_edge_rank`` (engine/p2p_agent.py)."""
     return jax.random.uniform(jax.random.PRNGKey(seed), (n_peers,))
+
+
+def _pallas_tile(config: SwarmConfig, offsets: list) -> int:
+    """Peer-axis tile for the fused eligibility kernel, or 0 to use
+    the jnp formulation.  OPT-IN only (``use_pallas=True``; see the
+    config field for why it is not the default), and requires a real
+    TPU (no CPU lowering), whole tiles, and a halo that fits —
+    anything missing falls back to the jnp stencil."""
+    if config.use_pallas is not True or not offsets:
+        return 0
+    try:
+        from .pallas_elig import HAVE_PALLAS, pick_tile
+    except ImportError:
+        return 0
+    if not HAVE_PALLAS or jax.devices()[0].platform != "tpu":
+        return 0
+    tile = pick_tile(config.n_peers)
+    halo = max((abs(o) for o in offsets), default=0)
+    if tile == 0 or halo > tile:
+        return 0
+    return tile
 
 
 def _normalized_offsets(offsets: Tuple[int, ...], n_peers: int) -> list:

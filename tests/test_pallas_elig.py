@@ -1,0 +1,91 @@
+"""The fused Pallas eligibility kernel must agree bit-for-bit with
+the jnp reference formulation (the semantics of record).  Runs in the
+Pallas interpreter so the contract is pinned on CPU CI too; on a real
+TPU the same code path compiles natively when a caller opts in with
+``SwarmConfig(use_pallas=True)`` (see that field's docstring for why
+the default stays the jnp stencil)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.ops.pallas_elig import (HAVE_PALLAS,
+                                                   fused_eligibility,
+                                                   pick_tile)
+
+pytestmark = pytest.mark.skipif(not HAVE_PALLAS,
+                                reason="pallas unavailable")
+
+
+def reference(ap, wm, offsets):
+    return jnp.stack([jnp.sum((jnp.roll(ap, -o, axis=0) & wm) != 0,
+                              axis=1, dtype=jnp.int32) for o in offsets])
+
+
+def make_inputs(P, W, seed=0):
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    ap = jax.random.randint(k1, (P, W), 0, 1 << 30,
+                            dtype=jnp.int32).astype(jnp.uint32)
+    flat = jax.random.randint(k2, (P,), 0, W * 32)
+    bit = (jnp.uint32(1) << (flat & 31).astype(jnp.uint32))[:, None]
+    wm = jnp.where(jnp.arange(W)[None, :] == (flat >> 5)[:, None],
+                   bit, jnp.uint32(0))
+    return ap, wm
+
+
+@pytest.mark.parametrize("P,W,offsets", [
+    (1024, 8, (1, 2, 3, 4, -1, -2, -3, -4)),   # bench ring, small P
+    (1024, 5, (1, -1)),                         # W not lane-aligned
+    (2048, 24, (8, -8, 2, -2)),                 # wider offsets
+])
+def test_kernel_matches_reference(P, W, offsets):
+    ap, wm = make_inputs(P, W)
+    tile = pick_tile(P)
+    assert tile > 0
+    got = fused_eligibility(ap, wm, offsets, tile, interpret=True)
+    assert jnp.array_equal(got, reference(ap, wm, offsets))
+
+
+def test_kernel_wraps_ring_seam():
+    """Rows near 0 and P-1 read across the wrap — the halo path."""
+    P, W = 512, 4
+    ap = jnp.zeros((P, W), jnp.uint32).at[0, 0].set(1)  # only peer 0 holds
+    wm = jnp.full((P, 1), jnp.uint32(1))
+    wm = jnp.pad(wm, ((0, 0), (0, W - 1)))
+    offsets = (1, -1)
+    got = fused_eligibility(ap, wm, offsets, pick_tile(P), interpret=True)
+    want = reference(ap, wm, offsets)
+    assert jnp.array_equal(got, want)
+    # peer P-1's +1 neighbor is peer 0 (wrap): eligibility must see it
+    assert int(got[0, P - 1]) == 1
+    assert int(got[1, 1]) == 1  # peer 1's -1 neighbor is peer 0
+
+
+def test_swarm_step_kernel_agrees_with_jnp_path():
+    """End-to-end through run_swarm: the default and explicit-off
+    configs are the same jnp path everywhere; on a real TPU (where
+    use_pallas=True is honored) the kernel-backed run must agree
+    with it.  On CPU the opt-in silently falls back, so the TPU leg
+    self-skips."""
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (SwarmConfig,
+                                                     init_swarm,
+                                                     offload_ratio,
+                                                     ring_offsets,
+                                                     run_swarm,
+                                                     staggered_joins)
+    P = 512
+    base = SwarmConfig(n_peers=P, n_segments=32, n_levels=2,
+                       neighbor_offsets=ring_offsets(8))
+    br = jnp.array([300_000.0, 800_000.0])
+    cdn = jnp.full((P,), 8_000_000.0)
+    join = staggered_joins(P, 30.0)
+    auto, _ = run_swarm(base, br, None, cdn, init_swarm(base), 240, join)
+    off_auto = float(offload_ratio(auto))
+    forced_off, _ = run_swarm(base._replace(use_pallas=False), br, None,
+                              cdn, init_swarm(base), 240, join)
+    assert abs(off_auto - float(offload_ratio(forced_off))) < 1e-6
+    if jax.devices()[0].platform == "tpu":
+        forced_on, _ = run_swarm(base._replace(use_pallas=True), br,
+                                 None, cdn, init_swarm(base), 240, join)
+        assert abs(off_auto - float(offload_ratio(forced_on))) < 1e-3

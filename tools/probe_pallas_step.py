@@ -1,0 +1,26 @@
+"""Timebox probe: fused-kernel step vs jnp step in a short scan."""
+import os
+import sys
+import time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax.numpy as jnp
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (SwarmConfig, init_swarm,
+                                                 ring_offsets, run_swarm,
+                                                 staggered_joins)
+P = 65536
+br = jnp.array([300e3, 800e3, 2e6]); cdn = jnp.full((P,), 8e6)
+join = staggered_joins(P, 60.0)
+for flag in (True, False):
+    cfg = SwarmConfig(n_peers=P, n_segments=256, n_levels=3,
+                      neighbor_offsets=ring_offsets(8), use_pallas=flag)
+    T = 50
+    t0 = time.perf_counter()
+    f, _ = run_swarm(cfg, br, None, cdn, init_swarm(cfg), T, join)
+    float(jnp.sum(f.p2p_bytes))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    f, _ = run_swarm(cfg, br, None, cdn, init_swarm(cfg), T, join)
+    float(jnp.sum(f.p2p_bytes))
+    run_s = time.perf_counter() - t0
+    print(f"use_pallas={flag}: compile+first {compile_s:.1f}s, "
+          f"steady {run_s/T*1e3:.2f} ms/step", flush=True)
