@@ -173,3 +173,69 @@ def test_run_until_all_finished_reports_timeout():
     swarm = SwarmHarness(cdn_bandwidth_bps=2_000.0)  # hopeless CDN
     swarm.add_peer("stuck")
     assert swarm.run_until_all_finished(max_ms=20_000.0) is False
+
+
+def test_scheduling_policy_ab_offload_and_waste():
+    """The round-3 scheduling fix, pinned at the harness level: under
+    tight uplinks the spread + admission-control defaults must beat
+    the legacy announce-order herding on BOTH north-star-adjacent
+    axes — offload up, upload waste down — without costing playback."""
+    def run(**p2p):
+        swarm = SwarmHarness(seg_duration=4.0, frag_count=24,
+                             level_bitrates=(800_000,),
+                             cdn_bandwidth_bps=8_000_000.0)
+        for i in range(8):
+            swarm.add_peer(f"p{i}", uplink_bps=2_400_000.0,
+                           p2p_config=dict(p2p))
+            swarm.run(6_000.0)
+        assert swarm.run_until_all_finished()
+        return swarm
+
+    fixed = run()
+    legacy = run(holder_selection="ranked", max_total_serves=10_000)
+    assert fixed.offload_ratio > 2.0 * legacy.offload_ratio
+    assert fixed.upload_waste_ratio < legacy.upload_waste_ratio / 2.0
+    assert fixed.rebuffer_ratio <= legacy.rebuffer_ratio + 0.01
+
+
+def test_prefetch_retry_rotates_holders():
+    """A failed prefetch must try a DIFFERENT holder next time —
+    holders_of is deterministic per (requester, key), so without
+    rotation the agent would re-ask the same overloaded peer forever.
+    Drives the REAL _schedule_prefetch against a stub mesh that
+    denies every request and records who was asked."""
+    swarm = SwarmHarness(cdn_bandwidth_bps=8_000_000.0)
+    peer = swarm.add_peer("alice")
+    swarm.run(30_000.0)  # playback running: track + window exist
+    agent = peer.agent
+    asked = []
+
+    class StubMesh:
+        closed = False
+
+        def holders_of(self, key):
+            return ["h-one", "h-two", "h-three"]
+
+        def request(self, peer_id, key, on_success, on_error,
+                    on_progress=None, timeout_ms=None):
+            asked.append((bytes(key), peer_id))
+            on_error({"status": 503})  # instant deny
+            return None
+
+    agent.mesh = StubMesh()
+    agent._prefetches.clear()
+    agent._prefetch_failures.clear()
+    # pretend nothing is cached so every window segment is a candidate
+    agent.cache.has = lambda key: False
+    for _ in range(3):
+        agent._schedule_prefetch()
+    # each segment's SUCCESSIVE attempts must walk the holder list
+    # (h-one → h-two → h-three), not re-ask the failed peer
+    per_key = {}
+    for key, peer_id in asked:
+        per_key.setdefault(key, []).append(peer_id)
+    assert per_key, "no prefetch attempts recorded"
+    for key, sequence in per_key.items():
+        assert sequence == ["h-one", "h-two", "h-three"][:len(sequence)], \
+            (key, sequence)
+        assert len(set(sequence)) == len(sequence)  # never repeats
