@@ -6,7 +6,8 @@ from .swarm_sim import (SwarmConfig, SwarmScenario, SwarmState,
                         full_neighbors, full_offsets, init_swarm,
                         invert_neighbors, isolated_neighbors,
                         make_scenario, neighbors_from_adjacency,
-                        offload_ratio, packed_words, rebuffer_ratio,
+                        offload_ratio, packed_words, random_neighbors,
+                        rebuffer_ratio,
                         ring_neighbors, ring_offsets, run_swarm,
                         stable_ranks, staggered_joins, step_flops,
                         step_hbm_bytes, swarm_step, unpack_avail)
@@ -16,6 +17,7 @@ __all__ = ["EwmaState", "get_estimate", "init_state", "scan_samples",
            "full_neighbors", "full_offsets", "init_swarm",
            "invert_neighbors", "isolated_neighbors", "make_scenario",
            "neighbors_from_adjacency", "offload_ratio",
+           "random_neighbors",
            "packed_words", "rebuffer_ratio", "ring_neighbors",
            "ring_offsets", "run_swarm", "stable_ranks",
            "staggered_joins", "step_flops", "step_hbm_bytes",

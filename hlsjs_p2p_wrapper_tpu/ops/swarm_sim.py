@@ -1064,6 +1064,25 @@ def ring_neighbors(n_peers: int, degree: int = 8,
     return _pad_neighbors(nbr, n_peers, k_pad)
 
 
+def random_neighbors(n_peers: int, degree: int = 8,
+                     seed: int = 0,
+                     k_pad: Optional[int] = None) -> jnp.ndarray:
+    """Uniform-random ``[P, degree]`` neighbor lists (distinct,
+    non-self) — the tracker-fed mesh topology: unlike a ring, peer
+    neighborhoods overlap GLOBALLY, so shared holder-list ordering
+    (announce order / lowest id) herds requesters onto the same
+    uplinks swarm-wide.  This is the topology where the
+    holder-selection policy matters (tools/policy_ab.py); rings are
+    structurally pre-spread."""
+    rng = np.random.default_rng(seed)
+    nbr = np.empty((n_peers, degree), np.int64)
+    for i in range(n_peers):
+        picks = rng.choice(n_peers - 1, size=degree, replace=False)
+        picks[picks >= i] += 1  # skip self, stay uniform
+        nbr[i] = picks
+    return _pad_neighbors(nbr, n_peers, k_pad)
+
+
 def full_neighbors(n_peers: int,
                    k_pad: Optional[int] = None) -> jnp.ndarray:
     """Everyone sees everyone (minus self) as ``[P, P-1]`` neighbor
