@@ -1073,13 +1073,15 @@ def random_neighbors(n_peers: int, degree: int = 8,
     (announce order / lowest id) herds requesters onto the same
     uplinks swarm-wide.  This is the topology where the
     holder-selection policy matters (tools/policy_ab.py); rings are
-    structurally pre-spread."""
+    structurally pre-spread.  Degree ≥ P collapses to everyone-else
+    plus self-padding (set semantics, like ring_neighbors)."""
     rng = np.random.default_rng(seed)
-    nbr = np.empty((n_peers, degree), np.int64)
+    real = min(degree, n_peers - 1)
+    nbr = np.repeat(np.arange(n_peers)[:, None], degree, axis=1)
     for i in range(n_peers):
-        picks = rng.choice(n_peers - 1, size=degree, replace=False)
+        picks = rng.choice(n_peers - 1, size=real, replace=False)
         picks[picks >= i] += 1  # skip self, stay uniform
-        nbr[i] = picks
+        nbr[i, :real] = picks
     return _pad_neighbors(nbr, n_peers, k_pad)
 
 

@@ -10,10 +10,13 @@ test_scheduling_policy_ab_offload_and_waste).
 
 Usage::
 
-    python tools/policy_ab.py [--peers 262144] [--out POLICY_AB.json]
+    python tools/policy_ab.py [--out POLICY_AB.json]
 
-Two compiles (policy is a static config switch), every uplink point
-reuses them (uplink is scenario data).
+Defaults: the random (tracker-like) mesh runs at 8,192 peers — its
+general [P, K] gather path pays TPU's per-element gather cost, so
+keep it small — and the ring runs at 262,144 on the circulant fast
+path.  Four compiles (2 topologies × 2 static policies); every
+uplink point reuses them (uplink is scenario data).
 """
 
 import argparse
@@ -119,7 +122,7 @@ def main():
                 "meta": {
                     "segments": args.segments,
                     "watch_s": args.watch_s, "bitrate": BITRATE,
-                    "degree": 8,
+                    "degree": 8, "seed": args.seed,
                     "elapsed_s": round(elapsed, 1),
                     "platform": device.platform,
                     "device_kind": getattr(device, "device_kind", "?"),
