@@ -365,3 +365,31 @@ def test_full_neighbors_matches_tracker_topology():
     assert nbr.shape == (6, 5)
     for i in range(6):
         assert set(int(x) for x in nbr[i]) == set(range(6)) - {i}
+
+
+def test_random_neighbors_uniform_and_invertible():
+    """The tracker-mesh topology helper: distinct non-self picks,
+    degree>=P clamps to everyone-else (set semantics), and the
+    inverse-edge construction handles its variable in-degree."""
+    import numpy as np
+
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (invert_neighbors,
+                                                     random_neighbors)
+    nbr = np.asarray(random_neighbors(64, 8, seed=3))
+    assert nbr.shape == (64, 8)
+    for i in range(64):
+        row = nbr[i]
+        assert i not in row
+        assert len(set(row)) == 8  # distinct
+    # inverse edges: every outbound slot appears exactly once inbound
+    inv = np.asarray(invert_neighbors(nbr))
+    flat = inv[inv >= 0]
+    assert len(flat) == 64 * 8
+    assert len(set(flat.tolist())) == 64 * 8
+    # and padding covers the max in-degree
+    in_degree = np.bincount(nbr.ravel(), minlength=64)
+    assert inv.shape[1] == max(int(in_degree.max()), 8)
+    # tiny swarm: degree >= P collapses instead of raising
+    tiny = np.asarray(random_neighbors(4, 8))
+    for i in range(4):
+        assert set(tiny[i]) - {i} == set(range(4)) - {i}
