@@ -120,6 +120,7 @@ def numpy_baseline_throughput(config, n_steps, join):
     dl_seg = np.zeros(P, np.int32); dl_level = np.zeros(P, np.int32)
     dl_done = np.zeros(P, np.float32); dl_total = np.zeros(P, np.float32)
     dl_ms = np.zeros(P, np.float32); dl_budget = np.zeros(P, np.float32)
+    cdn_bytes = 0.0; p2p_bytes = 0.0
     alpha_f = np.exp(np.log(0.5) / config.fast_half_life_s)
     alpha_s = np.exp(np.log(0.5) / config.slow_half_life_s)
     t = 0.0
@@ -193,6 +194,11 @@ def numpy_baseline_throughput(config, n_steps, join):
         dl_ms = np.where(expired, 0.0, dl_ms)
         np.maximum.at(avail, (pidx, dl_level * S + dl_seg),
                       comp.astype(np.uint8))
+        # boolean-index form: the byte accounting runs inside the
+        # timed loop, so keep its overhead negligible next to the
+        # model step (it must not deflate host_throughput)
+        cdn_bytes += float(dl_total[comp & ~dl_p2p].sum())
+        p2p_bytes += float(dl_total[comp & dl_p2p].sum())
         ms = np.maximum(dl_ms, MIN_SAMPLE_DURATION_MS)
         bw = 8000.0 * dl_total / ms; w = ms / 1000.0
         for (e, tw, alpha) in ((fast_e, fast_w, alpha_f),
@@ -208,7 +214,9 @@ def numpy_baseline_throughput(config, n_steps, join):
         buf = buf - adv
         t += dt_s
     elapsed = time.perf_counter() - start
-    return P * n_steps / elapsed
+    offload = (p2p_bytes / (p2p_bytes + cdn_bytes)
+               if p2p_bytes + cdn_bytes > 0 else 0.0)
+    return P * n_steps / elapsed, offload
 
 
 def main():
@@ -235,7 +243,8 @@ def main():
     steps_per_sec = T * repeats / elapsed
     device_throughput = P * steps_per_sec
 
-    host_throughput = numpy_baseline_throughput(config, min(T, 20), join)
+    host_throughput, _host_offload = numpy_baseline_throughput(
+        config, min(T, 20), join)
 
     achieved_flops = steps_per_sec * step_flops(config, DEGREE)
     achieved_hbm = steps_per_sec * step_hbm_bytes(config, DEGREE)
