@@ -100,8 +100,17 @@ def numpy_baseline_throughput(config, n_steps, join):
     """The same sparse model, stepped by NumPy on the host — the
     honest 'without the accelerator' comparison.  Mirrors the device
     step op-for-op: [P, K] eligibility via fancy-indexed gather,
-    ``np.add.at`` scatter for holder load, demand-split uplink
-    contention, urgency + budget failover, dual-EWMA ABR."""
+    bincount segment-sum for holder load, single-holder spread
+    selection, urgency + budget failover, dual-EWMA ABR."""
+    # the host loop mirrors the device DEFAULTS; a config it does not
+    # model must fail loudly, not publish an apples-to-oranges
+    # vs_baseline (tests/test_bench_host_model.py pins the parity)
+    assert config.max_total_serves == 0, \
+        "host baseline models the uncapped default only"
+    assert config.holder_selection == "spread", \
+        "host baseline models the spread default only"
+    assert config.max_concurrency == 1, \
+        "host baseline models the single-slot default only"
     P, S, L = config.n_peers, config.n_segments, config.n_levels
     bitrates = np.array(BITRATES[:L], np.float32)
     nbr = np.asarray(ring_neighbors(P, DEGREE))          # [P, K]

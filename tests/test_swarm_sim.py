@@ -393,3 +393,46 @@ def test_random_neighbors_uniform_and_invertible():
     tiny = np.asarray(random_neighbors(4, 8))
     for i in range(4):
         assert set(tiny[i]) - {i} == set(range(4)) - {i}
+
+
+def test_admission_cap_huge_equals_uncapped_both_paths():
+    """max_total_serves high enough never binds: bit-identical to the
+    uncapped fluid model on both the circulant and general paths."""
+    P = 64
+    br = jnp.array([800_000.0])
+    cdn = jnp.full((P,), 8_000_000.0)
+    join = jnp.linspace(0.0, 40.0, P)
+    for cfg, nbr in (
+        (SwarmConfig(n_peers=P, n_segments=48, n_levels=1,
+                     neighbor_offsets=ring_offsets(8),
+                     max_concurrency=3), None),
+        (SwarmConfig(n_peers=P, n_segments=48, n_levels=1,
+                     max_concurrency=3), ring_neighbors(P, 8)),
+    ):
+        a, _ = run_swarm(cfg, br, nbr, cdn, init_swarm(cfg), 300, join)
+        b, _ = run_swarm(cfg._replace(max_total_serves=1000), br, nbr,
+                         cdn, init_swarm(cfg), 300, join)
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            assert jnp.array_equal(jnp.asarray(x), jnp.asarray(y))
+
+
+def test_admission_cap_helps_under_contention():
+    """The admission-policy what-if: under tight uplinks, capped
+    serves (fast-fail, transfers that finish) must beat the uncapped
+    fair-share thrash in the sim — the direction the harness A/B
+    measured for the real agent."""
+    cfg = SwarmConfig(n_peers=8, n_segments=24, n_levels=1,
+                      seg_duration_s=4.0, max_concurrency=3)
+    br = jnp.array([800_000.0])
+    cdn = jnp.full((8,), 8_000_000.0)
+    join = jnp.arange(8, dtype=jnp.float32) * 6.0
+    uplink = jnp.full((8,), 2_400_000.0)
+
+    def run(cap):
+        f, _ = run_swarm(cfg._replace(max_total_serves=cap), br,
+                         full_neighbors(8), cdn, init_swarm(cfg),
+                         2000, join, uplink_bps=uplink)
+        return float(offload_ratio(f))
+
+    assert run(2) > run(0) + 0.1
