@@ -10,39 +10,44 @@ its answer to swarm questions was "open several browser tabs"
 
 Model per peer: playhead, buffer, quality level, dual-EWMA bandwidth
 estimator (bit-identical numerics to the player's, ``ops/ewma.py``),
-one in-flight segment download, and a per-(level, segment) cache map.
-Per step (``dt_ms``):
+``max_concurrency`` transfer slots (slot 0 = the CDN-capable
+foreground; slots 1.. = P2P-only prefetches that land in the cache,
+with the playback path absorbing cached segments — the agent's
+foreground + max_concurrent_prefetch model), and a bit-packed
+per-(level, segment) cache map.  Per step (``dt_ms``):
 
 1. idle present peers pick the next needed segment and an ABR level
    from the EWMA estimate (same highest-fitting-bitrate rule as
-   ``core/abr.py:next_level``),
-2. **availability + uplink contention** run on the sparse ``[P, K]``
-   neighbor lists: ``have[i, k] = avail[nbr[i, k], seg_i]`` — each
-   peer gathers its K neighbors' availability of its single segment
-   of interest.  (Round 1 computed the full ``adj @ avail`` product,
-   ``O(P²·L·S)``; round 2's gather-form ``[P, P]`` eligibility cut
-   that to ``O(P²)`` but still streamed two dense matrices through
-   HBM per step and needed ``O(P²)`` adjacency memory — 17 GB at 65k
-   peers.  Real overlays are degree-K sparse (the agent's mesh caps
-   its neighbor set, engine/mesh.py), so 99.8% of that matrix was
-   structurally zero at the default degree-8 ring.  The ``[P, K]``
-   form makes the step ``O(P·K)`` compute AND memory: gathers for
-   eligibility, one segment-sum scatter for holder load, and the
-   same demand-split service — bit-equivalent contention semantics
-   at 1/500th the traffic, which is what unlocks 100k+-peer sweeps.)
-   From the same eligibility: a downloader splits demand across its
-   holders, a holder's uplink is shared across the demand on it (the
-   ``engine/transport.py:126-132`` uplink-serialization model), and a
-   P2P download's rate is its share-weighted service, capped by the
-   downlink,
-3. downloads progress; P2P downloads whose holders all departed flip
-   to the CDN (the aggregate analogue of the agent's multi-holder →
-   CDN failover); completions update cache, buffer, estimator, and
-   byte counters,
+   ``core/abr.py:next_level``); prefetch slots target the following
+   in-window segments at that level,
+2. **availability + uplink contention** run on sparse degree-K
+   topology.  (Rounds 1-2 streamed dense ``[P, P]`` formulations
+   through HBM — O(P²) memory, 17 GB of adjacency at 65k peers; real
+   overlays are degree-K sparse, which is what unlocks 100k+-peer
+   sweeps.)  Two representations: circulant offsets (ring-style
+   overlays), where every cross-peer op is a static roll/stencil
+   over the bit-packed map — zero gathers, ~50× faster per edge on
+   TPU, and ICI halo exchanges under sharding — or general
+   ``[P, K]`` neighbor lists via XLA gathers.  Transfers are
+   SINGLE-HOLDER like the agent's: ``holder_selection`` picks the
+   rendezvous-hash "spread" holder (the shipped policy) or the
+   shared announce-order "ranked" head (the herding behavior the
+   design tool diagnosed, tools/policy_ab.py); a holder's uplink is
+   fair-shared across the transfers on it
+   (``engine/transport.py:126-132``), optionally behind an admission
+   cap (``max_total_serves``), and a transfer's rate is its holder's
+   service, capped by the downlink,
+3. transfers progress; a foreground P2P leg that outlives its budget
+   concedes to the CDN discarding partials, a prefetch that outlives
+   ``request_timeout_ms`` (or loses all holders) is dropped — the
+   timeout-discard waste that drives contention collapse;
+   completions update cache, buffer (foreground only), estimator,
+   and byte counters,
 4. playback advances where buffered, else rebuffer accrues.
 
 Live mode (``config.live=True``): segment ``s`` becomes downloadable
-only once fully published (``(s+1)·seg ≤ t``); joiners start
+only once fully published (``(s+1)·seg ≤ t``) and P2P-fetchable only
+``announce_delay_s`` after that (HAVE propagation lag); joiners start
 ``live_sync_s`` behind the edge; and when no neighbor has a fresh
 segment, a peer may hit the CDN only after its stable per-peer
 stagger delay (``edge_rank · live_spread_s``) — the device-side sweep
@@ -51,16 +56,22 @@ peers depart at ``leave_s``; departed peers stop downloading,
 serving, and playing, but their transferred bytes stay in the totals
 (same accounting as the harness).
 
-Scheduler-policy knobs (urgency margin, P2P time budget, live-edge
-spread) are **dynamic scenario fields**, not compile-time constants:
-they only feed ``jnp`` arithmetic, so a whole policy grid reuses ONE
-compiled program (``tools/sweep.py`` sweeps them recompile-free).
+Scheduler-policy knobs (urgency margin, P2P time budget, request
+timeout, live-edge spread, announce lag) are **dynamic scenario
+fields**, not compile-time constants: they only feed ``jnp``
+arithmetic, so a whole policy grid reuses ONE compiled program
+(``tools/sweep.py`` sweeps them recompile-free).
+
+How far to trust this model is a measured quantity, not a hope:
+``tests/test_sim_vs_harness_parity.py`` holds it to the discrete
+harness quantitatively across ample/contended/collapsed uplinks,
+live mode, ABR ladders, and both holder policies.
 
 Everything is ``lax.scan``-stepped, statically shaped, and
 ``shard_map``/pjit-shardable over the peer axis (see ``parallel/``):
-per-peer state shards cleanly; the neighbor gathers and the holder
-load scatter-add reference global peer indices, so under a sharded
-mesh XLA lowers them to the simulator's only collectives.
+per-peer state shards cleanly; the circulant rolls (or, on the
+general path, the neighbor gathers) are the simulator's only
+cross-device ops under a sharded mesh.
 """
 
 from __future__ import annotations
