@@ -682,10 +682,11 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
 
     # ---- 3. uplink contention + progress (phase B) ------------------
     # every active P2P transfer — foreground or prefetch, any slot —
-    # splits unit demand across its holders; a holder's uplink is
-    # shared across the TOTAL demand on it
-    # (engine/transport.py:126-132); a transfer's rate is its
-    # share-weighted service, capped by the downlink.
+    # places unit demand on its SINGLE selected holder; a holder's
+    # uplink is fair-shared across the total demand on it
+    # (engine/transport.py:126-132), optionally behind the admission
+    # cap; a transfer's rate is its holder's service, capped by the
+    # downlink.
     for s in slots:
         s["demand"] = (s["active"] & s["is_p2p"] & present).astype(
             jnp.float32)
