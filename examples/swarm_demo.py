@@ -35,7 +35,8 @@ def main():
         print(f"t={swarm.clock.now()/1000:5.0f}s  "
               f"offload [{bar(swarm.offload_ratio)}] {swarm.offload_ratio:6.1%}  "
               f"cdn={total['cdn']/1e6:6.1f}MB p2p={total['p2p']/1e6:6.1f}MB  "
-              f"rebuffer={swarm.rebuffer_ratio:.2%}")
+              f"rebuffer={swarm.rebuffer_ratio:.2%}  "
+              f"waste={swarm.upload_waste_ratio:.2f}x")
 
     print("\nper-peer (peerStat):")
     for peer in swarm.peers:
