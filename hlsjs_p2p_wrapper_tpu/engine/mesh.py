@@ -68,6 +68,12 @@ MAX_SERVES_PER_PEER = 4
 MAX_TOTAL_SERVES = 2
 #: give up on an upload that can't make progress (partitioned peer)
 UPLOAD_TTL_MS = 30_000.0
+#: how long a holder that denied (BUSY) or timed out on us is
+#: deprioritized in holder selection (the "adaptive" policy's
+#: feedback window).  Long enough to cover a typical transfer on the
+#: loaded holder (so we route around it while it drains), short
+#: enough that a momentary burst doesn't exile a good holder.
+HOLDER_PENALTY_MS = 3_000.0
 
 
 class _Download:
@@ -149,9 +155,9 @@ class PeerMesh:
                  is_upload_on: Callable[[], bool] = lambda: True,
                  chunk_bytes: int = CHUNK_PAYLOAD_BYTES,
                  ban_ms: float = DEFAULT_BAN_MS,
-                 holder_selection: str = "spread",
+                 holder_selection: str = "adaptive",
                  max_total_serves: int = MAX_TOTAL_SERVES):
-        if holder_selection not in ("spread", "ranked"):
+        if holder_selection not in ("adaptive", "spread", "ranked"):
             raise ValueError(f"unknown holder_selection "
                              f"{holder_selection!r}")
         self.holder_selection = holder_selection
@@ -165,6 +171,10 @@ class PeerMesh:
         self.chunk_bytes = chunk_bytes
         self.ban_ms = ban_ms
         self.peers: Dict[str, PeerState] = {}
+        # peer id -> penalty expiry (ms): holders that recently said
+        # BUSY or timed out on us are deprioritized by the "adaptive"
+        # selection until the window passes (congestion feedback)
+        self._holder_penalty: Dict[str, float] = {}
         # peer id -> ban expiry (ms); the tracker keeps re-listing a
         # punished peer every round, so dropping without remembering
         # would re-trust the poisoner seconds later
@@ -229,10 +239,15 @@ class PeerMesh:
     @staticmethod
     def _bump_edge(edges: Dict[str, int], peer_id: str, n: int) -> None:
         edges[peer_id] = edges.get(peer_id, 0) + n
-        if len(edges) > PeerMesh.MAX_EDGE_ENTRIES:
-            for victim, _bytes in sorted(edges.items(),
-                                         key=lambda kv: kv[1])[
-                    :len(edges) - PeerMesh.MAX_EDGE_ENTRIES // 2]:
+        # prune LAZILY at 2× cap, never evicting the key just bumped:
+        # a new neighbor starts with the smallest byte count, so an
+        # eager at-cap prune would evict each new edge's first chunk
+        # over and over, leaving fresh edges permanently invisible
+        if len(edges) > 2 * PeerMesh.MAX_EDGE_ENTRIES:
+            victims = sorted((k for k in edges if k != peer_id),
+                             key=lambda k: edges[k])
+            for victim in victims[:len(edges)
+                                  - PeerMesh.MAX_EDGE_ENTRIES]:
                 del edges[victim]
 
     def holders_of(self, key: bytes) -> list:
@@ -244,14 +259,20 @@ class PeerMesh:
         tie-break every peer in the swarm ordered ties identically,
         herding all requests onto the earliest announcer: its uplink
         became the swarm-wide bottleneck while other holders idled,
-        collapsing offload under tight uplinks (measured 0.04 at
-        1.2 Mbps uplinks, with ~7× more bytes uploaded than delivered
-        — found by the device sim's contention model,
-        ops/swarm_sim.py holder_selection).  The default "spread"
-        policy breaks ties with a rendezvous hash over (my id, holder
-        id, key): each (requester, segment) lands on an effectively
-        uniform holder, so demand covers every uplink.
-        ``holder_selection="ranked"`` restores announce order."""
+        collapsing offload under tight uplinks (found by the device
+        sim's contention model, ops/swarm_sim.py holder_selection).
+        Three policies:
+
+        - "adaptive" (default): least-loaded, then holders that
+          recently denied BUSY or timed out on us sort LAST for
+          :data:`HOLDER_PENALTY_MS` (congestion feedback — we route
+          around a loaded uplink before burning a round-trip to be
+          told it's busy), then the rendezvous-hash tie-break.
+        - "spread": the round-3 policy — least-loaded + rendezvous
+          hash over (my id, holder id, key), no feedback.
+        - "ranked": announce order (the round-2 herding behavior,
+          kept for A/B study).
+        """
         key = bytes(key)
         holders = [p for p in self.peers.values()
                    if p.handshaked and key in p.have]
@@ -259,18 +280,43 @@ class PeerMesh:
         for d in self._downloads.values():
             if d.peer_id in load:
                 load[d.peer_id] += 1
-        if self.holder_selection == "spread":
+        if self.holder_selection in ("adaptive", "spread"):
             me = self.endpoint.peer_id.encode()
+            now = self.clock.now()
+
+            def penalized(p):
+                if self.holder_selection != "adaptive":
+                    return 0
+                expiry = self._holder_penalty.get(p.peer_id)
+                if expiry is None:
+                    return 0
+                if now >= expiry:
+                    del self._holder_penalty[p.peer_id]
+                    return 0
+                return 1
 
             def rendezvous(p):
                 return hashlib.sha256(
                     me + b"\x00" + p.peer_id.encode() + b"\x00" + key
                 ).digest()
 
-            holders.sort(key=lambda p: (load[p.peer_id], rendezvous(p)))
+            holders.sort(key=lambda p: (load[p.peer_id], penalized(p),
+                                        rendezvous(p)))
         else:
             holders.sort(key=lambda p: load[p.peer_id])
         return [p.peer_id for p in holders]
+
+    def _penalize_holder(self, peer_id: str) -> None:
+        """Congestion feedback for the "adaptive" selection: this
+        holder just signalled overload (BUSY) or silently failed a
+        transfer (timeout) — deprioritize it for a window instead of
+        immediately re-electing it by hash."""
+        self._holder_penalty[peer_id] = self.clock.now() + HOLDER_PENALTY_MS
+        if len(self._holder_penalty) > self.MAX_EDGE_ENTRIES:
+            now = self.clock.now()
+            for pid in [pid for pid, exp in self._holder_penalty.items()
+                        if now >= exp]:
+                del self._holder_penalty[pid]
 
     @property
     def connected_count(self) -> int:
@@ -307,7 +353,7 @@ class PeerMesh:
         request_id = next(self._request_ids)
         timer = self.clock.call_later(
             timeout_ms if timeout_ms is not None else self.request_timeout_ms,
-            lambda: self._fail_download(request_id, {"status": 0}))
+            lambda: self._timeout_download(request_id))
         # snapshot what this peer ANNOUNCED for the key; the payload is
         # verified against it (content-poisoning defense)
         state = self.peers.get(peer_id)
@@ -325,6 +371,15 @@ class PeerMesh:
             return
         download.timer.cancel()
         self._send(download.peer_id, P.Cancel(request_id))
+
+    def _timeout_download(self, request_id: int) -> None:
+        """Per-download timeout: the holder silently failed to
+        deliver — congestion feedback for adaptive selection, then
+        the ordinary transport-shaped failure."""
+        download = self._downloads.get(request_id)
+        if download is not None:
+            self._penalize_holder(download.peer_id)
+        self._fail_download(request_id, {"status": 0})
 
     def _fail_download(self, request_id: int, error: dict) -> None:
         download = self._downloads.pop(request_id, None)
@@ -409,8 +464,13 @@ class PeerMesh:
         # admission control (see MAX_TOTAL_SERVES): refuse work this
         # uplink cannot finish before the requesters' timeouts —
         # BUSY redirects them to idler holders instead of letting
-        # every transfer crawl to a timeout and discard
-        if len(self._uploads) >= self.max_total_serves:
+        # every transfer crawl to a timeout and discard.  <= 0 means
+        # UNCAPPED (fair-share every inbound transfer) — the same
+        # convention the simulator documents (ops/swarm_sim.py
+        # SwarmConfig.max_total_serves), so a config carried between
+        # the two never silently denies every serve.
+        if (self.max_total_serves > 0
+                and len(self._uploads) >= self.max_total_serves):
             self._send(src_id, P.Deny(msg.request_id, P.DenyReason.BUSY))
             return
         # bounded serves per requesting peer, on two grounds: (a)
@@ -420,11 +480,12 @@ class PeerMesh:
         # amplification vector (MAX_SERVES_PER_PEER); (b) fairness —
         # one requester must not monopolize the whole admission
         # budget, so a single peer gets at most half of
-        # max_total_serves (floor 1).  Excess is denied BUSY (which
-        # the requester's multi-holder failover handles like any
-        # other deny).
-        per_peer_cap = min(MAX_SERVES_PER_PEER,
-                           max(1, self.max_total_serves // 2))
+        # max_total_serves (floor 1; the abuse bound alone when
+        # uncapped).  Excess is denied BUSY (which the requester's
+        # multi-holder failover handles like any other deny).
+        per_peer_cap = (MAX_SERVES_PER_PEER if self.max_total_serves <= 0
+                        else min(MAX_SERVES_PER_PEER,
+                                 max(1, self.max_total_serves // 2)))
         active_for_peer = sum(1 for (sid, _rid) in self._uploads
                               if sid == src_id)
         if active_for_peer >= per_peer_cap:
@@ -551,7 +612,9 @@ class PeerMesh:
             return
         if msg.reason == P.DenyReason.BUSY:
             # transient overload: the peer still HAS the key — keep
-            # the holder knowledge so failover can come back later
+            # the holder knowledge so failover can come back later,
+            # but route around it while its uplink drains (adaptive)
+            self._penalize_holder(src_id)
             self._fail_download(msg.request_id, {"status": 503})
             return
         # a denying peer can't serve this key now — stop asking it

@@ -22,6 +22,9 @@ public stats ``{cdn, p2p, upload, peers}`` and the
 - ``cache_max_bytes``: upload store budget
 - ``announce_interval_ms``, ``request_timeout_ms``
 - ``max_concurrent_prefetch``, ``prefetch_interval_ms``
+- ``prefetch_rotation`` (default True): rotate failed prefetch
+  retries across holders; False restores the round-2 head-holder
+  retry for A/B studies
 - ``live_buffer_margin``: if set and the stream is live, the agent
   steers the player's buffer target via ``set_buffer_margin_live``
   (player-interface.js:63-66)
@@ -166,10 +169,13 @@ class P2PAgent:
                 request_timeout_ms=cfg.get("request_timeout_ms",
                                            DEFAULT_REQUEST_TIMEOUT_MS),
                 is_upload_on=lambda: self.p2p_upload_on and not self.disposed,
-                # "spread" rendezvous-hash holder choice by default —
-                # announce-order ("ranked") herds the whole swarm onto
-                # one uplink under contention (mesh.holders_of)
-                holder_selection=cfg.get("holder_selection", "spread"),
+                # "adaptive" by default: rendezvous-hash spread PLUS
+                # BUSY/timeout feedback that routes around loaded
+                # holders — announce-order ("ranked") herds the whole
+                # swarm onto one uplink under contention, and static
+                # "spread" keeps re-electing a denying holder by hash
+                # (mesh.holders_of)
+                holder_selection=cfg.get("holder_selection", "adaptive"),
                 # serve admission control (mesh.MAX_TOTAL_SERVES)
                 max_total_serves=cfg.get("max_total_serves",
                                          MAX_TOTAL_SERVES))
@@ -457,6 +463,7 @@ class P2PAgent:
                 self._current_track, playhead, window_s)
         except Exception:  # noqa: BLE001 — level vanished mid-switch
             return
+        rotate = self.p2p_config.get("prefetch_rotation", True)
         for segment in segments:
             if len(self._prefetches) >= max_concurrent:
                 break
@@ -469,8 +476,11 @@ class P2PAgent:
             # rotate past holders that already failed this key —
             # holders_of is deterministic per (requester, key), so an
             # unrotated retry would re-ask the same overloaded peer
-            # forever
-            attempt = self._prefetch_failures.get(key, 0)
+            # forever.  ``prefetch_rotation: False`` restores the
+            # round-2 retry behavior (always the head holder) for
+            # A/B studies of the rotation itself.
+            attempt = (self._prefetch_failures.get(key, 0)
+                       if rotate else 0)
             self._start_prefetch(key, holders[attempt % len(holders)])
 
     def _start_prefetch(self, key: bytes, peer_id: str) -> None:

@@ -129,47 +129,61 @@ class SwarmConfig(NamedTuple):
     #: model.
     max_concurrency: int = 1
     #: which single holder a transfer rides (transfers are always
-    #: single-holder, like the agent's):
-    #: - "spread" (default, matching the agent's default): per-(peer,
-    #:   segment, slot) hash pick — demand distributes across all
-    #:   holders (the rendezvous-hash tie-break in
-    #:   engine/mesh.py PeerMesh.holders_of).
-    #: - "ranked": the shared announce-order head — prefetches on
-    #:   holders[0], the foreground one rank later.  Faithful to the
-    #:   round-2 agent, and the cause of its contention collapse:
-    #:   every requester herds onto the same uplink.
-    holder_selection: str = "spread"
+    #: single-holder, like the agent's) — one mode per agent
+    #: generation:
+    #: - "adaptive" (default, matching the agent's default): per-
+    #:   (peer, segment, slot) rendezvous hash, RE-ROLLED on each
+    #:   failed attempt (the salt carries the slot's consecutive-
+    #:   failure count) — the fluid model of the r4 agent's
+    #:   rendezvous spread + BUSY/timeout feedback + failure
+    #:   rotation: a denied transfer routes to a different holder
+    #:   instead of re-polling the busy one.
+    #: - "spread": the same hash with NO failure re-roll — the
+    #:   round-3 agent's static rendezvous spread.
+    #: - "ranked": shared announce-order ranks with local-load slot
+    #:   differentiation — a deliberately STYLIZED worst case of the
+    #:   round-2 herding (global order = lowest peer id, where the
+    #:   real mesh's per-requester announce orders diverge), kept as
+    #:   a conservative bound for A/B study.
+    holder_selection: str = "adaptive"
     #: serve admission control, mirroring the mesh's
     #: MAX_TOTAL_SERVES (engine/mesh.py): a holder admits at most
     #: this many concurrent inbound transfers (deterministic
-    #: slot/offset-order tie-break); the rest receive ZERO service
-    #: while their budget/timeout clocks keep running — the fluid
-    #: analogue of a BUSY denial redirecting the requester fast.
-    #: 0 = uncapped fair-share (every inbound transfer splits the
+    #: slot/offset-order tie-break).  A transfer DENIED at start
+    #: fast-fails like the mesh's BUSY: the foreground flips to the
+    #: CDN, a prefetch aborts into its retry cooldown
+    #: (``retry_dead_ms``); a mid-transfer admission loss stalls at
+    #: zero rate with its budget/timeout clocks running.  0 =
+    #: uncapped fair-share (every inbound transfer splits the
     #: uplink).
     #:
-    #: The DEFAULT stays uncapped deliberately, even though the
-    #: shipped agent caps at 2: measured against the harness at mid
-    #: contention, the uncapped fluid model lands closer to the
-    #: capped agent (0.644 vs measured 0.651 offload at 2.4 Mbps
-    #: uplinks) than the capped fluid model does (0.802) — the
-    #: frictions fluid modeling omits (protocol overhead, FIFO
-    #: serialization, retry latency) roughly offset the admission
-    #: benefit.  The knob exists for what-if studies of the admission
-    #: policy itself.
-    max_total_serves: int = 0
-    #: fused Pallas kernel for the circulant eligibility stencil
-    #: (ops/pallas_elig.py) — OPT-IN (default off; honored only on a
-    #: real TPU, silently falling back to the jnp stencil anywhere
-    #: else).  The kernel is correct (pinned bit-identical to the
-    #: jnp formulation by tests/test_pallas_elig.py) and compiles
-    #: standalone in ~14 s, but embedding it in the simulator's
-    #: lax.scan blows XLA compile time past several MINUTES on the
-    #: current toolchain (jnp step: ~40 s), so the default stays the
-    #: jnp stencil — which XLA already fuses well (hbm_util ≈ 0.72
-    #: at the bench shapes).  Flip to True to experiment on short
-    #: scans.
-    use_pallas: bool = False
+    #: The DEFAULT is the shipped agent's cap (mesh.MAX_TOTAL_SERVES
+    #: = 2).  Round 3 kept the sim uncapped because the capped fluid
+    #: model overshot the harness by ~0.15 — the frictions fluid
+    #: modeling omitted "roughly offset" the admission benefit.
+    #: Round 4 models those frictions explicitly (``p2p_setup_ms``,
+    #: ``uplink_efficiency``, ``retry_dead_ms``, BUSY fast-fail)
+    #: instead of absorbing them, so the sim's default can be the
+    #: agent's real config (VERDICT r3 next #4); parity is pinned by
+    #: tests/test_sim_vs_harness_parity.py.
+    max_total_serves: int = 2
+    # NOTE — a fused Pallas kernel for the eligibility stencil was
+    # built, verified bit-identical, and RETIRED (round 4).  The
+    # record, so nobody re-walks the dead end: the kernel fused the
+    # K roll+AND+reduce passes into one VMEM-resident pass (~2
+    # algorithmic HBM streams instead of ~2K) and compiled standalone
+    # in ~14 s — but embedding it in this module's lax.scan step
+    # blew XLA compile past every timebox tried on the current
+    # toolchain (round 3: >5 min; round 4 re-measurement on TPU v5e
+    # through the axon tunnel: killed at 20 and 25 minutes, two
+    # runs, vs ~40 s for the whole jnp step).  Since XLA already
+    # fuses the jnp stencil to hbm_util ≈ 0.75 end-to-end, the
+    # realistic win was ≤1.3× for an unusable compile cost; the
+    # kernel (ops/pallas_elig.py, ~120 LoC + 95 LoC tests) was
+    # deleted rather than shipped as a trophy the production path
+    # never executes.  Revisit only if pallas-in-scan compile cost
+    # drops by an order of magnitude (retrieve the code from git
+    # history, tag r3).
     seg_duration_s: float = 4.0
     dt_ms: float = 250.0
     max_buffer_s: float = 30.0
@@ -198,6 +212,30 @@ class SwarmConfig(NamedTuple):
     #: instant propagation (the VOD steady state, where announce lag
     #: is negligible against the prefetch window).
     announce_delay_s: float = 0.0
+    # -- per-transfer frictions (round 4, VERDICT r3 next #4): the
+    # protocol costs fluid modeling omits, made explicit so the
+    # CAPPED sim matches the CAPPED agent directly instead of
+    # relying on unmodeled frictions to offset the admission benefit.
+    #: dead time at the head of every P2P transfer before the first
+    #: payload byte: REQUEST frame propagation + the first CHUNK's
+    #: link latency (2 × the harness's default 8 ms p2p link latency)
+    #: — bytes accrue only past this point, while the budget/timeout
+    #: clocks run from the start, exactly like the mesh
+    p2p_setup_ms: float = 16.0
+    #: fraction of a holder's uplink that moves segment payload; the
+    #: rest is chunk framing, HAVE/BITFIELD broadcasts, tracker
+    #: announces, and serve-pacing quantization
+    #: (engine/mesh.py PACE_RETRY_MS) sharing the same shaped link
+    uplink_efficiency: float = 0.97
+    #: after a failed prefetch attempt (BUSY deny, timeout, holders
+    #: lost) the slot sits idle this long before retrying.  The agent
+    #: retries failed keys on its prefetch TICK (prefetch_interval_ms
+    #: = 1000, engine/p2p_agent.py) — but incoming HAVE broadcasts
+    #: re-trigger scheduling earlier (``mesh.on_remote_have``), so
+    #: the tick rarely binds.  Default = the measured mean
+    #: failure→retry delay in the discrete harness under contention
+    #: (205-212 ms at 1.2-2.4 Mbps uplinks, round-4 instrumentation).
+    retry_dead_ms: float = 200.0
 
 
 class SwarmScenario(NamedTuple):
@@ -229,6 +267,9 @@ class SwarmScenario(NamedTuple):
     live_spread_s: jax.Array        # [] live-edge CDN stagger window
     request_timeout_ms: jax.Array   # [] per-attempt P2P timeout
     announce_delay_s: jax.Array     # [] live HAVE-propagation lag
+    p2p_setup_ms: jax.Array         # [] per-transfer setup dead time
+    uplink_efficiency: jax.Array    # [] payload fraction of the uplink
+    retry_dead_ms: jax.Array        # [] prefetch retry cooldown
 
 
 def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
@@ -237,7 +278,9 @@ def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
                   p2p_budget_fraction=None, p2p_budget_cap_ms=None,
                   p2p_budget_floor_ms=None, live_spread_s=None,
                   request_timeout_ms=None,
-                  announce_delay_s=None) -> SwarmScenario:
+                  announce_delay_s=None, p2p_setup_ms=None,
+                  uplink_efficiency=None,
+                  retry_dead_ms=None) -> SwarmScenario:
     """Normalize optional arrays to their defaults (everyone joins at
     t=0, never leaves, serves at the downlink cap, rank 0) and policy
     scalars to the config's values.  Also precomputes the inbound
@@ -295,7 +338,11 @@ def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
         request_timeout_ms=scalar(request_timeout_ms,
                                   config.request_timeout_ms),
         announce_delay_s=scalar(announce_delay_s,
-                                config.announce_delay_s))
+                                config.announce_delay_s),
+        p2p_setup_ms=scalar(p2p_setup_ms, config.p2p_setup_ms),
+        uplink_efficiency=scalar(uplink_efficiency,
+                                 config.uplink_efficiency),
+        retry_dead_ms=scalar(retry_dead_ms, config.retry_dead_ms))
 
 
 class SwarmState(NamedTuple):
@@ -327,6 +374,26 @@ class SwarmState(NamedTuple):
     dl_total_bytes: jax.Array  # [P, C] f32
     dl_elapsed_ms: jax.Array   # [P, C] f32
     dl_budget_ms: jax.Array    # [P, C] f32 P2P budget before CDN failover
+    #: [P, C] f32 prefetch retry cooldown: a failed prefetch slot may
+    #: not restart until this drains (the agent's tick-paced retry,
+    #: SwarmConfig.retry_dead_ms).  Slot 0 (foreground) never cools
+    #: down — its failure path IS the CDN leg.
+    dl_cooldown_ms: jax.Array
+    #: [P, C] i32 consecutive failed attempts per prefetch slot —
+    #: salts the "spread" holder hash so retries rotate to a
+    #: DIFFERENT holder instead of re-polling the one that just
+    #: denied/timed out (the agent's ``attempt % len(holders)``
+    #: rotation, p2p_agent.py _schedule_prefetch).  Reset on success.
+    dl_attempts: jax.Array
+    #: [P] f32 how long the foreground has been holding its CDN
+    #: trigger for a live segment no peer serves yet — the agent's
+    #: edge wait is armed at REQUEST time (p2p_agent.py
+    #: _edge_wait_ms), not at publish time, so the stagger must be
+    #: measured from when this peer first wanted the segment.  A
+    #: publish-anchored stagger never binds once the swarm plays
+    #: behind a backlog, leaving every peer in lockstep racing the
+    #: CDN for each frontier segment (the round-4 live-parity bug).
+    fg_wait_ms: jax.Array
 
 
 def packed_words(config: SwarmConfig) -> int:
@@ -362,7 +429,8 @@ def init_swarm(config: SwarmConfig) -> SwarmState:
         avail=jnp.zeros((P, packed_words(config)), jnp.uint32),
         cdn_bytes=f0, p2p_bytes=f0, dl_active=bc, dl_is_p2p=bc,
         dl_seg=ic, dl_level=ic, dl_done_bytes=fc, dl_total_bytes=fc,
-        dl_elapsed_ms=fc, dl_budget_ms=fc)
+        dl_elapsed_ms=fc, dl_budget_ms=fc, dl_cooldown_ms=fc,
+        dl_attempts=ic, fg_wait_ms=f0)
 
 
 def _abr_pick(estimate_bps: jax.Array, bitrates: jax.Array) -> jax.Array:
@@ -379,7 +447,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     (``config.max_concurrency``) are unrolled at trace time: slot 0 is
     the foreground download, slots 1.. are P2P-only prefetches (see
     the ``max_concurrency`` field docs)."""
-    if config.holder_selection not in ("spread", "ranked"):
+    if config.holder_selection not in ("adaptive", "spread", "ranked"):
         # mirror PeerMesh's validation: a typo must not silently
         # simulate the ranked pile-on and fake a zero-gain A/B
         raise ValueError(f"unknown holder_selection "
@@ -432,7 +500,6 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         # neighbor_offsets doc)
         offs = _normalized_offsets(config.neighbor_offsets, P)
         AP = jnp.where(present[:, None], avail_p, jnp.uint32(0))
-        kernel_tile = _pallas_tile(config, offs)
     else:
         # general [P, K] neighbor-list path (arbitrary topologies):
         # XLA gathers — correct everywhere, ~50× slower per edge on
@@ -443,27 +510,27 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         nbr_valid = (nbr != peer_idx[:, None]).astype(jnp.float32)
         present_nbr = present.astype(jnp.float32)[nbr]       # [P, K]
 
+    def bit_mask(gi_flat):
+        """One-hot [P, W] u32 mask selecting each peer's flat
+        (level, seg) bit in the packed cache map."""
+        word_idx = gi_flat >> 5                              # [P] i32
+        bitmask = jnp.uint32(1) << (gi_flat & 31).astype(jnp.uint32)
+        return jnp.where(wcol[None, :] == word_idx[:, None],
+                         bitmask[:, None], jnp.uint32(0))    # [P, W]
+
     def eligibility(gi_flat):
         """(one-hot bit mask, per-edge eligibility, holder count) for
         each peer's [P] flat (level, seg) target."""
-        word_idx = gi_flat >> 5                              # [P] i32
-        bitmask = jnp.uint32(1) << (gi_flat & 31).astype(jnp.uint32)
-        Wm = jnp.where(wcol[None, :] == word_idx[:, None],
-                       bitmask[:, None], jnp.uint32(0))      # [P, W]
+        Wm = bit_mask(gi_flat)
         if circulant:
-            if kernel_tile and offs:
-                from .pallas_elig import eligibility_call
-                fused = eligibility_call(AP, Wm, tuple(offs),
-                                         kernel_tile)       # [K, P]
-                elig = [fused[k].astype(jnp.float32)
-                        for k in range(len(offs))]
-            else:
-                elig = [jnp.sum((jnp.roll(AP, -o, axis=0) & Wm) != 0,
-                                axis=1,
-                                dtype=jnp.int32).astype(jnp.float32)
-                        for o in offs]                       # K × [P]
+            elig = [jnp.sum((jnp.roll(AP, -o, axis=0) & Wm) != 0,
+                            axis=1,
+                            dtype=jnp.int32).astype(jnp.float32)
+                    for o in offs]                           # K × [P]
             n = sum(elig) if elig else zeros
         else:
+            word_idx = gi_flat >> 5
+            bitmask = jnp.uint32(1) << (gi_flat & 31).astype(jnp.uint32)
             got = avail_p[nbr, word_idx[:, None]]            # [P, K] u32
             have = (got & bitmask[:, None]) != 0
             elig = nbr_valid * have.astype(jnp.float32) * present_nbr
@@ -510,17 +577,22 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
             prev = jnp.where(nxt < big, nxt, prev)
         return (pos & (nbr == prev[:, None])).astype(jnp.float32)
 
-    def spread_holder_only(elig, n_holders, gi_seg, salt: int):
+    def spread_holder_only(elig, n_holders, gi_seg, salt: int, rot):
         """Restrict eligibility to ONE eligible holder chosen by a
-        per-(peer, segment, slot) hash — the 'spread' selection
-        policy (config.holder_selection): each requester lands on an
-        effectively uniform-random holder, so demand distributes
-        across ALL holders' uplinks instead of herding onto the
-        shared announce-order head.  Models the mesh's
+        per-(peer, segment, slot, attempt) hash — the 'spread'
+        selection policy (config.holder_selection): each requester
+        lands on an effectively uniform-random holder, so demand
+        distributes across ALL holders' uplinks instead of herding
+        onto the shared announce-order head.  Models the mesh's
         rendezvous-hash holder tie-break
-        (engine/mesh.py PeerMesh.holders_of)."""
+        (engine/mesh.py PeerMesh.holders_of).  ``rot`` (the slot's
+        consecutive-failure count) re-rolls the hash per retry — the
+        agent's failure rotation (p2p_agent.py: ``holders[attempt %
+        len(holders)]``); without it a denied transfer re-polls the
+        same busy holder forever while its neighbors idle."""
         h = (peer_idx32 * jnp.uint32(2654435761)
              + gi_seg.astype(jnp.uint32) * jnp.uint32(40503)
+             + rot.astype(jnp.uint32) * jnp.uint32(3266489917)
              + jnp.uint32((salt * 2246822519 + 97) % (1 << 32)))
         rank = (h % jnp.maximum(n_holders, 1.0).astype(jnp.uint32)) \
             .astype(jnp.int32)
@@ -537,10 +609,23 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         return (pos & (cum == rank[:, None])).astype(jnp.float32)
 
     def select_holder(elig, n_holders, gi_seg, c: int):
+        if config.holder_selection == "adaptive":
+            return spread_holder_only(elig, n_holders, gi_seg, c,
+                                      state.dl_attempts[:, c])
         if config.holder_selection == "spread":
-            return spread_holder_only(elig, n_holders, gi_seg, c)
-        # "ranked": the announce-order pile-on (see nth_holder_only)
-        return nth_holder_only(elig, 1 if (c == 0 and C > 1) else 0)
+            # static rendezvous hash, no failure re-roll (r3 agent)
+            return spread_holder_only(elig, n_holders, gi_seg, c,
+                                      jnp.zeros((P,), jnp.int32))
+        # "ranked": announce-order selection with LOCAL load
+        # differentiation (see nth_holder_only) — holders_of sorts by
+        # my own in-flight count first, so a requester's C concurrent
+        # transfers land on C *different* announce ranks (prefetch
+        # slots take ranks 0..C-2, the foreground the next).  The
+        # ranks themselves are still shared swarm-wide: every
+        # requester's k-th transfer herds onto the same k-th
+        # announcer, which is the (measured) residual pile-on this
+        # mode exists to study.
+        return nth_holder_only(elig, c - 1 if c > 0 else C - 1)
 
     def own_cache(Wm):
         """Does each peer already hold its own target? (bit test —
@@ -592,11 +677,22 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
             # agent prefetch window = playhead → +get_buffer_level_max
             in_window = (raw.astype(jnp.float32) * seg
                          < playhead + config.max_buffer_s)
-            wants_c = present & ~a0 & in_timeline & in_window
+            # retry cooldown: a slot whose last attempt failed waits
+            # out the tick-paced retry delay before asking again
+            wants_c = (present & ~a0 & in_timeline & in_window
+                       & (state.dl_cooldown_ms[:, c] <= 0.0))
             if config.live:
-                wants_c = wants_c & ((raw.astype(jnp.float32) + 1.0) * seg
-                                     <= t)
+                wants_c = wants_c & ((raw.astype(jnp.float32) + 1.0)
+                                     * seg <= t)
         target_flat = want_level * S + target_seg
+        if c > 0:
+            # prefetch dedup guard (`key in self._prefetches`,
+            # p2p_agent.py:453): not already in flight on another
+            # slot.  The FOREGROUND deliberately has no such guard —
+            # the agent's get_segment consults only the cache.
+            conflict = never
+            for (a_o, f_o) in post_flight + pre_flight[c + 1:]:
+                conflict = conflict | (a_o & (f_o == target_flat))
         if config.live:
             # HAVE/announce propagation lag: freshly published
             # segments are P2P-fetchable only announce_delay_s after
@@ -606,14 +702,6 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                            * seg + scenario.announce_delay_s)
         else:
             p2p_visible = jnp.ones((P,), bool)
-        if c > 0:
-            # prefetch dedup guard (`key in self._prefetches`,
-            # p2p_agent.py:453): not already in flight on another
-            # slot.  The FOREGROUND deliberately has no such guard —
-            # the agent's get_segment consults only the cache.
-            conflict = never
-            for (a_o, f_o) in post_flight + pre_flight[c + 1:]:
-                conflict = conflict | (a_o & (f_o == target_flat))
         gi_seg = jnp.where(a0, state.dl_seg[:, c], target_seg)
         gi_level = jnp.where(a0, state.dl_level[:, c], want_level)
         gi_flat = gi_level * S + gi_seg
@@ -631,20 +719,30 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                 wants_dl = fg_wants
             if config.live:
                 # live-edge stagger: with no holder yet, only
-                # low-rank peers hit the CDN now; the rest wait
-                # their stable fraction of the spread and usually
-                # catch the seeders' announcements instead.  (At
-                # spread 0 this is `t >= publish_t`, which `wants`
-                # already guarantees for idle peers — no stagger.)
-                publish_t = (gi_seg.astype(jnp.float32) + 1.0) * seg
-                cdn_allowed = (t >= publish_t
-                               + scenario.edge_rank
-                               * scenario.live_spread_s)
+                # low-rank peers hit the CDN now; the rest hold the
+                # trigger for their stable fraction of the spread and
+                # usually catch the seeders' announcements instead.
+                # The wait is armed at REQUEST time (the agent's
+                # _edge_wait_ms fires when get_segment arrives), NOT
+                # at publish time: a swarm playing behind a backlog
+                # wants each frontier segment long after publish, and
+                # a publish-anchored stagger would never bind there —
+                # leaving every synchronized peer racing the CDN.
+                waited = state.fg_wait_ms + config.dt_ms
+                cdn_allowed = (waited >= scenario.edge_rank
+                               * scenario.live_spread_s * 1000.0)
             else:
                 cdn_allowed = jnp.ones_like(have_n)
             start_p2p = wants_dl & have_n & ~urgent & p2p_visible
             start_cdn = wants_dl & ~start_p2p & (cdn_allowed | urgent)
             may = start_p2p | start_cdn
+            # the wait clock runs only while the foreground is
+            # actively blocked on the stagger; any start (or nothing
+            # to fetch) resets it
+            if config.live:
+                fg_wait = jnp.where(wants_dl & ~may, waited, 0.0)
+            else:
+                fg_wait = state.fg_wait_ms
             is_p2p = jnp.where(may, start_p2p, state.dl_is_p2p[:, c])
             # a P2P download whose holders all departed flips to the
             # CDN — the aggregate analogue of the agent's
@@ -712,6 +810,9 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                     cum_j = cum_j + adm_at_j
                     admitted.append(jnp.roll(adm_at_j, -o))
                 s["elig_adm"] = admitted
+                # which requesters got a slot (BUSY fast-fail needs
+                # the complement)
+                s["admitted"] = sum(admitted, zeros) > 0.0
             load_j = cum_j
         else:
             load_j = zeros
@@ -719,7 +820,8 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                 s["elig_adm"] = s["elig"]
                 for e, o in zip(s["elig"], offs):
                     load_j = load_j + jnp.roll(e * s["demand"], o)
-        service_j = scenario.uplink_bps / jnp.maximum(load_j, 1.0)
+        service_j = (scenario.uplink_bps * scenario.uplink_efficiency
+                     / jnp.maximum(load_j, 1.0))
         rolled_svc = [jnp.roll(service_j, -o) for o in offs]
         for s in slots:
             s["svc"] = sum((e * r
@@ -756,6 +858,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                     scatter_idx.reshape(-1)].max(adm.reshape(-1))
                 s["elig_adm"] = (adm_flat[:P * K].reshape(P, K)
                                  * s["elig"])
+                s["admitted"] = jnp.sum(s["elig_adm"], axis=1) > 0.0
             load_j = cum_j
         else:
             load_j = zeros
@@ -765,7 +868,8 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                                 * s["demand"][:, None]).reshape(-1)
                 load_j = load_j + jnp.sum(
                     jnp.where(in_ok, contrib_flat[in_idx], 0.0), axis=1)
-        service_j = scenario.uplink_bps / jnp.maximum(load_j, 1.0)
+        service_j = (scenario.uplink_bps * scenario.uplink_efficiency
+                     / jnp.maximum(load_j, 1.0))
         svc_nbr = service_j[nbr]                             # [P, K]
         for s in slots:
             s["svc"] = jnp.sum(s["elig_adm"] * svc_nbr, axis=1)
@@ -776,19 +880,36 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     p2p_bytes = state.p2p_bytes
     buffer_add = jnp.where(absorb, seg, 0.0)
     new_cols = {k: [] for k in ("active", "is_p2p", "seg", "level",
-                                "done", "elapsed", "total", "budget")}
+                                "done", "elapsed", "total", "budget",
+                                "cooldown", "attempts")}
     for c, s in enumerate(slots):
         p2p_rate = jnp.minimum(s["demand"] * s["svc"], config.p2p_bps)
-        rate_bps = (jnp.where(s["is_p2p"], p2p_rate, scenario.cdn_bps)
-                    if c == 0 else p2p_rate)
         progressing = s["active"] & present
-        done = s["done"] + jnp.where(progressing, rate_bps * dt_s / 8.0,
-                                     0.0)
         elapsed = s["elapsed"] + jnp.where(progressing, config.dt_ms, 0.0)
+        # setup friction: P2P payload accrues only past p2p_setup_ms
+        # of the transfer's life (REQUEST + first-chunk latency); the
+        # budget/timeout clocks run from the start, like the mesh's
+        p2p_live_ms = jnp.clip(elapsed - scenario.p2p_setup_ms,
+                               0.0, config.dt_ms)
+        p2p_step = p2p_rate * p2p_live_ms / 8000.0
+        step_bytes = (jnp.where(s["is_p2p"], p2p_step,
+                                scenario.cdn_bps * dt_s / 8.0)
+                      if c == 0 else p2p_step)
+        done = s["done"] + jnp.where(progressing, step_bytes, 0.0)
         completed = progressing & (done >= s["total"])
         active = s["active"] & ~completed
         is_p2p = s["is_p2p"]
+        cooled = jnp.maximum(state.dl_cooldown_ms[:, c] - config.dt_ms,
+                             0.0)
         if c == 0:
+            if cap > 0:
+                # BUSY fast-fail (mesh Deny → scheduler to_cdn): a
+                # foreground P2P start the holder did not admit flips
+                # to the CDN now instead of stalling out its budget
+                denied = s["may"] & is_p2p & s["have_n"] & ~s["admitted"]
+                is_p2p = is_p2p & ~denied
+                done = jnp.where(denied, 0.0, done)
+                elapsed = jnp.where(denied, 0.0, elapsed)
             # budget failover (engine/p2p_agent.py _start_p2p_leg →
             # to_cdn): a P2P attempt that outlives its budget
             # concedes to the CDN, DISCARDING partial bytes — the
@@ -803,16 +924,30 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
             p2p_bytes = p2p_bytes + jnp.where(completed & is_p2p,
                                               s["total"], 0.0)
             buffer_add = buffer_add + jnp.where(completed, seg, 0.0)
+            cooldown = cooled  # the foreground's failure path IS the CDN
+            attempts = state.dl_attempts[:, c]  # unused on slot 0
         else:
-            # a prefetch whose holders vanished OR whose per-attempt
-            # request timeout expired is dropped (the agent's
-            # on_error path discards the attempt; no CDN leg)
+            # a prefetch whose holders vanished, whose per-attempt
+            # request timeout expired, OR whose start the holder
+            # denied (BUSY fast-fail under the admission cap) is
+            # dropped (the agent's on_error path discards the
+            # attempt; no CDN leg) — and the slot cools down for the
+            # tick-paced retry delay before asking again
             aborted = (active & ~s["have_n"]) | (
                 active & (elapsed >= scenario.request_timeout_ms))
+            if cap > 0:
+                aborted = aborted | (s["may"] & active & s["have_n"]
+                                     & ~s["admitted"])
             active = active & ~aborted
             done = jnp.where(aborted, 0.0, done)
             elapsed = jnp.where(aborted, 0.0, elapsed)
             p2p_bytes = p2p_bytes + jnp.where(completed, s["total"], 0.0)
+            cooldown = jnp.where(aborted, scenario.retry_dead_ms, cooled)
+            # failure rotation (see spread_holder_only's rot): bump
+            # on every failed attempt, reset once one succeeds
+            attempts = jnp.where(
+                completed, 0,
+                state.dl_attempts[:, c] + aborted.astype(jnp.int32))
         # cache insert: one-hot bit OR instead of a scatter — touches
         # the whole packed bitmap but runs at vector throughput; TPU
         # scatter serializes its updates.  A slot can only complete
@@ -837,6 +972,8 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         new_cols["elapsed"].append(elapsed)
         new_cols["total"].append(s["total"])
         new_cols["budget"].append(s["budget"])
+        new_cols["cooldown"].append(cooldown)
+        new_cols["attempts"].append(attempts)
 
     avail = avail_p | insert
     buffer_s = state.buffer_s + buffer_add
@@ -864,7 +1001,8 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         dl_is_p2p=stack("is_p2p"), dl_seg=stack("seg"),
         dl_level=stack("level"), dl_done_bytes=stack("done"),
         dl_total_bytes=stack("total"), dl_elapsed_ms=stack("elapsed"),
-        dl_budget_ms=stack("budget"))
+        dl_budget_ms=stack("budget"), dl_cooldown_ms=stack("cooldown"),
+        dl_attempts=stack("attempts"), fg_wait_ms=fg_wait)
 
 
 @partial(jax.jit, static_argnames=("config", "n_steps"))
@@ -889,7 +1027,8 @@ def run_swarm(config: SwarmConfig, bitrates: jax.Array,
               urgent_margin_s=None, p2p_budget_fraction=None,
               p2p_budget_cap_ms=None, p2p_budget_floor_ms=None,
               live_spread_s=None, request_timeout_ms=None,
-              announce_delay_s=None,
+              announce_delay_s=None, p2p_setup_ms=None,
+              uplink_efficiency=None, retry_dead_ms=None,
               ) -> Tuple[SwarmState, jax.Array]:
     """Scan ``n_steps`` ticks; returns (final state, offload-over-time
     ``[n_steps]``).  One compiled program regardless of T — and of any
@@ -905,7 +1044,8 @@ def run_swarm(config: SwarmConfig, bitrates: jax.Array,
         p2p_budget_floor_ms=p2p_budget_floor_ms,
         live_spread_s=live_spread_s,
         request_timeout_ms=request_timeout_ms,
-        announce_delay_s=announce_delay_s)
+        announce_delay_s=announce_delay_s, p2p_setup_ms=p2p_setup_ms,
+        uplink_efficiency=uplink_efficiency, retry_dead_ms=retry_dead_ms)
     return _run_swarm(config, scenario, state, n_steps)
 
 
@@ -974,9 +1114,10 @@ def step_hbm_bytes(config: SwarmConfig, n_neighbors: int = 8) -> float:
     TPU-friendliness over per-element gather/scatter (which measure
     ~50× slower per edge, tools/profile_kernels.py).  General path:
     the O(P·K) edge gathers dominate instead.  Both add per-peer
-    state (17 f32/i32 [P] fields + 4 EWMA leaves + C transfer-slot
-    columns, read and written each step as the scan carry) and
-    scenario reads.
+    state (14 f32/i32 [P] fields incl. the 4 EWMA leaves and
+    fg_wait_ms, plus 10 [P, C] transfer-slot columns incl. the
+    round-4 cooldown/attempt fields, read and written each step as
+    the scan carry) and scenario reads.
 
     This model counts only algorithmically-required traffic (perfect
     fusion); fusion-boundary spills make the REAL traffic higher, so
@@ -985,7 +1126,10 @@ def step_hbm_bytes(config: SwarmConfig, n_neighbors: int = 8) -> float:
     P = config.n_peers
     W = packed_words(config)
     C = config.max_concurrency
-    state_rw = 2.0 * (13.0 + 8.0 * C) * 4.0 * P
+    # 14 [P] f32/i32 fields (incl. fg_wait_ms) + 10 [P, C] transfer-
+    # slot columns (incl. the round-4 dl_cooldown_ms / dl_attempts),
+    # each read and written as scan carry
+    state_rw = 2.0 * (14.0 + 10.0 * C) * 4.0 * P
     scenario_reads = 5.0 * 4.0 * P
     cache_insert = 2.0 * 4.0 * P * W        # packed map read + rewritten
     if config.neighbor_offsets is not None:
@@ -1046,27 +1190,6 @@ def stable_ranks(n_peers: int, seed: int = 0) -> jnp.ndarray:
     stagger — the device-side analogue of the agent's hashed
     ``_edge_rank`` (engine/p2p_agent.py)."""
     return jax.random.uniform(jax.random.PRNGKey(seed), (n_peers,))
-
-
-def _pallas_tile(config: SwarmConfig, offsets: list) -> int:
-    """Peer-axis tile for the fused eligibility kernel, or 0 to use
-    the jnp formulation.  OPT-IN only (``use_pallas=True``; see the
-    config field for why it is not the default), and requires a real
-    TPU (no CPU lowering), whole tiles, and a halo that fits —
-    anything missing falls back to the jnp stencil."""
-    if config.use_pallas is not True or not offsets:
-        return 0
-    try:
-        from .pallas_elig import HAVE_PALLAS, pick_tile
-    except ImportError:
-        return 0
-    if not HAVE_PALLAS or jax.devices()[0].platform != "tpu":
-        return 0
-    tile = pick_tile(config.n_peers)
-    halo = max((abs(o) for o in offsets), default=0)
-    if tile == 0 or halo > tile:
-        return 0
-    return tile
 
 
 def _normalized_offsets(offsets: Tuple[int, ...], n_peers: int) -> list:

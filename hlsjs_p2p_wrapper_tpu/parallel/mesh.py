@@ -77,7 +77,8 @@ def state_shardings(mesh: Mesh) -> SwarmState:
         dl_active=peer_vec, dl_is_p2p=peer_vec, dl_seg=peer_vec,
         dl_level=peer_vec, dl_done_bytes=peer_vec,
         dl_total_bytes=peer_vec, dl_elapsed_ms=peer_vec,
-        dl_budget_ms=peer_vec)
+        dl_budget_ms=peer_vec, dl_cooldown_ms=peer_vec,
+        dl_attempts=peer_vec, fg_wait_ms=peer_vec)
 
 
 def scenario_shardings(mesh: Mesh) -> SwarmScenario:
@@ -97,7 +98,8 @@ def scenario_shardings(mesh: Mesh) -> SwarmScenario:
         urgent_margin_s=rep, p2p_budget_fraction=rep,
         p2p_budget_cap_ms=rep, p2p_budget_floor_ms=rep,
         live_spread_s=rep, request_timeout_ms=rep,
-        announce_delay_s=rep)
+        announce_delay_s=rep, p2p_setup_ms=rep,
+        uplink_efficiency=rep, retry_dead_ms=rep)
 
 
 def shard_swarm(mesh: Mesh, scenario: SwarmScenario, state: SwarmState):

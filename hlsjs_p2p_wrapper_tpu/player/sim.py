@@ -93,6 +93,16 @@ class SimPlayer(EventEmitter):
 
         self.abr = AbrController(self)
         self.current_level = 0
+        #: hls.js fires LEVEL_SWITCH on EVERY level assignment,
+        #: including the initial selection at playback start (its
+        #: level-controller's setter has no was-it-different guard on
+        #: first set) — so the first fetch must announce the level
+        #: even when ABR keeps the default.  Without this, a
+        #: constant-level session never tells the agent its track and
+        #: the prefetcher sits dark for the whole session (found by
+        #: round-4 harness instrumentation: 1-level swarms ran
+        #: foreground-only).
+        self._level_announced = False
         self.frag_last_kbps = 0
 
         self.buffer_end = 0.0          # contiguous buffer ahead of playhead
@@ -284,7 +294,8 @@ class SimPlayer(EventEmitter):
             return
 
         next_level = self.abr.next_level(self._levels)
-        if next_level != self.current_level:
+        if next_level != self.current_level or not self._level_announced:
+            self._level_announced = True
             self.current_level = next_level
             self.emit(Events.LEVEL_SWITCH, {"level": next_level})
 

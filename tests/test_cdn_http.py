@@ -1,0 +1,322 @@
+"""The production origin leg, end-to-end (VERDICT r3 missing #1).
+
+``HttpCdnTransport`` is the SHIPPED default origin transport
+(engine/p2p_agent.py:122-123) — these tests drive it through a real
+stdlib ``http.server`` on localhost: fetch success + progress cadence,
+``Range: bytes=a-b`` inclusive-end slicing, HTTP error status
+propagation into the loader's retry path, mid-transfer abort, and one
+full-stack e2e of the exact production fabric combination — a 3-peer
+swarm on ``TcpNetwork`` with the HTTP CDN as origin.  No external
+network: everything binds 127.0.0.1.  Reference analogue: the Karma
+suite loading a real ``.ts`` segment over HTTP
+(test/html/p2p-loader-generator.js:8-137).
+"""
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core.loader import p2p_loader_generator
+from hlsjs_p2p_wrapper_tpu.core.segment_view import SegmentView
+from hlsjs_p2p_wrapper_tpu.core.track_view import TrackView
+from hlsjs_p2p_wrapper_tpu.engine.cdn import HttpCdnTransport
+from hlsjs_p2p_wrapper_tpu.engine.cdn_agent import CdnOnlyAgent
+from hlsjs_p2p_wrapper_tpu.engine.net import TcpNetwork
+from hlsjs_p2p_wrapper_tpu.engine.p2p_agent import P2PAgent
+from hlsjs_p2p_wrapper_tpu.engine.tracker import Tracker, TrackerEndpoint
+from hlsjs_p2p_wrapper_tpu.testing import FakePlayer
+from hlsjs_p2p_wrapper_tpu.testing.mock_cdn import synthetic_payload
+from hlsjs_p2p_wrapper_tpu.testing.seed_process import (NullBridge,
+                                                        NullMediaMap)
+
+SEGMENT_BYTES = 200_000  # > 3 × HttpCdnTransport.CHUNK_SIZE
+
+
+def wait_for(predicate, timeout_s=8.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+class _OriginHandler(BaseHTTPRequestHandler):
+    """Minimal HLS origin: ``/seg{sn}.ts`` with Range support (206,
+    inclusive end — the on-wire convention the loader produces),
+    ``/missing.ts`` → 404, ``/boom.ts`` → 500, ``/flaky.ts`` → 503
+    twice then 200, ``/slow.ts`` → a trickled body for abort tests."""
+
+    server_version = "TestOrigin/1"
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        if self.path == "/missing.ts":
+            self.send_error(404)
+            return
+        if self.path == "/boom.ts":
+            self.send_error(500)
+            return
+        if self.path == "/flaky.ts":
+            self.server.flaky_hits += 1
+            if self.server.flaky_hits <= 2:
+                self.send_error(503)
+                return
+        if self.path == "/slow.ts":
+            payload = synthetic_payload(self._url(), SEGMENT_BYTES)
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            try:
+                for i in range(0, len(payload), 10_000):
+                    self.wfile.write(payload[i:i + 10_000])
+                    self.wfile.flush()
+                    time.sleep(0.05)
+            except (BrokenPipeError, ConnectionResetError):
+                self.server.slow_broken = True
+            return
+
+        payload = synthetic_payload(self._url(), SEGMENT_BYTES)
+        range_header = self.headers.get("Range")
+        self.server.seen_ranges.append(range_header)
+        status = 200
+        if range_header:
+            spec = range_header.split("=", 1)[1]
+            start_s, end_s = spec.split("-", 1)
+            start = int(start_s) if start_s else 0
+            end = int(end_s) + 1 if end_s else len(payload)
+            payload = payload[start:end]
+            status = 206
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _url(self):
+        # payloads are derived from the CANONICAL url (no host/port)
+        # so the e2e peers and the test agree on the expected bytes
+        return f"http://origin{self.path}"
+
+    def log_message(self, *args):
+        pass  # keep pytest output clean
+
+
+@pytest.fixture(scope="module")
+def origin():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _OriginHandler)
+    server.seen_ranges = []
+    server.flaky_hits = 0
+    server.slow_broken = False
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    yield SimpleNamespace(server=server, base=base)
+    server.shutdown()
+    server.server_close()
+
+
+def fetch(transport, url, headers=None):
+    """Drive one fetch to completion; returns (events, progresses)."""
+    done = threading.Event()
+    out = {"progress": []}
+
+    def on_success(data):
+        out["data"] = data
+        done.set()
+
+    def on_error(err):
+        out["error"] = err
+        done.set()
+
+    handle = transport.fetch(
+        {"url": url, "headers": headers or {}},
+        {"on_success": on_success, "on_error": on_error,
+         "on_progress": lambda e: out["progress"].append(e)})
+    out["handle"] = handle
+    out["done"] = done
+    return out
+
+
+def test_fetch_success_with_progress_cadence(origin):
+    """A full fetch delivers the exact payload with CUMULATIVE progress
+    events at the chunk cadence, the last one covering every byte."""
+    transport = HttpCdnTransport()
+    out = fetch(transport, f"{origin.base}/seg1.ts")
+    assert out["done"].wait(8.0)
+    assert "error" not in out
+    assert out["data"] == synthetic_payload("http://origin/seg1.ts",
+                                            SEGMENT_BYTES)
+    counts = [e["cdn_downloaded"] for e in out["progress"]]
+    assert len(counts) >= 3                      # 200 kB / 64 KiB chunks
+    assert counts == sorted(counts)              # cumulative, monotonic
+    assert counts[-1] == SEGMENT_BYTES
+
+
+def test_fetch_applies_range_header_inclusive_end(origin):
+    """The loader emits ``Range: bytes=a-b`` with an INCLUSIVE end
+    (core/loader.py:170); a real origin must yield payload[a:b+1]."""
+    transport = HttpCdnTransport()
+    out = fetch(transport, f"{origin.base}/seg2.ts",
+                headers={"Range": "bytes=100-299"})
+    assert out["done"].wait(8.0)
+    full = synthetic_payload("http://origin/seg2.ts", SEGMENT_BYTES)
+    assert out["data"] == full[100:300]
+    assert "bytes=100-299" in origin.server.seen_ranges
+
+
+def test_fetch_http_error_status_propagates(origin):
+    transport = HttpCdnTransport()
+    for path, status in (("/missing.ts", 404), ("/boom.ts", 500)):
+        out = fetch(transport, f"{origin.base}{path}")
+        assert out["done"].wait(8.0)
+        assert out.get("error") == {"status": status}
+        assert "data" not in out
+
+
+def test_fetch_connection_refused_is_status_zero():
+    """Transport-level failure (nothing listening) surfaces as the
+    XHR-shaped ``{"status": 0}`` — the same contract as every other
+    terminal error (loader-generator.js:103-112)."""
+    transport = HttpCdnTransport(timeout_s=2.0)
+    out = fetch(transport, "http://127.0.0.1:1/seg.ts")
+    assert out["done"].wait(8.0)
+    assert out.get("error") == {"status": 0}
+
+
+def test_mid_transfer_abort_stops_delivery(origin):
+    """Aborting mid-body must suppress BOTH terminal callbacks and
+    stop reading the stream (the server sees the pipe break)."""
+    transport = HttpCdnTransport()
+    out = fetch(transport, f"{origin.base}/slow.ts")
+    assert wait_for(lambda: out["progress"]), "no first progress"
+    out["handle"].abort()
+    progressed = len(out["progress"])
+    assert not out["done"].wait(1.5)      # neither success nor error
+    assert "data" not in out and "error" not in out
+    # and the reader genuinely stopped: no further progress accrues
+    time.sleep(0.3)
+    assert len(out["progress"]) <= progressed + 1
+
+
+def _loader_harness(origin, max_retry, retry_delay=50):
+    """A real P2PLoader wired to a CdnOnlyAgent over the REAL HTTP
+    transport (wall clock: retries fire on actual timers)."""
+    agent = CdnOnlyAgent(NullBridge(), f"{origin.base}/master.m3u8",
+                         NullMediaMap(), {"cdn_transport": HttpCdnTransport()},
+                         SegmentView, "hls", "v2")
+    wrapper = SimpleNamespace(peer_agent_module=agent,
+                              player=FakePlayer(3, live=False), clock=None)
+    loader = p2p_loader_generator(wrapper)(None)
+    events = {"success": [], "error": [], "done": threading.Event()}
+
+    def load(url):
+        loader.load(
+            url, "arraybuffer",
+            lambda ev, stats: (events["success"].append((ev, stats)),
+                               events["done"].set()),
+            lambda ev: (events["error"].append(ev), events["done"].set()),
+            lambda ev, stats: None,
+            20_000, max_retry, retry_delay,
+            on_progress=lambda ev, stats: None,
+            frag=SimpleNamespace(sn=30, level=0, start=300.0,
+                                 byte_range_start_offset=None,
+                                 byte_range_end_offset=None))
+        return loader
+
+    return load, events
+
+
+def test_loader_retries_through_real_http_errors(origin):
+    """503 twice then 200: the loader's capped-backoff retry path
+    (core/loader.py:219-228) must recover through a REAL origin and
+    deliver the payload, with the retry count on its stats."""
+    origin.server.flaky_hits = 0
+    load, events = _loader_harness(origin, max_retry=3)
+    loader = load(f"{origin.base}/flaky.ts")
+    assert events["done"].wait(10.0)
+    assert events["error"] == []
+    (event, stats), = events["success"]
+    assert event["current_target"]["response"] == synthetic_payload(
+        "http://origin/flaky.ts", SEGMENT_BYTES)
+    assert stats["retry"] == 2
+    assert loader.stats["loaded"] == SEGMENT_BYTES
+
+
+def test_loader_exhausts_retries_with_real_status(origin):
+    """A permanently-404 origin: after max_retry attempts the loader
+    surfaces the REAL terminal status, XHR-shaped."""
+    load, events = _loader_harness(origin, max_retry=1)
+    load(f"{origin.base}/missing.ts")
+    assert events["done"].wait(10.0)
+    assert events["success"] == []
+    assert events["error"] == [{"target": {"status": 404}}]
+
+
+def test_full_stack_tcp_swarm_with_http_origin(origin):
+    """The production fabric combination, assembled end-to-end: three
+    full P2P agents on real TCP sockets, a socket tracker, and the
+    REAL HTTP CDN as origin.  The seeder pulls from the origin over
+    HTTP; both followers then fetch the same segment P2P — their CDN
+    byte counters must stay zero."""
+    net = TcpNetwork()
+    tracker_endpoint = net.register()
+    TrackerEndpoint(Tracker(net.loop), tracker_endpoint)
+    url = f"{origin.base}/seg7.ts"
+    # canonical-URL payload: what the origin synthesizes for /seg7.ts
+    expected = synthetic_payload("http://origin/seg7.ts", SEGMENT_BYTES)
+    sv = SegmentView(sn=7, track_view=TrackView(level=0, url_id=0),
+                     time=70.0)
+
+    def make_agent():
+        return P2PAgent(
+            NullBridge(), f"{origin.base}/master.m3u8", NullMediaMap(),
+            {"network": net, "clock": net.loop,
+             "cdn_transport": HttpCdnTransport(),
+             "tracker_peer_id": tracker_endpoint.peer_id,
+             "content_id": "http-origin-demo",
+             "announce_interval_ms": 200.0},
+            SegmentView, "hls", "v2")
+
+    agents = [make_agent() for _ in range(3)]
+    seeder, followers = agents[0], agents[1:]
+    try:
+        assert wait_for(lambda: all(a.stats["peers"] == 2 for a in agents),
+                        timeout_s=12.0), "mesh never fully connected"
+
+        done = threading.Event()
+        result = {}
+        seeder.get_segment(
+            {"url": url, "headers": {}},
+            {"on_success": lambda d: (result.__setitem__("seed", d),
+                                      done.set()),
+             "on_error": lambda e: (result.__setitem__("err", e),
+                                    done.set()),
+             "on_progress": lambda e: None}, sv)
+        assert done.wait(10.0) and "err" not in result, result.get("err")
+        assert result["seed"] == expected
+        assert seeder.stats["cdn"] == SEGMENT_BYTES  # origin leg was HTTP
+
+        key = sv.to_bytes()
+        assert wait_for(lambda: all(
+            seeder.peer_id in f.mesh.holders_of(key) for f in followers))
+
+        for i, follower in enumerate(followers):
+            got = threading.Event()
+            follower.get_segment(
+                {"url": url, "headers": {}},
+                {"on_success": lambda d, i=i: (result.__setitem__(i, d),
+                                               got.set()),
+                 "on_error": lambda e: pytest.fail(f"p2p error {e}"),
+                 "on_progress": lambda e: None}, sv)
+            assert got.wait(10.0)
+            assert result[i] == expected
+            assert follower.stats["cdn"] == 0      # never touched HTTP
+            assert follower.stats["p2p"] == SEGMENT_BYTES
+        assert wait_for(
+            lambda: seeder.stats["upload"] == 2 * SEGMENT_BYTES)
+    finally:
+        for agent in agents:
+            agent.dispose()
+        net.close()

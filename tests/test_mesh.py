@@ -488,6 +488,94 @@ def test_total_serve_admission_control():
     assert all(e == {"status": 503} for _i, e in denies)
 
 
+def test_total_serves_zero_means_uncapped():
+    """``max_total_serves=0`` is the simulator's documented UNCAPPED
+    convention (ops/swarm_sim.py SwarmConfig) — carried into the
+    mesh it must fair-share, not deny every serve BUSY (the inverted
+    semantics ADVICE r3 flagged)."""
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    endpoint_b = net.register("b", uplink_bps=100_000.0)
+    cache_b = SegmentCache(max_bytes=1 << 22)
+    mesh_b = PeerMesh(endpoint_b, "s", clock, cache_b,
+                      max_total_serves=0)
+    endpoint_b.on_receive = \
+        lambda src, frame: mesh_b.handle_frame(src, P.decode(frame))
+    for sn in range(1, 7):
+        cache_b.put(key(sn), bytes(200_000))
+    requesters = []
+    for i in range(6):
+        mesh, _cache = make_mesh(net, clock, f"r{i}")
+        mesh.connect_to("b")
+        requesters.append(mesh)
+    clock.advance(50.0)
+    denies = []
+    for i, mesh in enumerate(requesters):
+        mesh.request("b", key(i + 1), on_success=lambda p: None,
+                     on_error=lambda e, i=i: denies.append((i, e)))
+    clock.advance(2_000.0)
+    assert denies == []               # nothing denied...
+    assert len(mesh_b._uploads) == 6  # ...everything admitted
+
+
+def test_edge_attribution_prunes_lazily_keeping_fresh_edges():
+    """At the attribution cap, a brand-new edge's first chunk must
+    survive the prune (ADVICE r3: eager at-cap pruning evicted the
+    entry just added, since a fresh edge starts smallest)."""
+    edges = {f"old-{i}": 10_000 + i
+             for i in range(2 * PeerMesh.MAX_EDGE_ENTRIES)}
+    PeerMesh._bump_edge(edges, "fresh", 1)
+    assert edges["fresh"] == 1                      # the new edge survived
+    assert len(edges) <= PeerMesh.MAX_EDGE_ENTRIES + 1
+
+
+def test_adaptive_selection_routes_around_busy_holder():
+    """"adaptive" (the default): a holder that denies BUSY or times
+    out is deprioritized for HOLDER_PENALTY_MS, then restored — the
+    congestion feedback VERDICT r3 #3 asked for, so a requester stops
+    re-electing a loaded holder by hash while its uplink drains."""
+    from hlsjs_p2p_wrapper_tpu.engine.mesh import HOLDER_PENALTY_MS
+
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    mesh_a, _ = make_mesh(net, clock, "a")
+    assert mesh_a.holder_selection == "adaptive"  # the default
+    meshes = {}
+    for name in ("b", "c"):
+        meshes[name], cache = make_mesh(net, clock, name)
+        cache.put(key(1), bytes(1000))
+        mesh_a.connect_to(name)
+    clock.advance(50.0)
+    base = mesh_a.holders_of(key(1))
+    assert set(base) == {"b", "c"}
+    preferred = base[0]
+
+    # the hash-preferred holder denies BUSY → penalized, sorts last
+    errors = []
+    handle = mesh_a.request(preferred, key(1),
+                            on_success=lambda d: pytest.fail("served"),
+                            on_error=errors.append)
+    mesh_a.handle_frame(preferred,
+                        P.Deny(handle._request_id, P.DenyReason.BUSY))
+    assert errors == [{"status": 503}]
+    assert mesh_a.holders_of(key(1))[0] != preferred
+    assert set(mesh_a.holders_of(key(1))) == {"b", "c"}  # still known
+    # ...and the penalty expires: hash order is restored
+    clock.advance(HOLDER_PENALTY_MS + 1.0)
+    assert mesh_a.holders_of(key(1)) == base
+
+    # a silent timeout penalizes the same way
+    errors.clear()
+    mesh_a.request(preferred, key(1),
+                   on_success=lambda d: None, on_error=errors.append,
+                   timeout_ms=100.0)
+    # drop the request frame so the serve never happens
+    meshes[preferred].drop_peer("a")
+    clock.advance(200.0)
+    assert errors == [{"status": 0}]
+    assert mesh_a.holders_of(key(1))[0] != preferred
+
+
 def test_spread_policy_breaks_holder_ties_differently():
     """With "spread" (the default), two requesters with identical
     local load order the same holder set differently (rendezvous

@@ -177,9 +177,18 @@ def test_run_until_all_finished_reports_timeout():
 
 def test_scheduling_policy_ab_offload_and_waste():
     """The round-3 scheduling fix, pinned at the harness level: under
-    tight uplinks the spread + admission-control defaults must beat
-    the legacy announce-order herding on BOTH north-star-adjacent
-    axes — offload up, upload waste down — without costing playback."""
+    tight uplinks the spread + admission + rotation defaults must
+    beat the full round-2 legacy configuration (announce-order
+    herding, uncapped serves, head-holder retries) on BOTH
+    north-star-adjacent axes — offload up, upload waste down —
+    without costing playback.
+
+    Margin note (round 4): with the prefetcher running in 1-level
+    sessions (the initial-LEVEL_SWITCH fix), a requester's concurrent
+    transfers already spread across holders via the mesh's local-load
+    ordering, so legacy herding costs ~0.13 offload and ~1.5× waste
+    here rather than round 3's dramatic 3×/7× (those numbers were
+    measured against a harness whose prefetcher was dark)."""
     def run(**p2p):
         swarm = SwarmHarness(seg_duration=4.0, frag_count=24,
                              level_bitrates=(800_000,),
@@ -191,11 +200,41 @@ def test_scheduling_policy_ab_offload_and_waste():
         assert swarm.run_until_all_finished()
         return swarm
 
-    fixed = run()
-    legacy = run(holder_selection="ranked", max_total_serves=10_000)
-    assert fixed.offload_ratio > 2.0 * legacy.offload_ratio
-    assert fixed.upload_waste_ratio < legacy.upload_waste_ratio / 2.0
+    fixed = run()  # the r4 default: adaptive + admission + rotation
+    legacy = run(holder_selection="ranked", max_total_serves=10_000,
+                 prefetch_rotation=False)
+    spread = run(holder_selection="spread")  # the r3 default
+    assert fixed.offload_ratio > legacy.offload_ratio + 0.10
+    assert fixed.upload_waste_ratio < legacy.upload_waste_ratio - 0.3
     assert fixed.rebuffer_ratio <= legacy.rebuffer_ratio + 0.01
+    # the r4 acceptance bar (VERDICT r3 #3) at the harness level:
+    # adaptive within 0.02 of the best alternative in this cell
+    best = max(legacy.offload_ratio, spread.offload_ratio)
+    assert fixed.offload_ratio >= best - 0.02, \
+        (fixed.offload_ratio, legacy.offload_ratio, spread.offload_ratio)
+
+
+def test_initial_level_announced_so_prefetch_runs_in_flat_streams():
+    """hls.js fires LEVEL_SWITCH on its FIRST level assignment, not
+    only on changes — so even a session whose ABR never moves must
+    tell the agent its track (round-4 fix: without the initial
+    announcement, 1-level swarms ran foreground-only and the whole
+    prefetch machinery sat dark, silently skewing every swarm
+    measurement)."""
+    swarm = SwarmHarness(seg_duration=4.0, frag_count=12,
+                         level_bitrates=(800_000,),  # 1 level: no switches
+                         cdn_bandwidth_bps=8_000_000.0)
+    seeder = swarm.add_peer("seed")
+    swarm.run(20_000.0)
+    late = swarm.add_peer("late")
+    swarm.run(20_000.0)
+    # both agents know their track despite zero ABR level changes...
+    assert seeder.agent._current_track is not None
+    assert late.agent._current_track is not None
+    # ...and the late joiner genuinely prefetches ahead of playback:
+    # more segments cached than its playhead has consumed
+    played = int(late.position_s / 4.0) + 1
+    assert len(late.agent.cache.entries()) > played
 
 
 def test_prefetch_retry_rotates_holders():
