@@ -282,14 +282,16 @@ class _Connection:
             host, port_s = self.remote_id.rsplit(":", 1)
             sock = socket.create_connection((host, int(port_s)),
                                             timeout=HANDSHAKE_TIMEOUT_S)
+            # one absolute deadline for the whole handshake: a
+            # byte-dribbling acceptor must not wedge the writer thread
+            deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S
             raw = self.endpoint.peer_id.encode()
             sock.sendall(_LEN.pack(len(raw)) + raw)
             psk = self.endpoint.network.psk
             if psk is not None:
-                # prove swarm membership before any protocol frame:
-                # answer the acceptor's nonce (still on the handshake
-                # timeout — a silent acceptor must not wedge the writer)
-                nonce = _read_frame(sock, max_bytes=MAX_AUTH_BYTES)
+                # prove swarm membership before any protocol frame
+                nonce = _read_frame(sock, max_bytes=MAX_AUTH_BYTES,
+                                    deadline=deadline)
                 if nonce is None:
                     sock.close()
                     return None
@@ -325,13 +327,24 @@ class _Connection:
         self.endpoint._forget(self)
 
 
-def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def _read_exact(sock: socket.socket, n: int,
+                deadline: Optional[float] = None) -> Optional[bytes]:
+    """Read exactly ``n`` bytes.  With a ``deadline`` (absolute
+    ``time.monotonic()`` seconds), every recv runs under the REMAINING
+    budget — a per-recv timeout alone would let a byte-dribbling
+    client pin the thread ~indefinitely (one byte per almost-timeout),
+    which is exactly the handshake DoS the deadline exists to close."""
     buf = bytearray()
     while len(buf) < n:
         try:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                sock.settimeout(remaining)
             chunk = sock.recv(n - len(buf))
         except OSError:
-            return None  # connection torn down under us
+            return None  # connection torn down under us (or expired)
         if not chunk:
             return None
         buf.extend(chunk)
@@ -339,14 +352,15 @@ def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 def _read_frame(sock: socket.socket,
-                max_bytes: int = MAX_FRAME_BYTES) -> Optional[bytes]:
-    header = _read_exact(sock, _LEN.size)
+                max_bytes: int = MAX_FRAME_BYTES,
+                deadline: Optional[float] = None) -> Optional[bytes]:
+    header = _read_exact(sock, _LEN.size, deadline)
     if header is None:
         return None
     (length,) = _LEN.unpack(header)
     if length > max_bytes:
         return None  # poisoned stream; drop the connection
-    return _read_exact(sock, length)
+    return _read_exact(sock, length, deadline)
 
 
 class TcpEndpoint:
@@ -438,14 +452,12 @@ class TcpEndpoint:
     MAX_PREAMBLE_BYTES = 512
 
     def _handshake_inbound(self, sock: socket.socket) -> None:
-        try:
-            # the whole identity handshake runs under one timeout: a
-            # connection that sends nothing must not pin this thread
-            sock.settimeout(HANDSHAKE_TIMEOUT_S)
-        except OSError:
-            sock.close()
-            return
-        preamble = _read_frame(sock, max_bytes=self.MAX_PREAMBLE_BYTES)
+        # the whole identity handshake runs under ONE absolute
+        # deadline: a connection that sends nothing — or dribbles one
+        # byte per almost-timeout — must not pin this thread
+        deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S
+        preamble = _read_frame(sock, max_bytes=self.MAX_PREAMBLE_BYTES,
+                               deadline=deadline)
         if preamble is None:
             sock.close()
             return
@@ -483,7 +495,8 @@ class TcpEndpoint:
             except OSError:
                 sock.close()
                 return
-            mac = _read_frame(sock, max_bytes=MAX_AUTH_BYTES)
+            mac = _read_frame(sock, max_bytes=MAX_AUTH_BYTES,
+                              deadline=deadline)
             if mac is None or not hmac.compare_digest(
                     mac, _psk_response(psk, nonce, preamble)):
                 log.warning("rejecting unauthenticated inbound claiming "

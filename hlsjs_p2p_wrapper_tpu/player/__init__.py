@@ -1,8 +1,13 @@
-"""The in-tree simulated media engine (hls.js-analog L0 layer)."""
+"""The in-tree media engines (hls.js-analog L0 layer): the
+full-dynamics :class:`SimPlayer` and the deliberately
+differently-shaped :class:`MinimalPlayer` (the second implementation
+the integration seam is proven against)."""
 
 from .manifest import (Frag, LevelSpec, Manifest, make_vod_manifest,
                        segment_size_bytes)
+from .minimal import MinimalEvents, MinimalPlayer
 from .sim import MediaElementSim, SimPlayer
 
 __all__ = ["Frag", "LevelSpec", "Manifest", "make_vod_manifest",
-           "segment_size_bytes", "MediaElementSim", "SimPlayer"]
+           "segment_size_bytes", "MediaElementSim", "SimPlayer",
+           "MinimalEvents", "MinimalPlayer"]

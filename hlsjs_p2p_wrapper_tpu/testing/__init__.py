@@ -1,9 +1,11 @@
-"""First-class test fakes (the reference's mocks, promoted)."""
+"""First-class test fakes (the reference's mocks, promoted) and the
+executable media-engine contract."""
 
 from .fixtures import DEFAULT_CONFIG, FakePlayer, make_fragments
 from .mock_cdn import MockCdnTransport, serve_manifest, synthetic_payload
+from .player_contract import run_player_contract
 from .swarm import SwarmHarness, SwarmPeer
 
 __all__ = ["DEFAULT_CONFIG", "FakePlayer", "make_fragments",
            "MockCdnTransport", "serve_manifest", "synthetic_payload",
-           "SwarmHarness", "SwarmPeer"]
+           "SwarmHarness", "SwarmPeer", "run_player_contract"]

@@ -1,0 +1,173 @@
+"""The media-engine integration contract, as executable assertions.
+
+The wrapper stack touches a player ONLY through the seams SURVEY.md
+§7.3(4) isolates (PlayerInterface, MediaMap, the fLoader protocol,
+and the session's event hooks).  This module states that contract as
+a function any player implementation can be run against — the
+"player-contract test kit" VERDICT r3 missing #2 asked for.  Two
+in-tree players pass it today (SimPlayer and the deliberately
+differently-shaped MinimalPlayer); a third-party integration should
+start by making its adapter pass ``run_player_contract``.
+
+What the contract requires of a player class:
+
+1.  A class-level ``Events`` enum; all wrapper-side subscriptions go
+    through it (names are the player's own business).
+2.  ``load_source(url)`` sets ``.url`` and emits MANIFEST_LOADING;
+    the parsed ``levels`` appear asynchronously with the hls.js
+    surface MediaMap/PlayerInterface read: ``url`` (list),
+    ``url_id`` (int), ``details.fragments`` (objects with
+    sn/start/duration).
+3.  ``attach_media()`` emits MEDIA_ATTACHING and exposes ``.media``
+    with a ``current_time`` the agent can read.
+4.  The player instantiates ``config["f_loader"]`` per fragment and
+    calls ``load(url, response_type, on_success, on_error,
+    on_timeout, timeout, max_retry, retry_delay, on_progress=,
+    frag=)`` with a non-None ``frag`` carrying sn/level/start
+    (dict or attribute access).
+5.  LEVEL_SWITCH is announced for the INITIAL level selection, no
+    later than the first fragment request — the agent's prefetcher
+    learns its track from it (hls.js behavior; round-4 fix).
+6.  Success is delivered XHR-shaped
+    (``event["current_target"]["response"]``) and playback makes
+    progress: ``media.current_time`` advances once content arrives.
+7.  A terminal loader error surfaces as the player's ERROR event.
+8.  ``destroy()`` emits DESTROYING (the session's dispose hook).
+"""
+
+from __future__ import annotations
+
+from ..core.clock import VirtualClock
+# the SAME dict-or-attribute tolerance rule the production loader
+# applies — if its rules change, the contract tests the new rules
+from ..core.loader import _attr
+from ..player.manifest import make_vod_manifest
+
+
+class RecordingLoader:
+    """Captures fLoader instantiations + load() calls; the kit
+    completes or fails them by script."""
+
+    calls: list = []
+    fail_next = False
+
+    def __init__(self, config):
+        self.config = config
+        self.aborted = False
+
+    def load(self, url, response_type, on_success, on_error, on_timeout,
+             timeout, max_retry, retry_delay, on_progress=None, frag=None):
+        RecordingLoader.calls.append(
+            {"loader": self, "url": url, "frag": frag,
+             "on_success": on_success, "on_error": on_error,
+             "on_progress": on_progress, "timeout": timeout,
+             "max_retry": max_retry, "retry_delay": retry_delay})
+        if RecordingLoader.fail_next:
+            RecordingLoader.fail_next = False
+            on_error({"target": {"status": 404}})
+            return
+        payload = b"x" * 1000
+        clock = (self.config or {}).get("clock") if isinstance(
+            self.config, dict) else None
+        now = clock.now() if clock is not None else 0.0
+        # loader-shaped stats: the real P2PLoader always carries the
+        # trequest/tfirst/tload triple the player's ABR feeds on
+        stats = {"trequest": now - 10.0, "tfirst": now - 5.0,
+                 "tload": now, "loaded": len(payload), "retry": 0,
+                 "aborted": False}
+        if on_progress is not None:
+            on_progress({"cdn_downloaded": len(payload),
+                         "p2p_downloaded": 0, "cdn_duration": 5,
+                         "p2p_duration": 0}, stats)
+        on_success({"current_target": {"response": payload}}, stats)
+
+    def abort(self):
+        self.aborted = True
+
+
+def run_player_contract(player_cls) -> None:
+    """Assert the full integration contract against ``player_cls``.
+    Raises AssertionError with a pointed message on any violation."""
+    events = getattr(player_cls, "Events", None)
+    assert events is not None, "contract 1: player class must carry Events"
+    for name in ("MANIFEST_LOADING", "LEVEL_SWITCH", "MEDIA_ATTACHING",
+                 "DESTROYING", "ERROR"):
+        assert getattr(events, name, None), f"contract 1: Events.{name}"
+
+    clock = VirtualClock()
+    # enough timeline that fetching is still ongoing when the error
+    # injection of contract 7 arms (the buffer bound keeps the player
+    # from swallowing the whole VOD up front)
+    manifest = make_vod_manifest(level_bitrates=(300_000, 800_000),
+                                 frag_count=30, seg_duration=4.0)
+    RecordingLoader.calls = []
+    RecordingLoader.fail_next = False
+    seen: list = []
+    player = player_cls({"clock": clock, "manifest": manifest,
+                         "f_loader": RecordingLoader,
+                         "max_buffer_length": 30})
+    for name in ("MANIFEST_LOADING", "LEVEL_SWITCH", "MEDIA_ATTACHING",
+                 "DESTROYING", "ERROR"):
+        player.on(getattr(events, name),
+                  lambda data=None, name=name: seen.append(name))
+
+    # 2. manifest lifecycle
+    player.load_source("http://origin.example/master.m3u8")
+    assert player.url == "http://origin.example/master.m3u8", \
+        "contract 2: load_source must set .url"
+    assert "MANIFEST_LOADING" in seen, \
+        "contract 2: MANIFEST_LOADING must fire on load_source"
+    player.attach_media()
+    assert "MEDIA_ATTACHING" in seen, \
+        "contract 3: MEDIA_ATTACHING must fire on attach_media"
+    assert hasattr(player.media, "current_time"), \
+        "contract 3: .media.current_time"
+
+    clock.advance(1_000.0)
+    levels = player.levels
+    assert levels is not None and len(levels) == 2, \
+        "contract 2: levels must appear after the manifest parses"
+    for level in levels:
+        assert isinstance(level.url, list) and level.url, \
+            "contract 2: level.url is the redundant-URL list"
+        assert isinstance(level.url_id, int), "contract 2: level.url_id"
+        frag = level.details.fragments[0]
+        for field in ("sn", "start", "duration"):
+            assert getattr(frag, field, None) is not None, \
+                f"contract 2: fragment.{field}"
+
+    # 4/5. fLoader protocol + initial level announcement
+    clock.advance(2_000.0)
+    assert RecordingLoader.calls, \
+        "contract 4: player must instantiate config['f_loader'] and load"
+    first = RecordingLoader.calls[0]
+    assert first["frag"] is not None, "contract 4: frag must be passed"
+    assert first["on_progress"] is not None, \
+        "contract 4: on_progress must be passed"
+    for field in ("sn", "level", "start"):
+        assert _attr(first["frag"], field) is not None, \
+            f"contract 4: frag.{field}"
+    assert isinstance(first["loader"].config, dict) or \
+        first["loader"].config is not None, \
+        "contract 4: loader constructed with the player config"
+    assert "LEVEL_SWITCH" in seen, \
+        "contract 5: the INITIAL level selection must be announced " \
+        "no later than the first fragment request"
+
+    # 6. playback progress on delivered content
+    clock.advance(20_000.0)
+    assert len(RecordingLoader.calls) >= 2, \
+        "contract 6: player must keep requesting fragments"
+    assert player.media.current_time > 0.5, \
+        "contract 6: current_time must advance once content arrives"
+
+    # 7. terminal loader error → player ERROR event
+    RecordingLoader.fail_next = True
+    clock.advance(10_000.0)
+    assert "ERROR" in seen, \
+        "contract 7: a terminal loader error must surface as ERROR"
+
+    # 8. teardown
+    player.destroy()
+    assert "DESTROYING" in seen, \
+        "contract 8: destroy() must emit DESTROYING"

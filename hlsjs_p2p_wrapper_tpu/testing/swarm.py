@@ -115,13 +115,18 @@ class SwarmHarness:
                  uplink_bps: Optional[float] = None,
                  p2p_config: Optional[dict] = None,
                  player_config: Optional[dict] = None,
+                 player_class=None,
                  start: bool = True) -> SwarmPeer:
         """Join a new player to the swarm (defaults start playback
-        immediately)."""
+        immediately).  ``player_class`` swaps the media engine —
+        swarms may MIX implementations (e.g. SimPlayer and
+        MinimalPlayer), which is exactly how the integration seam is
+        proven against the contract rather than one player's shape."""
         if peer_id is None:
             peer_id = f"peer-{self._counter}"
         self._counter += 1
-        wrapper = P2PWrapper(SimPlayer, P2PAgent, clock=self.clock)
+        wrapper = P2PWrapper(player_class or SimPlayer, P2PAgent,
+                             clock=self.clock)
         cfg = {"clock": self.clock, "cdn_transport": self.cdn,
                "network": self.network, "peer_id": peer_id,
                "uplink_bps": uplink_bps, "content_id": "swarm-content",

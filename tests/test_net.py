@@ -243,6 +243,47 @@ def test_psk_authenticated_peers_exchange_frames():
         network.close()
 
 
+def test_byte_dribbling_handshake_hits_absolute_deadline():
+    """The handshake runs under one ABSOLUTE deadline, not a per-recv
+    timeout: a client feeding one preamble byte per almost-timeout
+    would otherwise pin a handshake thread for minutes (one thread
+    per connection — the accumulation DoS)."""
+    import socket as socket_mod
+    import struct
+
+    from hlsjs_p2p_wrapper_tpu.engine import net as net_mod
+
+    network = TcpNetwork(psk=b"swarm-secret")
+    orig = net_mod.HANDSHAKE_TIMEOUT_S
+    net_mod.HANDSHAKE_TIMEOUT_S = 0.6
+    try:
+        target = network.register()
+        got = []
+        target.on_receive = lambda src, f: got.append((src, f))
+        host, port = target.peer_id.rsplit(":", 1)
+        sock = socket_mod.create_connection((host, int(port)), timeout=2.0)
+        # declare a 40-byte preamble, then dribble one byte per 0.25 s
+        # — each recv succeeds well inside any per-recv timeout, but
+        # the ABSOLUTE deadline must cut the connection at ~0.6 s
+        sock.sendall(struct.pack("<I", 40))
+        start = time.monotonic()
+        dropped_at = None
+        for i in range(40):
+            try:
+                sock.sendall(b"x")
+            except OSError:
+                dropped_at = time.monotonic() - start
+                break
+            time.sleep(0.25)
+        assert dropped_at is not None, "server never dropped the dribbler"
+        assert dropped_at < 5.0, dropped_at  # deadline, not 40×per-recv
+        assert got == []
+        sock.close()
+    finally:
+        net_mod.HANDSHAKE_TIMEOUT_S = orig
+        network.close()
+
+
 def test_psk_silent_client_times_out_handshake():
     """A connection that sends a preamble but never answers the
     challenge is dropped after HANDSHAKE_TIMEOUT_S — it must not pin

@@ -447,3 +447,127 @@ def test_admission_cap_helps_under_contention():
         return float(offload_ratio(f))
 
     assert run(2) > run(0) + 0.1
+
+
+def _crafted_state(config, holder_bits, buffer_s):
+    """init_swarm + hand-set availability bits and buffers: the
+    white-box entry for single-step friction tests."""
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import packed_words
+    import numpy as np
+
+    state = init_swarm(config)
+    avail = np.zeros((config.n_peers, packed_words(config)), np.uint32)
+    for peer, flat_bit in holder_bits:
+        avail[peer, flat_bit // 32] |= np.uint32(1) << (flat_bit % 32)
+    return state._replace(avail=jnp.asarray(avail),
+                          buffer_s=jnp.asarray(buffer_s, jnp.float32))
+
+
+def test_busy_fastfail_flips_denied_foreground_to_cdn():
+    """Admission cap 1, two simultaneous foreground starts on ONE
+    holder: exactly one transfer is admitted P2P; the other must flip
+    to the CDN in the SAME step (the mesh's BUSY deny → scheduler
+    to_cdn), not stall out its budget at zero rate."""
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import swarm_step, make_scenario
+
+    config = SwarmConfig(n_peers=3, n_segments=8, n_levels=1,
+                         seg_duration_s=4.0, max_total_serves=1)
+    # peer 0 holds segment 5; peers 1 and 2 (buffer 20 s → next_seg 5,
+    # margin 20 s: not urgent) both start it this step.  The slow
+    # uplink keeps the admitted transfer in flight past the step.
+    state = _crafted_state(config, [(0, 5)], [32.0, 20.0, 20.0])
+    scenario = make_scenario(config, jnp.array([800_000.0]),
+                             full_neighbors(3), jnp.full((3,), 8e6),
+                             uplink_bps=jnp.full((3,), 2_000_000.0))
+    new = jax.jit(lambda s: swarm_step(config, scenario, s))(state)
+    started = [bool(new.dl_active[p, 0]) for p in (1, 2)]
+    p2p = [bool(new.dl_is_p2p[p, 0]) for p in (1, 2)]
+    assert started == [True, True]
+    assert sorted(p2p) == [False, True], p2p  # one admitted, one → CDN
+
+
+def test_prefetch_denial_sets_retry_cooldown():
+    """A prefetch denied by the admission cap aborts into its retry
+    cooldown (the agent's tick-paced retry) and may not restart until
+    it drains; the attempt counter bumps so the retry re-rolls to a
+    different holder."""
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import swarm_step, make_scenario
+
+    config = SwarmConfig(n_peers=3, n_segments=8, n_levels=1,
+                         seg_duration_s=4.0, max_total_serves=1,
+                         max_concurrency=2, retry_dead_ms=1_000.0)
+    # peer 0 holds segments 5 AND 6; peers 1/2 foreground seg 5 and
+    # prefetch seg 6 — cap 1 on the single holder denies three of the
+    # four transfers
+    state = _crafted_state(config, [(0, 5), (0, 6)],
+                           [32.0, 20.0, 20.0])
+    scenario = make_scenario(config, jnp.array([800_000.0]),
+                             full_neighbors(3), jnp.full((3,), 8e6))
+    step = jax.jit(lambda s: swarm_step(config, scenario, s))
+    new = step(state)
+    cooldowns = [float(new.dl_cooldown_ms[p, 1]) for p in (1, 2)]
+    attempts = [int(new.dl_attempts[p, 1]) for p in (1, 2)]
+    denied = [p for p, cd in zip((1, 2), cooldowns) if cd > 0.0]
+    assert denied, (cooldowns, attempts)  # at least one prefetch denied
+    for p in denied:
+        assert not bool(new.dl_active[p, 1])          # aborted, not stalled
+        assert float(new.dl_cooldown_ms[p, 1]) == 1_000.0 - config.dt_ms \
+            or float(new.dl_cooldown_ms[p, 1]) == 1_000.0
+        assert int(new.dl_attempts[p, 1]) == 1        # rotation armed
+    # and the cooled slot does NOT restart on the next step
+    after = step(new)
+    for p in denied:
+        assert not bool(after.dl_active[p, 1])
+
+
+def test_live_stagger_is_request_anchored():
+    """Four synchronized live viewers want a backlog-frontier segment
+    no peer holds.  With ranks spread over a wide stagger window only
+    the low-rank seeder may hit the CDN in the early steps — even
+    though the segment was PUBLISHED long ago (the round-4 fix: the
+    agent arms its edge wait at request time, so the sim must too; a
+    publish-anchored stagger would let everyone race the CDN)."""
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import swarm_step, make_scenario
+
+    def run(spread_s):
+        # uncapped serves: with the admission cap, the third
+        # simultaneous rider is (correctly) denied BUSY and fast-fails
+        # to the CDN — a different mechanism than the one under test
+        config = SwarmConfig(n_peers=4, n_segments=64, n_levels=1,
+                             seg_duration_s=4.0, live=True,
+                             live_sync_s=0.0, live_spread_s=spread_s,
+                             urgent_margin_s=0.0, max_total_serves=0)
+        # everything published long ago relative to the playheads
+        state = init_swarm(config)._replace(
+            t_s=jnp.asarray(100.0, jnp.float32),
+            playhead_s=jnp.full((4,), 40.0, jnp.float32))
+        # a wide P2P budget floor: at the frontier the playback margin
+        # is ~0, and the default 500 ms floor would expire the shared
+        # three-way transfer into a CDN leg — the budget-failover
+        # mechanism, not the stagger, which is what's under test here
+        scenario = make_scenario(config, jnp.array([800_000.0]),
+                                 full_neighbors(4), jnp.full((4,), 8e6),
+                                 edge_rank=jnp.array([0.0, 0.4, 0.7,
+                                                      0.95]),
+                                 p2p_budget_floor_ms=4_000.0)
+        step = jax.jit(lambda s: swarm_step(config, scenario, s))
+        waited = False
+        for _ in range(16):
+            state = step(state)
+            waited = waited or float(jnp.max(state.fg_wait_ms)) > 0.0
+        return state, waited
+
+    staggered, waited = run(spread_s=60.0)
+    # the rank-0 seeder CDN'd the frontier; everyone else HELD their
+    # trigger (wait clocks ran) and then rode P2P off the seeder's
+    # copies — zero CDN bytes despite publish being long past
+    assert float(staggered.cdn_bytes[0]) > 0.0
+    assert waited
+    assert all(float(b) == 0.0 for b in staggered.cdn_bytes[1:])
+    assert all(float(b) > 0.0 for b in staggered.p2p_bytes[1:])
+
+    # control: without the stagger, the synchronized viewers race the
+    # CDN for the first frontier segment — multiple CDN fetches
+    unstaggered, _ = run(spread_s=0.0)
+    cdn_hitters = sum(1 for b in unstaggered.cdn_bytes if float(b) > 0)
+    assert cdn_hitters >= 2, unstaggered.cdn_bytes
