@@ -154,7 +154,15 @@ def _unpack_str(buf: memoryview, off: int) -> Tuple[str, int]:
     off += 2
     if off + n > len(buf):
         raise ProtocolError("truncated string field")
-    return bytes(buf[off:off + n]).decode("utf-8"), off + n
+    try:
+        s = bytes(buf[off:off + n]).decode("utf-8")
+    except UnicodeDecodeError as exc:
+        # must surface as ProtocolError: dispatchers (tracker,
+        # p2p_agent) rely on decode()'s one-except-clause contract,
+        # and a peer-supplied id is exactly where hostile bytes land
+        raise ProtocolError(f"invalid UTF-8 in string field: {exc}") \
+            from exc
+    return s, off + n
 
 
 def _check_key(key: bytes) -> bytes:
@@ -249,10 +257,20 @@ def decode(frame: bytes):
         raise ProtocolError(f"truncated body: {exc}") from exc
 
 
+def _consumed(off: int, body: memoryview) -> None:
+    """Reject trailing bytes: every frame must be exactly its message.
+    Keeps decoding canonical (``encode(decode(f)) == f`` for every
+    accepted frame) — laxity here would let two different byte strings
+    mean the same message, a classic protocol-confusion foothold."""
+    if off != len(body):
+        raise ProtocolError(f"{len(body) - off} trailing bytes in body")
+
+
 def _decode_body(msg_type: int, body: memoryview):
     if msg_type == MsgType.HELLO:
         swarm_id, off = _unpack_str(body, 0)
-        peer_id, _ = _unpack_str(body, off)
+        peer_id, off = _unpack_str(body, off)
+        _consumed(off, body)
         return Hello(swarm_id, peer_id)
     if msg_type == MsgType.HAVE:
         if len(body) != _ENTRY_SIZE:
@@ -272,20 +290,24 @@ def _decode_body(msg_type: int, body: memoryview):
         return Request(request_id, _check_key(bytes(body[4:])))
     if msg_type == MsgType.CANCEL:
         (request_id,) = struct.unpack_from("<I", body, 0)
+        _consumed(4, body)
         return Cancel(request_id)
     if msg_type == MsgType.CHUNK:
         request_id, offset, total = struct.unpack_from("<III", body, 0)
         return Chunk(request_id, offset, total, bytes(body[12:]))
     if msg_type == MsgType.DENY:
         request_id, reason = struct.unpack_from("<IB", body, 0)
+        _consumed(5, body)
         return Deny(request_id, reason)
     if msg_type == MsgType.LOST:
         return Lost(_check_key(bytes(body)))
     if msg_type == MsgType.BYE:
+        _consumed(0, body)
         return Bye()
     if msg_type == MsgType.ANNOUNCE:
         swarm_id, off = _unpack_str(body, 0)
-        peer_id, _ = _unpack_str(body, off)
+        peer_id, off = _unpack_str(body, off)
+        _consumed(off, body)
         return Announce(swarm_id, peer_id)
     if msg_type == MsgType.PEERS:
         swarm_id, off = _unpack_str(body, 0)
@@ -295,10 +317,12 @@ def _decode_body(msg_type: int, body: memoryview):
         for _ in range(count):
             p, off = _unpack_str(body, off)
             peer_ids.append(p)
+        _consumed(off, body)
         return Peers(swarm_id, tuple(peer_ids))
     if msg_type == MsgType.LEAVE:
         swarm_id, off = _unpack_str(body, 0)
-        peer_id, _ = _unpack_str(body, off)
+        peer_id, off = _unpack_str(body, off)
+        _consumed(off, body)
         return Leave(swarm_id, peer_id)
     raise ProtocolError(f"unknown message type 0x{msg_type:02x}")
 

@@ -35,7 +35,12 @@ from hlsjs_p2p_wrapper_tpu.testing.seed_process import (NullBridge,
 SEGMENT_BYTES = 200_000  # > 3 × HttpCdnTransport.CHUNK_SIZE
 
 
-def wait_for(predicate, timeout_s=8.0, interval_s=0.02):
+def wait_for(predicate, timeout_s=25.0, interval_s=0.02):
+    # generous budget: these poll real wall-clock sockets inside a
+    # process that may be paying JAX compile/GC pauses from earlier
+    # tests; a passing run returns at first True, so only genuine
+    # failures pay the full wait (observed one-off full-suite
+    # flakes at 8 s)
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         if predicate():

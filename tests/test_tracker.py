@@ -135,6 +135,25 @@ def test_malformed_frame_does_not_crash_tracker_service():
     assert tracker.members("s") == ["p1"]
 
 
+def test_invalid_utf8_announce_does_not_crash_tracker_service():
+    # regression: a well-framed ANNOUNCE whose peer-id bytes are not
+    # UTF-8 used to escape decode() as UnicodeDecodeError, which the
+    # dispatcher's except-ProtocolError clause does not catch
+    from hlsjs_p2p_wrapper_tpu.engine import protocol as P
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    tracker = Tracker(clock)
+    TrackerEndpoint(tracker, net.register("tracker"))
+    evil = net.register("evil")
+    # valid swarm-id, hostile peer-id: the failure must be reachable
+    # past the first field for the regression to bite
+    evil.send("tracker", P._frame(P.MsgType.ANNOUNCE,
+                                  b"\x01\x00s" + b"\x02\x00\xff\xfe"))
+    clock.advance(20.0)  # must not raise out of the clock
+    tracker.announce("s", "p1")
+    assert tracker.members("s") == ["p1"]
+
+
 def test_expired_swarms_fully_pruned():
     clock = VirtualClock()
     tracker = Tracker(clock, lease_ms=100.0)
