@@ -1,8 +1,10 @@
 """Multi-chip scaling: device meshes + canonical shardings for the
 swarm simulator (peers = data axis, segments = optional second axis)."""
 
-from .mesh import (PEER_AXIS, SEGMENT_AXIS, make_mesh, scenario_shardings,
+from .mesh import (CHIP_AXIS, HOST_AXIS, PEER_AXIS, SEGMENT_AXIS,
+                   make_mesh, make_multihost_mesh, scenario_shardings,
                    shard_swarm, sharded_run, state_shardings)
 
-__all__ = ["PEER_AXIS", "SEGMENT_AXIS", "make_mesh", "scenario_shardings",
+__all__ = ["CHIP_AXIS", "HOST_AXIS", "PEER_AXIS", "SEGMENT_AXIS",
+           "make_mesh", "make_multihost_mesh", "scenario_shardings",
            "shard_swarm", "sharded_run", "state_shardings"]

@@ -39,6 +39,15 @@ from ..ops.swarm_sim import SwarmConfig, SwarmScenario, SwarmState
 
 PEER_AXIS = "peers"
 SEGMENT_AXIS = "segments"
+#: multi-host deployment axes: ``hosts`` is the DCN (inter-host)
+#: dimension, ``chips`` the ICI (intra-host) dimension.  The peer axis
+#: shards over BOTH, hosts-major, so of a host's two shard boundaries
+#: at most two halo exchanges per step cross DCN — and a halo is the
+#: same constant ~2 KB regardless of which fabric it rides, so DCN
+#: bandwidth is never a scaling term (contrast an all-gather design,
+#: where DCN would carry O(P·W) per step).
+HOST_AXIS = "hosts"
+CHIP_AXIS = "chips"
 
 
 def make_mesh(devices: Optional[Sequence] = None,
@@ -55,6 +64,38 @@ def make_mesh(devices: Optional[Sequence] = None,
     return Mesh(grid, (PEER_AXIS, SEGMENT_AXIS))
 
 
+def make_multihost_mesh(n_hosts: int, chips_per_host: int,
+                        devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``(hosts, chips)`` mesh for multi-host deployments.
+
+    Lay hosts out as the MAJOR dimension of the device grid so that
+    consecutive peer shards live on consecutive chips of one host and
+    only the first/last shard of each host adjoins another host's.
+    The circulant halo exchange then rides ICI for ``chips_per_host-1``
+    of every ``chips_per_host`` boundaries and crosses DCN exactly at
+    host seams — with constant per-boundary traffic either way (see
+    module docstring).  On a single-process test platform (e.g. the
+    8-virtual-CPU conftest mesh) this compiles and executes the exact
+    program a real ``jax.distributed`` multi-host launch would run;
+    only the physical transport under the collectives differs."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = n_hosts * chips_per_host
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices for a {n_hosts}x"
+                         f"{chips_per_host} mesh, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(n_hosts, chips_per_host)
+    return Mesh(grid, (HOST_AXIS, CHIP_AXIS))
+
+
+def _peer_spec(mesh: Mesh):
+    """The PartitionSpec entry for the peer axis on this mesh: the
+    ``peers`` axis when present, else ALL mesh axes combined
+    (hosts-major multi-host sharding)."""
+    if PEER_AXIS in mesh.axis_names:
+        return PEER_AXIS
+    return tuple(mesh.axis_names)
+
+
 def state_shardings(mesh: Mesh) -> SwarmState:
     """A ``SwarmState``-shaped pytree of NamedShardings: per-peer
     vectors (and the [P, C] transfer slots) shard over the peer axis.
@@ -65,9 +106,10 @@ def state_shardings(mesh: Mesh) -> SwarmState:
     mesh axis remains for workloads that add genuinely segment-major
     state."""
     from ..ops.ewma import EwmaState
-    peer_vec = NamedSharding(mesh, P(PEER_AXIS))
+    spec = _peer_spec(mesh)
+    peer_vec = NamedSharding(mesh, P(spec))
     scalar = NamedSharding(mesh, P())
-    avail = NamedSharding(mesh, P(PEER_AXIS, None))
+    avail = NamedSharding(mesh, P(spec, None))
     return SwarmState(
         t_s=scalar,
         playhead_s=peer_vec, buffer_s=peer_vec, rebuffer_s=peer_vec,
@@ -87,12 +129,13 @@ def scenario_shardings(mesh: Mesh) -> SwarmScenario:
     neighbor list shards its ROW (requester) axis so each device owns
     its peers' neighbor lists; every per-peer vector shards over the
     peer axis."""
-    peer_vec = NamedSharding(mesh, P(PEER_AXIS))
+    spec = _peer_spec(mesh)
+    peer_vec = NamedSharding(mesh, P(spec))
     rep = NamedSharding(mesh, P())
     return SwarmScenario(
         bitrates=rep,
-        neighbors=NamedSharding(mesh, P(PEER_AXIS, None)),
-        in_edges=NamedSharding(mesh, P(PEER_AXIS, None)),
+        neighbors=NamedSharding(mesh, P(spec, None)),
+        in_edges=NamedSharding(mesh, P(spec, None)),
         cdn_bps=peer_vec, uplink_bps=peer_vec, join_s=peer_vec,
         leave_s=peer_vec, edge_rank=peer_vec,
         urgent_margin_s=rep, p2p_budget_fraction=rep,

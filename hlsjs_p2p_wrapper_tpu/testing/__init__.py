@@ -1,11 +1,12 @@
 """First-class test fakes (the reference's mocks, promoted) and the
 executable media-engine contract."""
 
-from .fixtures import DEFAULT_CONFIG, FakePlayer, make_fragments
+from .fixtures import (DEFAULT_CONFIG, FakePlayer, make_fragments,
+                       wait_for)
 from .mock_cdn import MockCdnTransport, serve_manifest, synthetic_payload
 from .player_contract import run_player_contract
 from .swarm import SwarmHarness, SwarmPeer
 
-__all__ = ["DEFAULT_CONFIG", "FakePlayer", "make_fragments",
+__all__ = ["DEFAULT_CONFIG", "FakePlayer", "make_fragments", "wait_for",
            "MockCdnTransport", "serve_manifest", "synthetic_payload",
            "SwarmHarness", "SwarmPeer", "run_player_contract"]

@@ -9,10 +9,27 @@ URLs per level (redundant streams).
 
 from __future__ import annotations
 
+import time
 from types import SimpleNamespace
 from typing import List, Optional
 
 from ..core.events import EventEmitter
+
+
+def wait_for(predicate, timeout_s=25.0, interval_s=0.02):
+    """Poll ``predicate`` on real wall-clock time until True or the
+    budget runs out — for tests of the real-socket fabric, which
+    cannot ride a VirtualClock.  The budget is generous: the test
+    process may be paying JAX compile/GC pauses from earlier tests,
+    and a passing run returns at the first True, so only genuine
+    failures pay the full wait (one-off full-suite flakes were
+    observed at 8 s)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
 
 DEFAULT_CONFIG = {
     "max_buffer_size": 60 * 1000 * 1000,

@@ -28,25 +28,12 @@ from hlsjs_p2p_wrapper_tpu.engine.net import TcpNetwork
 from hlsjs_p2p_wrapper_tpu.engine.p2p_agent import P2PAgent
 from hlsjs_p2p_wrapper_tpu.engine.tracker import Tracker, TrackerEndpoint
 from hlsjs_p2p_wrapper_tpu.testing import FakePlayer
+from hlsjs_p2p_wrapper_tpu.testing.fixtures import wait_for
 from hlsjs_p2p_wrapper_tpu.testing.mock_cdn import synthetic_payload
 from hlsjs_p2p_wrapper_tpu.testing.seed_process import (NullBridge,
                                                         NullMediaMap)
 
 SEGMENT_BYTES = 200_000  # > 3 × HttpCdnTransport.CHUNK_SIZE
-
-
-def wait_for(predicate, timeout_s=25.0, interval_s=0.02):
-    # generous budget: these poll real wall-clock sockets inside a
-    # process that may be paying JAX compile/GC pauses from earlier
-    # tests; a passing run returns at first True, so only genuine
-    # failures pay the full wait (observed one-off full-suite
-    # flakes at 8 s)
-    deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
-        if predicate():
-            return True
-        time.sleep(interval_s)
-    return False
 
 
 class _OriginHandler(BaseHTTPRequestHandler):

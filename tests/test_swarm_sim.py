@@ -322,6 +322,25 @@ def test_sharded_run_matches_single_device():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_multihost_mesh_matches_single_device():
+    # the (hosts, chips) deployment mesh: peer axis sharded over DCN x
+    # ICI, hosts-major — must execute the exact same program as one
+    # device (parallel/mesh.py make_multihost_mesh)
+    from hlsjs_p2p_wrapper_tpu.parallel import make_multihost_mesh
+    config, bitrates, neighbors, cdn, join, state = scenario(n_peers=64)
+    n = steps_for(config, 30.0)
+    single, _ = run_swarm(config, bitrates, neighbors, cdn, state, n, join)
+    mesh = make_multihost_mesh(n_hosts=2, chips_per_host=4)
+    sharded, _ = sharded_run(mesh, config, bitrates, neighbors, cdn,
+                             state, n, join)
+    for a, b in zip(jax.tree_util.tree_leaves(single),
+                    jax.tree_util.tree_leaves(sharded)):
+        assert jnp.allclose(jnp.asarray(a, jnp.float32),
+                            jnp.asarray(b, jnp.float32), atol=1e-4), \
+            "multihost-sharded execution diverged from single-device"
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
 def test_sharded_run_with_segment_axis():
     config, bitrates, neighbors, cdn, join, state = scenario(n_peers=32,
                                                              n_segments=64)
