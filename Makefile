@@ -3,13 +3,18 @@
 
 PY ?= python
 
-.PHONY: test lint bench sweep sweep-live examples dryrun check all
+.PHONY: test lint bench sweep sweep-live examples dryrun check all \
+	coverage
 
 test:
 	$(PY) -m pytest tests/ -q
 
 lint:
 	$(PY) tools/lint.py
+
+# stdlib-only line coverage (sys.monitoring; needs Python >= 3.12)
+coverage:
+	$(PY) tools/coverage.py
 
 bench:
 	$(PY) bench.py
@@ -30,6 +35,8 @@ examples:
 	$(PY) examples/wrapper_demo.py
 	$(PY) examples/legacy_demo.py
 	$(PY) examples/swarm_demo.py
+	$(PY) examples/swarm_demo.py --live
+	$(PY) examples/production_demo.py
 
 check: lint test dryrun
 

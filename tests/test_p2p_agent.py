@@ -440,3 +440,12 @@ def test_dispose_mid_p2p_transfer_does_not_start_cdn_leg():
     rig.clock.advance(30_000.0)
     assert rig.cdn.fetch_count == cdn_fetches_before  # no zombie CDN leg
     assert out["success"] == []
+
+
+def test_agent_stats_helpers():
+    from hlsjs_p2p_wrapper_tpu.engine.stats import AgentStats
+    stats = AgentStats()
+    assert stats.offload_ratio == 0.0          # no traffic yet: no 0/0
+    stats.cdn, stats.p2p = 250_000, 750_000
+    assert stats.offload_ratio == 0.75
+    assert "cdn" in repr(stats) and "750000" in repr(stats)

@@ -70,3 +70,32 @@ def test_view_to_string_and_id():
     sv = make_sv(sn=7, level=2, url_id=1)
     assert sv.view_to_string() == "L2U1S7"
     assert sv.get_id() == 7
+
+
+def test_copy_constructor_from_segment_view():
+    # the reference ctor re-wraps whatever shape it is given
+    # (segment-view.js:22-26); a SegmentView input must copy cleanly
+    src = make_sv(sn=9, level=2, url_id=1)
+    copy = SegmentView(src)
+    assert copy == src and copy.time == src.time
+
+
+def test_constructor_from_attribute_object():
+    class FragLike:
+        sn = 5
+        trackView = TrackView(level=1, url_id=0)
+        time = 50.0
+
+    sv = SegmentView(FragLike())
+    assert sv.sn == 5 and sv.track_view.level == 1 and sv.time == 50.0
+
+
+def test_hash_matches_equality():
+    a, b = make_sv(sn=3), make_sv(sn=3)
+    assert a == b and hash(a) == hash(b)
+    assert len({a, b, make_sv(sn=4)}) == 2
+
+
+def test_repr_is_informative():
+    assert "L1U0S7" not in repr(make_sv(sn=7))  # repr, not view string
+    assert "sn=7" in repr(make_sv(sn=7))
