@@ -798,7 +798,14 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
             # transfers in deterministic (slot, offset) order until
             # the cap; denied edges are masked out of eligibility so
             # their transfers stall at rate 0 (fast-fail semantics:
-            # the budget/timeout clocks still run)
+            # the budget/timeout clocks still run).  NOTE the
+            # tie-break ORDER is path-specific: here it is offset
+            # order, the general path below admits in inbound-edge
+            # (requester-id-major) order — when the cap does not
+            # bind (or cap=0) the paths agree to float-accumulation
+            # tolerance, and when it binds they agree statistically
+            # (tests/test_swarm_sim.py
+            # test_ranked_circulant_matches_general_path)
             cum_j = zeros
             for s in slots:
                 admitted = []

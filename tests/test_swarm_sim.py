@@ -590,3 +590,82 @@ def test_live_stagger_is_request_anchored():
     unstaggered, _ = run(spread_s=0.0)
     cdn_hitters = sum(1 for b in unstaggered.cdn_bytes if float(b) > 0)
     assert cdn_hitters >= 2, unstaggered.cdn_bytes
+
+
+def test_ranked_circulant_matches_general_path():
+    """The "ranked" (announce-order) holder policy has its own
+    circulant branch (nth_holder_only's rank-walk over static
+    offsets); with admission UNCAPPED it must trace the exact same
+    trajectories as the general [P, K] gather form.  (Capped, the two
+    paths admit in different deterministic orders — offset order vs
+    inbound-edge order — and ranked herding makes the cap bind
+    constantly, so the capped comparison below is aggregate-level.)"""
+    config, bitrates, neighbors, cdn, join, state = scenario(
+        holder_selection="ranked", max_total_serves=0)
+    n = steps_for(config, 90.0)
+    general, _ = run_swarm(config, bitrates, neighbors, cdn, state, n,
+                           join)
+    circ, _ = run_swarm(config._replace(neighbor_offsets=ring_offsets(8)),
+                        bitrates, None, cdn, state, n, join)
+    for a, b in zip(jax.tree_util.tree_leaves(general),
+                    jax.tree_util.tree_leaves(circ)):
+        assert jnp.allclose(jnp.asarray(a, jnp.float32),
+                            jnp.asarray(b, jnp.float32),
+                            atol=1e-3, rtol=1e-5), \
+            "ranked circulant path diverged from general gather path"
+
+    capped = config._replace(max_total_serves=2)
+    cap_gen, _ = run_swarm(capped, bitrates, neighbors, cdn, state, n,
+                           join)
+    cap_circ, _ = run_swarm(
+        capped._replace(neighbor_offsets=ring_offsets(8)),
+        bitrates, None, cdn, state, n, join)
+    assert abs(float(offload_ratio(cap_gen))
+               - float(offload_ratio(cap_circ))) < 0.05
+
+
+def test_spread_equals_adaptive_single_slot():
+    """At max_concurrency=1 the failure-rotation salt never bumps
+    (only prefetch slots rotate), so "adaptive" must reproduce
+    "spread" EXACTLY — the equivalence bench.py's host baseline
+    asserts (bench.py:120-122) as a checked property."""
+    config, bitrates, neighbors, cdn, join, state = scenario()
+    n = steps_for(config, 60.0)
+    spread, _ = run_swarm(config._replace(holder_selection="spread"),
+                          bitrates, neighbors, cdn, state, n, join)
+    adaptive, _ = run_swarm(config._replace(holder_selection="adaptive"),
+                            bitrates, neighbors, cdn, state, n, join)
+    for a, b in zip(jax.tree_util.tree_leaves(spread),
+                    jax.tree_util.tree_leaves(adaptive)):
+        assert jnp.array_equal(jnp.asarray(a), jnp.asarray(b)), \
+            "adaptive != spread at C=1 (the documented equivalence)"
+
+
+def test_config_validation_raises():
+    config, bitrates, neighbors, cdn, join, state = scenario(n_peers=8)
+    # neighbors=None needs circulant offsets
+    with pytest.raises(ValueError, match="circulant"):
+        run_swarm(config, bitrates, None, cdn, state, 2, join)
+    # both offsets AND a real neighbor array is ambiguous
+    with pytest.raises(ValueError, match="both"):
+        run_swarm(config._replace(neighbor_offsets=ring_offsets(4)),
+                  bitrates, neighbors, cdn, state, 2, join)
+    # holder_selection typos must not silently simulate anything
+    with pytest.raises(ValueError, match="holder_selection"):
+        run_swarm(config._replace(holder_selection="sperad"),
+                  bitrates, neighbors, cdn, state, 2, join)
+
+
+def test_cost_models_smoke():
+    """The analytic per-step cost models bench.py reports utilization
+    against: positive, circulant vs general differ, and both scale
+    with the transfer-slot count."""
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (step_flops,
+                                                     step_hbm_bytes)
+    general = SwarmConfig(n_peers=1024, n_segments=64, n_levels=3)
+    circ = general._replace(neighbor_offsets=ring_offsets(8))
+    for model in (step_flops, step_hbm_bytes):
+        assert model(general) > 0 and model(circ) > 0
+        assert model(general) != model(circ)
+        multi = model(general._replace(max_concurrency=3))
+        assert multi > model(general)
