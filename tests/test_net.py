@@ -509,3 +509,43 @@ def test_resolve_cache_refreshes_on_mismatch(monkeypatch):
         assert network._host_matches("peer.example", "10.9.9.9") is False
     finally:
         network.close()
+
+
+def test_oversized_frame_length_drops_connection():
+    """The length-prefix guard (_read_frame: length > max_bytes →
+    poisoned stream): a peer declaring a gigabyte frame must be
+    dropped WITHOUT the server allocating or waiting for the body —
+    and the endpoint must keep serving honest peers afterwards."""
+    import socket as socket_mod
+    import struct
+
+    network = TcpNetwork()
+    try:
+        target = network.register()
+        got = []
+        target.on_receive = lambda src, f: got.append((src, f))
+        host, port = target.peer_id.rsplit(":", 1)
+
+        # a preamble claiming to be 2^30 bytes long (cap: 512)
+        sock = socket_mod.create_connection((host, int(port)), timeout=5.0)
+        start = time.monotonic()
+        try:
+            sock.sendall(struct.pack("<I", 1 << 30))
+            sock.sendall(b"x" * 64)  # the server must not wait for more
+            dropped = sock.recv(1) == b""  # orderly close
+        except OSError:
+            dropped = True   # RST mid-send or mid-recv — also a drop
+        assert dropped
+        assert time.monotonic() - start < 5.0
+        sock.close()
+
+        # honest traffic still flows through the same listener
+        other = network.register()
+        delivered = threading.Event()
+        target.on_receive = lambda src, f: (got.append((src, f)),
+                                            delivered.set())
+        other.send(target.peer_id, b"still-alive")
+        assert wait_for(delivered.is_set)
+        assert got[-1] == (other.peer_id, b"still-alive")
+    finally:
+        network.close()

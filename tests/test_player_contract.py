@@ -62,3 +62,43 @@ def test_mixed_player_swarm_exchanges_segments():
     # and both implementations SERVED: the seeder is a SimPlayer, the
     # second joiner a MinimalPlayer that caches and re-serves
     assert swarm.peers[1].stats["upload"] > 0  # MinimalPlayer uploaded
+
+
+def test_minimal_player_error_and_guard_paths():
+    """The second engine's failure surface: a missing manifest is a
+    fatal network error (as hls.js reports manifestLoadError), bad
+    set_level raises, a missing loader is a loud config error, and
+    destroy is idempotent."""
+    from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+    from hlsjs_p2p_wrapper_tpu.player.manifest import make_vod_manifest
+
+    clock = VirtualClock()
+
+    # no manifest configured → fatal manifestLoadError on load
+    player = MinimalPlayer({"clock": clock})
+    errors = []
+    player.on(player.Events.ERROR, errors.append)
+    player.load_source("http://cdn.example/master.m3u8")
+    clock.advance(50.0)
+    assert errors and errors[0]["fatal"] \
+        and errors[0]["details"] == "manifestLoadError"
+
+    # healthy manifest, but no loader configured → loud, not silent
+    manifest = make_vod_manifest(level_bitrates=(800_000,),
+                                 seg_duration=4.0, frag_count=4)
+    player = MinimalPlayer({"clock": clock, "manifest": manifest})
+    player.load_source("http://cdn.example/master.m3u8")
+    clock.advance(50.0)
+    assert player.levels is not None
+    with pytest.raises(ValueError, match="no such level"):
+        player.set_level(5)
+    player.attach_media()
+    with pytest.raises(RuntimeError, match="no fragment loader"):
+        clock.advance(1_000.0)
+
+    # destroy is idempotent and emits DESTROYING exactly once
+    destroying = []
+    player.on(player.Events.DESTROYING, destroying.append)
+    player.destroy()
+    player.destroy()
+    assert len(destroying) == 1 and player.destroyed

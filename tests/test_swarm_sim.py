@@ -37,6 +37,19 @@ def steps_for(config, seconds):
     return int(seconds * 1000.0 / config.dt_ms)
 
 
+def assert_trees_match(a, b, *, exact=False, what="trees"):
+    """Leaf-wise state comparison: exact for bit-determinism claims,
+    else within f32 summation-order tolerance."""
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        if exact:
+            assert jnp.array_equal(jnp.asarray(x), jnp.asarray(y)), what
+        else:
+            assert jnp.allclose(jnp.asarray(x, jnp.float32),
+                                jnp.asarray(y, jnp.float32),
+                                atol=1e-3, rtol=1e-5), what
+
+
 def test_isolated_peers_all_cdn_no_offload():
     config, bitrates, _, cdn, join, state = scenario()
     no_nbr = isolated_neighbors(config.n_peers)
@@ -141,9 +154,7 @@ def test_self_padding_is_inert():
                      steps_for(config, 60.0), join)
     b, _ = run_swarm(config, bitrates, padded, cdn, state,
                      steps_for(config, 60.0), join)
-    for x, y in zip(jax.tree_util.tree_leaves(a),
-                    jax.tree_util.tree_leaves(b)):
-        assert jnp.array_equal(jnp.asarray(x), jnp.asarray(y))
+    assert_trees_match(a, b, exact=True, what="self-padding changed dynamics")
 
 
 def test_circulant_matches_general_path():
@@ -157,12 +168,9 @@ def test_circulant_matches_general_path():
     circ_config = config._replace(neighbor_offsets=ring_offsets(8))
     circulant, _ = run_swarm(circ_config, bitrates, None, cdn, state, n,
                              join)
-    for a, b in zip(jax.tree_util.tree_leaves(general),
-                    jax.tree_util.tree_leaves(circulant)):
-        assert jnp.allclose(jnp.asarray(a, jnp.float32),
-                            jnp.asarray(b, jnp.float32),
-                            atol=1e-3, rtol=1e-5), \
-            "circulant fast path diverged from general gather path"
+    assert_trees_match(general, circulant,
+                       what="circulant fast path diverged from general "
+                            "gather path")
 
 
 def test_circulant_full_offsets_tiny_swarm():
@@ -179,10 +187,9 @@ def test_circulant_full_offsets_tiny_swarm():
     circ, _ = run_swarm(
         config._replace(neighbor_offsets=full_offsets(n_peers) * 2),
         bitrates, None, cdn, state, 200, join)  # ×2: dupes must dedupe
-    for a, b in zip(jax.tree_util.tree_leaves(general),
-                    jax.tree_util.tree_leaves(circ)):
-        assert jnp.allclose(jnp.asarray(a, jnp.float32),
-                            jnp.asarray(b, jnp.float32), atol=1e-3)
+    assert_trees_match(general, circ,
+                       what="wrapped full_offsets diverged from "
+                            "full_neighbors")
 
 
 def test_policy_knobs_are_dynamic_no_recompile():
@@ -314,11 +321,8 @@ def test_sharded_run_matches_single_device():
     mesh = make_mesh()
     sharded, _ = sharded_run(mesh, config, bitrates, neighbors, cdn,
                              state, n, join)
-    for a, b in zip(jax.tree_util.tree_leaves(single),
-                    jax.tree_util.tree_leaves(sharded)):
-        assert jnp.allclose(jnp.asarray(a, jnp.float32),
-                            jnp.asarray(b, jnp.float32), atol=1e-4), \
-            "sharded execution diverged from single-device"
+    assert_trees_match(single, sharded,
+                       what="sharded execution diverged from single-device")
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
@@ -333,11 +337,9 @@ def test_multihost_mesh_matches_single_device():
     mesh = make_multihost_mesh(n_hosts=2, chips_per_host=4)
     sharded, _ = sharded_run(mesh, config, bitrates, neighbors, cdn,
                              state, n, join)
-    for a, b in zip(jax.tree_util.tree_leaves(single),
-                    jax.tree_util.tree_leaves(sharded)):
-        assert jnp.allclose(jnp.asarray(a, jnp.float32),
-                            jnp.asarray(b, jnp.float32), atol=1e-4), \
-            "multihost-sharded execution diverged from single-device"
+    assert_trees_match(single, sharded,
+                       what="multihost-sharded execution diverged from "
+                            "single-device")
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
@@ -607,12 +609,9 @@ def test_ranked_circulant_matches_general_path():
                            join)
     circ, _ = run_swarm(config._replace(neighbor_offsets=ring_offsets(8)),
                         bitrates, None, cdn, state, n, join)
-    for a, b in zip(jax.tree_util.tree_leaves(general),
-                    jax.tree_util.tree_leaves(circ)):
-        assert jnp.allclose(jnp.asarray(a, jnp.float32),
-                            jnp.asarray(b, jnp.float32),
-                            atol=1e-3, rtol=1e-5), \
-            "ranked circulant path diverged from general gather path"
+    assert_trees_match(general, circ,
+                       what="ranked circulant path diverged from general "
+                            "gather path")
 
     capped = config._replace(max_total_serves=2)
     cap_gen, _ = run_swarm(capped, bitrates, neighbors, cdn, state, n,
@@ -628,17 +627,17 @@ def test_spread_equals_adaptive_single_slot():
     """At max_concurrency=1 the failure-rotation salt never bumps
     (only prefetch slots rotate), so "adaptive" must reproduce
     "spread" EXACTLY — the equivalence bench.py's host baseline
-    asserts (bench.py:120-122) as a checked property."""
+    asserts (numpy_baseline_throughput's config guards) as a checked
+    property."""
     config, bitrates, neighbors, cdn, join, state = scenario()
     n = steps_for(config, 60.0)
     spread, _ = run_swarm(config._replace(holder_selection="spread"),
                           bitrates, neighbors, cdn, state, n, join)
     adaptive, _ = run_swarm(config._replace(holder_selection="adaptive"),
                             bitrates, neighbors, cdn, state, n, join)
-    for a, b in zip(jax.tree_util.tree_leaves(spread),
-                    jax.tree_util.tree_leaves(adaptive)):
-        assert jnp.array_equal(jnp.asarray(a), jnp.asarray(b)), \
-            "adaptive != spread at C=1 (the documented equivalence)"
+    assert_trees_match(spread, adaptive, exact=True,
+                       what="adaptive != spread at C=1 (the documented "
+                            "equivalence)")
 
 
 def test_config_validation_raises():
