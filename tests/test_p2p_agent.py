@@ -449,3 +449,29 @@ def test_agent_stats_helpers():
     stats.cdn, stats.p2p = 250_000, 750_000
     assert stats.offload_ratio == 0.75
     assert "cdn" in repr(stats) and "750000" in repr(stats)
+
+
+def test_malformed_and_hostile_frames_do_not_kill_agent_dispatch():
+    """The agent's transport dispatch must survive garbage AND
+    well-framed-but-hostile messages (invalid UTF-8 ids) — one bad
+    peer cannot take down the receive path (protocol decode errors
+    all surface as ProtocolError; see engine/protocol.py)."""
+    from hlsjs_p2p_wrapper_tpu.engine import protocol as P
+    swarm = Swarm()
+    a = swarm.agent("a")
+    b = swarm.agent("b")
+    evil = swarm.net.register("evil")
+    evil.send("a", b"\xde\xad\xbe\xef")                 # not a frame
+    evil.send("a", P._frame(P.MsgType.HELLO,            # hostile UTF-8
+                            b"\x01\x00s" + b"\x02\x00\xff\xfe"))
+    evil.send("a", P._frame(0x7F, b"junk"))             # unknown type
+    swarm.clock.advance(2_000.0)
+    # the mesh between the two honest agents still forms and serves
+    out, _ = fetch(a, 30, swarm.clock)
+    assert out["success"]
+    swarm.clock.advance(2_000.0)
+    out_b, _ = fetch(b, 30, swarm.clock)
+    assert out_b["success"]
+    assert b.stats["p2p"] > 0  # P2P leg worked after the hostile frames
+    a.dispose()
+    b.dispose()

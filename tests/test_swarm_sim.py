@@ -37,17 +37,17 @@ def steps_for(config, seconds):
     return int(seconds * 1000.0 / config.dt_ms)
 
 
-def assert_trees_match(a, b, *, exact=False, what="trees"):
+def assert_trees_match(a, b, *, exact=False, atol=1e-3, what="trees"):
     """Leaf-wise state comparison: exact for bit-determinism claims,
     else within f32 summation-order tolerance."""
     for x, y in zip(jax.tree_util.tree_leaves(a),
-                    jax.tree_util.tree_leaves(b)):
+                    jax.tree_util.tree_leaves(b), strict=True):
         if exact:
             assert jnp.array_equal(jnp.asarray(x), jnp.asarray(y)), what
         else:
             assert jnp.allclose(jnp.asarray(x, jnp.float32),
                                 jnp.asarray(y, jnp.float32),
-                                atol=1e-3, rtol=1e-5), what
+                                atol=atol, rtol=1e-5), what
 
 
 def test_isolated_peers_all_cdn_no_offload():
@@ -321,7 +321,7 @@ def test_sharded_run_matches_single_device():
     mesh = make_mesh()
     sharded, _ = sharded_run(mesh, config, bitrates, neighbors, cdn,
                              state, n, join)
-    assert_trees_match(single, sharded,
+    assert_trees_match(single, sharded, atol=1e-4,
                        what="sharded execution diverged from single-device")
 
 
@@ -337,7 +337,7 @@ def test_multihost_mesh_matches_single_device():
     mesh = make_multihost_mesh(n_hosts=2, chips_per_host=4)
     sharded, _ = sharded_run(mesh, config, bitrates, neighbors, cdn,
                              state, n, join)
-    assert_trees_match(single, sharded,
+    assert_trees_match(single, sharded, atol=1e-4,
                        what="multihost-sharded execution diverged from "
                             "single-device")
 

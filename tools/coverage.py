@@ -10,7 +10,9 @@ so the denominator is executable instructions, not raw source lines.
 
 Usage::
 
-    python tools/coverage.py [pytest args...]      # default: tests/ -q
+    python tools/coverage.py [--min PCT] [pytest args...]
+    # pytest args default: tests/ -q; --min N exits 1 when total
+    # coverage lands below N percent (the CI floor)
 
 Caveats (documented, not hidden): code executed only in SUBPROCESSES
 (the multichip dryrun child, testing/seed_process peers) shows as
@@ -69,8 +71,18 @@ def main() -> int:
 
     sys.path.insert(0, ROOT)
     import pytest
-    args = sys.argv[1:] or ["tests/", "-q"]
-    rc = pytest.main(args)
+    args = sys.argv[1:]
+    min_pct = None
+    if "--min" in args:
+        at = args.index("--min")
+        try:
+            min_pct = float(args[at + 1])
+        except (IndexError, ValueError):
+            print("usage: tools/coverage.py [--min PCT] [pytest args...]",
+                  file=sys.stderr)
+            return 2
+        args = args[:at] + args[at + 2:]
+    rc = pytest.main(args or ["tests/", "-q"])
 
     mon.set_events(tool, 0)
     mon.free_tool_id(tool)
@@ -101,6 +113,10 @@ def main() -> int:
     total_pct = 100.0 * total_hit / max(total_expected, 1)
     print(f"  ------\n  {total_pct:6.1f}%  TOTAL "
           f"({total_hit}/{total_expected} executable lines)")
+    if rc == 0 and min_pct is not None and total_pct < min_pct:
+        print(f"coverage {total_pct:.1f}% is below the --min "
+              f"{min_pct:.1f}% floor", file=sys.stderr)
+        return 1
     return rc
 
 
