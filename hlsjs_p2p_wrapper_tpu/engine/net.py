@@ -510,17 +510,30 @@ class TcpEndpoint:
             return
         conn = _Connection(self, remote_id, sock)
         with self._conn_lock:
-            # reuse: an inbound link doubles as our outbound to them;
-            # a stale dead entry must not shadow the fresh link
-            existing = self._conns.get(remote_id)
-            if existing is None or existing.closed:
-                self._conns[remote_id] = conn
+            # a handshake racing close() must not register a fresh
+            # connection on a dead endpoint (same guard as send()):
+            # close() has already reaped its snapshot, so anything
+            # added now would leak its writer thread + socket forever
+            if self.closed:
+                register = False
             else:
-                # crossed dial: both sides connected simultaneously.
-                # This inbound IS the remote's working outbound — keep
-                # reading from it, but track it separately so close()
-                # still reaps it (untracked = socket+thread leak)
-                self._extra_conns.append(conn)
+                register = True
+                # reuse: an inbound link doubles as our outbound to
+                # them; a stale dead entry must not shadow the fresh
+                # link
+                existing = self._conns.get(remote_id)
+                if existing is None or existing.closed:
+                    self._conns[remote_id] = conn
+                else:
+                    # crossed dial: both sides connected
+                    # simultaneously.  This inbound IS the remote's
+                    # working outbound — keep reading from it, but
+                    # track it separately so close() still reaps it
+                    # (untracked = socket+thread leak)
+                    self._extra_conns.append(conn)
+        if not register:
+            conn.close()
+            return
         conn.start()
 
     def _reader_loop(self, conn: _Connection) -> None:
@@ -546,6 +559,23 @@ class TcpEndpoint:
             conns = list(self._conns.values()) + list(self._extra_conns)
             self._conns.clear()
             self._extra_conns.clear()
+        try:
+            # shutdown BEFORE close, like _Connection.close: close()
+            # alone does not wake a thread blocked in accept() — the
+            # in-flight syscall pins the fd and the accept loop (and
+            # its listener socket) leaks on every endpoint close.
+            # Linux wakes the accept here; BSD/macOS raise ENOTCONN
+            # on a LISTEN socket, so the self-connect below is the
+            # portable wake-up for them.
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            wake = socket.create_connection(
+                self._listener.getsockname()[:2], timeout=1.0)
+            wake.close()
+        except OSError:
+            pass  # already woken (Linux) or listener already dead
         try:
             self._listener.close()
         except OSError:

@@ -549,3 +549,72 @@ def test_oversized_frame_length_drops_connection():
         assert got[-1] == (other.peer_id, b"still-alive")
     finally:
         network.close()
+
+
+def test_tcp_churn_soak_no_thread_leak():
+    """Endpoints joining, exchanging traffic, and closing in rounds
+    must not strand threads: after network.close() the process's
+    thread count returns to (near) its pre-network baseline.  Thread
+    lifecycle is the classic long-uptime failure mode of a socket
+    fabric — reader/writer/accept threads all wake via shutdown()."""
+    baseline = threading.active_count()
+    network = TcpNetwork()
+    endpoints = []
+    received = []
+
+    def attach(ep):
+        ep.on_receive = lambda src, f: received.append((ep.peer_id, src))
+        endpoints.append(ep)
+
+    for _ in range(5):
+        attach(network.register())
+    try:
+        for round_no in range(4):
+            for ep in endpoints:
+                for other in endpoints:
+                    if other is not ep:
+                        ep.send(other.peer_id, b"ping" * 200)
+            # churn: the oldest endpoint leaves, a new one joins
+            victim = endpoints.pop(0)
+            victim.close()
+            attach(network.register())
+        assert wait_for(lambda: len(received) >= 40), len(received)
+    finally:
+        network.close()
+    assert wait_for(
+        lambda: threading.active_count() <= baseline + 1, timeout_s=10.0), \
+        f"threads leaked: {threading.active_count()} vs baseline {baseline}"
+
+
+def test_handshake_completing_after_close_does_not_register():
+    """A handshake racing close() past the preamble must not register
+    (and strand) a fresh connection on the dead endpoint — close()
+    has already reaped its snapshot, so a late registration would
+    leak the writer thread and socket forever (same guard send()
+    has).  Driven deterministically: the handshake runs against an
+    endpoint that closed mid-flight."""
+    import socket
+    import struct
+
+    network = TcpNetwork()
+    try:
+        victim = network.register()
+        # a real TCP pair so getpeername/host verification behave
+        gate = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        gate.bind(("127.0.0.1", 0))
+        gate.listen(1)
+        client = socket.create_connection(gate.getsockname(), timeout=2.0)
+        server_side, _ = gate.accept()
+        claimed = b"127.0.0.1:45678"
+        client.sendall(struct.pack("<I", len(claimed)) + claimed)
+
+        victim.close()  # close wins the race before registration
+        before = {t.name for t in threading.enumerate()}
+        victim._handshake_inbound(server_side)
+        assert victim._conns == {} and victim._extra_conns == []
+        after = {t.name for t in threading.enumerate()} - before
+        assert not any("p2p-writer" in name for name in after), after
+        client.close()
+        gate.close()
+    finally:
+        network.close()
