@@ -40,6 +40,20 @@ DEFAULT_REQUEST_TIMEOUT_MS = 8_000.0
 #: if a HELLO went unanswered this long, the next tracker round
 #: re-sends it (frame loss must not be permanent)
 HANDSHAKE_RETRY_MS = 5_000.0
+#: reap a half-open PeerState (HELLO sent, never answered) after this
+#: long: a peer that departed or crashed BEFORE completing the
+#: handshake never sends BYE — to anyone who only ever dialed it —
+#: so without a reap its entry lives forever (the tracker re-lists
+#: live peers, and connect_to recreates the state, so reaping an
+#: actually-alive-but-slow peer costs one extra HELLO round)
+HANDSHAKE_REAP_MS = 4 * HANDSHAKE_RETRY_MS
+#: reap a handshaked neighbor not heard from in this long (no HAVE,
+#: no requests, nothing) with no transfer in flight either way —
+#: the crashed-without-BYE case on a real fabric.  Generous: quiet
+#: VOD neighbors get re-handshaked via the tracker on the next
+#: announce round if reaped, so the only cost of a false positive is
+#: one HELLO/BITFIELD exchange.
+PEER_IDLE_REAP_MS = 300_000.0
 #: how long a peer that served bytes contradicting its own
 #: announcement stays banned.  Finite, so one corrupted transfer
 #: (bit-rot, not malice) doesn't permanently shrink a small swarm.
@@ -130,15 +144,20 @@ class DownloadHandle:
 class PeerState:
     """What we know about one neighbor."""
 
-    __slots__ = ("peer_id", "have", "hello_sent", "hello_at", "handshaked")
+    __slots__ = ("peer_id", "have", "hello_sent", "hello_at",
+                 "hello_first_at", "handshaked", "last_seen_ms")
 
     def __init__(self, peer_id: str):
         self.peer_id = peer_id
         # key -> (announced size, announced sha256)
         self.have: Dict[bytes, Tuple[int, bytes]] = {}
         self.hello_sent = False
-        self.hello_at = 0.0
+        self.hello_at = 0.0       # last HELLO (retries refresh this)
+        #: clock of the FIRST HELLO of the current half-open
+        #: cycle (None = no cycle open); retries must not refresh
+        self.hello_first_at: Optional[float] = None
         self.handshaked = False
+        self.last_seen_ms = 0.0   # clock of the last frame they sent
 
 
 class PeerMesh:
@@ -213,12 +232,60 @@ class PeerMesh:
             return
         state.hello_sent = True
         state.hello_at = now
+        if state.hello_first_at is None:
+            state.hello_first_at = now  # retries must NOT refresh this
         self._send(peer_id, P.Hello(self.swarm_id, self.endpoint.peer_id))
         self._send(peer_id, P.Bitfield(tuple(self.cache.entries())))
 
     def on_tracker_peers(self, peer_ids) -> None:
+        self._reap_stale_peers(self.clock.now())
         for peer_id in peer_ids:
             self.connect_to(peer_id)
+
+    def _reap_stale_peers(self, now: float) -> None:
+        """Bounded-state sweep, run at announce cadence: drop
+        half-open handshakes nobody ever answered
+        (:data:`HANDSHAKE_REAP_MS`) and handshaked neighbors silent
+        past :data:`PEER_IDLE_REAP_MS` with nothing in flight either
+        way.  Departure-by-crash never sends BYE, so without this the
+        peers map grows with every churned neighbor for the life of
+        the session (tests/test_swarm.py
+        test_churn_soak_mesh_state_stays_bounded)."""
+        stale = []
+        for peer_id, state in self.peers.items():
+            if not state.handshaked:
+                # measured from the FIRST unanswered HELLO of this
+                # cycle: retries refresh hello_at, and a peer the
+                # tracker keeps listing (alive but unreachable to us,
+                # e.g. one-way reachability) would otherwise never
+                # age past the reap bound
+                if (state.hello_first_at is not None
+                        and now - state.hello_first_at
+                        >= HANDSHAKE_REAP_MS):
+                    # Bye here too: under one-way loss the remote may
+                    # be fully handshaked with us (our HELLO arrived,
+                    # its replies did not) and would otherwise keep
+                    # selecting us as a holder, burning a request
+                    # timeout per attempt until ITS idle reap — which
+                    # our per-announce retries keep pushing out
+                    self._send(peer_id, P.Bye())
+                    stale.append(peer_id)
+                continue
+            last = max(state.last_seen_ms, state.hello_at)
+            if now - last < PEER_IDLE_REAP_MS:
+                continue
+            busy = (any(k[0] == peer_id for k in self._uploads)
+                    or any(d.peer_id == peer_id
+                           for d in self._downloads.values()))
+            if not busy:
+                # tell them: otherwise the pair is asymmetrically
+                # handshaked and their next request to us burns a
+                # full request timeout before failover (close() has
+                # the same symmetry via its Bye broadcast)
+                self._send(peer_id, P.Bye())
+                stale.append(peer_id)
+        for peer_id in stale:
+            self.drop_peer(peer_id)
 
     def drop_peer(self, peer_id: str) -> None:
         """Forget a neighbor; fail its in-flight downloads and stop
@@ -397,6 +464,9 @@ class PeerMesh:
         """Dispatch one decoded peer message."""
         if self.closed or self._is_banned(src_id):
             return
+        known = self.peers.get(src_id)
+        if known is not None:
+            known.last_seen_ms = self.clock.now()
         if isinstance(msg, P.Hello):
             if msg.swarm_id != self.swarm_id:
                 return  # different content; not our neighbor
@@ -412,6 +482,7 @@ class PeerMesh:
             retried = (state.handshaked
                        and now - state.hello_at >= HANDSHAKE_RETRY_MS)
             state.handshaked = True
+            state.hello_first_at = None  # half-open cycle resolved
             if not state.hello_sent or retried:
                 state.hello_sent = True
                 state.hello_at = now
@@ -419,7 +490,7 @@ class PeerMesh:
                 self._send(src_id, P.Bitfield(tuple(self.cache.entries())))
             return
 
-        state = self.peers.get(src_id)
+        state = known
         if state is None or not (state.handshaked or state.hello_sent):
             return  # never handshaked with this peer; ignore
 

@@ -617,3 +617,47 @@ def test_spread_policy_breaks_holder_ties_differently():
     assert len(orders) >= 2, orders
     # same requester+key is deterministic (retries stay analyzable)
     assert spread_a.holders_of(key(1)) == spread_a.holders_of(key(1))
+
+
+def test_unanswered_hello_reaped_despite_tracker_relisting():
+    """A peer the tracker keeps listing but that never answers our
+    HELLO (alive but unreachable to us — one-way reachability) must
+    not hold a half-open PeerState forever: the reap bound runs from
+    the FIRST unanswered HELLO of the cycle, which retries must not
+    refresh."""
+    from hlsjs_p2p_wrapper_tpu.engine.mesh import HANDSHAKE_REAP_MS
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    mesh, _cache = make_mesh(net, clock, "a")
+    net.register("ghost")  # exists on the fabric, never replies
+    for _ in range(6):     # announce rounds re-listing the ghost
+        mesh.on_tracker_peers(["ghost"])
+        clock.advance(HANDSHAKE_REAP_MS / 4)
+    # the entry was reaped mid-loop and recreated by the re-listing
+    # (bounded: one PeerState cycle per listing window, not forever);
+    # once the tracker stops listing the ghost, the cycle ages out
+    clock.advance(HANDSHAKE_REAP_MS)
+    mesh.on_tracker_peers([])
+    assert "ghost" not in mesh.peers
+    mesh.close()
+
+
+def test_idle_reap_sends_bye_for_symmetry():
+    """Idle-reaping a quiet-but-alive neighbor must TELL them (BYE):
+    otherwise the pair is asymmetrically handshaked and the remote's
+    next request to us would burn a full request timeout."""
+    from hlsjs_p2p_wrapper_tpu.engine.mesh import PEER_IDLE_REAP_MS
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    mesh_a, _ = make_mesh(net, clock, "a")
+    mesh_b, _ = make_mesh(net, clock, "b")
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    assert mesh_a.peers["b"].handshaked and mesh_b.peers["a"].handshaked
+    clock.advance(PEER_IDLE_REAP_MS + 1.0)  # total silence
+    mesh_a.on_tracker_peers([])             # a's announce-cadence sweep
+    clock.advance(50.0)                     # BYE crosses the wire
+    assert "b" not in mesh_a.peers
+    assert "a" not in mesh_b.peers          # told, not ghosted
+    mesh_a.close()
+    mesh_b.close()

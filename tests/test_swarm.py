@@ -278,3 +278,40 @@ def test_prefetch_retry_rotates_holders():
         assert sequence == ["h-one", "h-two", "h-three"][:len(sequence)], \
             (key, sequence)
         assert len(set(sequence)) == len(sequence)  # never repeats
+
+
+def test_churn_soak_mesh_state_stays_bounded():
+    """Long-uptime invariant: a peer that outlives waves of churn must
+    not accumulate state for departed neighbors — peers map, upload
+    slots, in-flight downloads, bans, penalties, and the ABR-honesty
+    duration map (tied to cache occupancy) all stay bounded.  The
+    fabric-level analogue (threads/sockets) lives in test_net.py; this
+    is the protocol-state half."""
+    swarm = SwarmHarness(cdn_bandwidth_bps=20_000_000.0, frag_count=10,
+                         seg_duration=4.0)
+    seed = swarm.add_peer("seed")
+    swarm.run(25_000.0)
+    for wave in range(3):
+        names = [f"w{wave}-{i}" for i in range(3)]
+        for name in names:
+            swarm.add_peer(name)
+        swarm.run(12_000.0)
+        for peer in [p for p in swarm.peers if p.peer_id in names]:
+            peer.leave()
+        swarm.run(3_000.0)
+
+    # the tracker may re-list just-departed peers for one lease round,
+    # recreating half-open handshake entries; those reap at announce
+    # cadence once HANDSHAKE_REAP_MS (20 s) passes unanswered
+    swarm.run(30_000.0)
+    mesh = seed.agent.mesh
+    assert len(mesh.peers) == 0, list(mesh.peers)   # everyone departed
+    assert mesh._uploads == {} and mesh._downloads == {}
+    assert mesh._banned == {}                        # clean churn: no bans
+    # edge attribution survives (it is the stats surface) but bounded
+    assert len(mesh.downloaded_from) <= mesh.MAX_EDGE_ENTRIES
+    assert len(mesh.uploaded_to) <= mesh.MAX_EDGE_ENTRIES
+    agent = seed.agent
+    # duration map is keyed by cached segments only (evict-paired)
+    assert len(agent._transfer_ms) <= len(agent.cache)
+    assert len(agent._prefetches) == 0
