@@ -475,3 +475,31 @@ def test_malformed_and_hostile_frames_do_not_kill_agent_dispatch():
     assert b.stats["p2p"] > 0  # P2P leg worked after the hostile frames
     a.dispose()
     b.dispose()
+
+
+def test_budget_expiry_aborts_live_p2p_leg_and_cdn_delivers():
+    """Mid-transfer budget failover: a holder that is ALIVE but too
+    slow to beat the P2P time budget gets its transfer aborted (not
+    failed) and the CDN leg restarts the payload — partial P2P bytes
+    are discarded from the stats, and the downloader still gets the
+    exact segment."""
+    rig = Swarm()
+    seeder = rig.agent("s", config={"uplink_bps": 20_000.0})  # ~20 kbps
+    rig.clock.advance(100.0)
+    fetch(seeder, 30, rig.clock)          # seeder caches sn=30 via CDN
+    rig.clock.advance(100.0)
+    slowpoke = rig.agent("d", config={
+        # generous margin so the P2P leg is tried, small budget cap so
+        # the slow transfer cannot possibly finish inside it
+        "urgent_margin_s": 0.0,
+        "p2p_budget_cap_ms": 1_500.0,
+        "p2p_budget_floor_ms": 1_500.0})
+    rig.clock.advance(500.0)              # handshakes + BITFIELD
+    out, _ = fetch(slowpoke, 30, rig.clock, advance=30_000.0)
+    assert len(out["success"]) == 1       # delivered, via the CDN leg
+    assert slowpoke.stats["cdn"] == 50_000
+    assert slowpoke.stats["p2p"] == 0     # partial P2P bytes discarded
+    # the holder really was asked first (it burned uplink for nothing)
+    assert seeder.stats["upload"] > 0
+    seeder.dispose()
+    slowpoke.dispose()
