@@ -661,3 +661,63 @@ def test_idle_reap_sends_bye_for_symmetry():
     assert "a" not in mesh_b.peers          # told, not ghosted
     mesh_a.close()
     mesh_b.close()
+
+
+def test_unknown_holder_selection_rejected():
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    with pytest.raises(ValueError, match="holder_selection"):
+        make_mesh(net, clock, "a", holder_selection="sperad")
+
+
+def test_holder_penalty_map_prunes_expired_entries():
+    """The adaptive policy's penalty map is attacker/churn-exposed
+    state (one entry per misbehaving holder id): past the cap, the
+    expired entries must be swept rather than accumulating."""
+    from hlsjs_p2p_wrapper_tpu.engine.mesh import (HOLDER_PENALTY_MS,
+                                                   PeerMesh)
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    mesh, _cache = make_mesh(net, clock, "a")
+    for i in range(PeerMesh.MAX_EDGE_ENTRIES):
+        mesh._penalize_holder(f"old-{i}")
+    clock.advance(HOLDER_PENALTY_MS + 1.0)   # all of those expire
+    mesh._penalize_holder("fresh")           # tips past the cap: sweep
+    assert len(mesh._holder_penalty) == 1
+    assert "fresh" in mesh._holder_penalty
+    mesh.close()
+
+
+def test_broadcast_have_for_evicted_key_is_silent(duo):
+    clock, net, (mesh_a, cache_a), (mesh_b, cache_b) = duo
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    delivered = net.frames_delivered
+    mesh_a.broadcast_have(key(99))           # never cached: would lie
+    clock.advance(50.0)
+    assert net.frames_delivered == delivered  # nothing went out
+
+
+def test_upload_to_partitioned_peer_expires_at_ttl(duo):
+    """A serve whose destination stops acking (partition mid-serve)
+    must give up at UPLOAD_TTL_MS and free the upload slot — a dead
+    requester cannot pin admission capacity forever."""
+    from hlsjs_p2p_wrapper_tpu.engine.mesh import UPLOAD_TTL_MS
+    clock, net, (mesh_a, cache_a), (mesh_b, cache_b) = duo
+    # shaped uplink so the serve paces over many pump rounds
+    mesh_a.endpoint.uplink_bps = 100_000.0
+    cache_a.put(key(3), b"x" * 200_000)      # ~16 s of uplink
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    got = {}
+    mesh_b.request("a", key(3),
+                   on_success=lambda d: got.__setitem__("data", d),
+                   on_error=lambda e: got.__setitem__("err", e),
+                   timeout_ms=120_000.0)
+    clock.advance(300.0)
+    assert mesh_a._uploads                   # serve in flight
+    net.partition("a", "b")
+    clock.advance(UPLOAD_TTL_MS + 1_000.0)
+    assert mesh_a._uploads == {}             # slot reclaimed
+    mesh_a.close()
+    mesh_b.close()
