@@ -674,8 +674,7 @@ def test_holder_penalty_map_prunes_expired_entries():
     """The adaptive policy's penalty map is attacker/churn-exposed
     state (one entry per misbehaving holder id): past the cap, the
     expired entries must be swept rather than accumulating."""
-    from hlsjs_p2p_wrapper_tpu.engine.mesh import (HOLDER_PENALTY_MS,
-                                                   PeerMesh)
+    from hlsjs_p2p_wrapper_tpu.engine.mesh import HOLDER_PENALTY_MS
     clock = VirtualClock()
     net = LoopbackNetwork(clock, default_latency_ms=5.0)
     mesh, _cache = make_mesh(net, clock, "a")
