@@ -54,6 +54,15 @@ HANDSHAKE_REAP_MS = 4 * HANDSHAKE_RETRY_MS
 #: announce round if reaped, so the only cost of a false positive is
 #: one HELLO/BITFIELD exchange.
 PEER_IDLE_REAP_MS = 300_000.0
+#: per-neighbor bound on announced segment keys.  A truthful peer's
+#: announcements are bounded by its own cache budget (64 MiB at
+#: typical segment sizes is a few hundred keys); a hostile one can
+#: stream HAVE frames (or one huge BITFIELD — the 64 MiB frame cap
+#: alone admits ~1.4M entries) to grow our per-peer state without
+#: limit.  At the cap, the OLDEST announcement is evicted: fresh
+#: segments are the useful ones, and anything this stale is likely
+#: evicted remotely anyway.  Generous (~50× a truthful cache).
+MAX_REMOTE_HAVE = 8_192
 #: how long a peer that served bytes contradicting its own
 #: announcement stays banned.  Finite, so one corrupted transfer
 #: (bit-rot, not malice) doesn't permanently shrink a small swarm.
@@ -495,12 +504,21 @@ class PeerMesh:
             return  # never handshaked with this peer; ignore
 
         if isinstance(msg, P.Bitfield):
+            # keep the TAIL on overflow: bitfields are built from
+            # cache.entries(), oldest-first, and fresh segments are
+            # the ones worth knowing a holder for
             state.have = {key: (size, digest)
-                          for key, size, digest in msg.entries}
+                          for key, size, digest
+                          in msg.entries[-MAX_REMOTE_HAVE:]}
             if state.have and self.on_remote_have is not None:
                 self.on_remote_have(src_id)
         elif isinstance(msg, P.Have):
+            # refresh-to-newest on re-announce, then cap FIFO: the
+            # oldest announcement goes, never the one just received
+            state.have.pop(msg.key, None)
             state.have[msg.key] = (msg.size, msg.digest)
+            while len(state.have) > MAX_REMOTE_HAVE:
+                state.have.pop(next(iter(state.have)))
             if self.on_remote_have is not None:
                 self.on_remote_have(src_id)
         elif isinstance(msg, P.Lost):

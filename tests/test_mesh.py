@@ -720,3 +720,34 @@ def test_upload_to_partitioned_peer_expires_at_ttl(duo):
     assert mesh_a._uploads == {}             # slot reclaimed
     mesh_a.close()
     mesh_b.close()
+
+
+def test_remote_have_map_bounded_under_announce_storm(duo):
+    """A hostile neighbor streaming HAVE frames (or one huge
+    BITFIELD) must not grow our per-peer state without limit: the
+    announce map caps at MAX_REMOTE_HAVE, evicting the OLDEST
+    announcement, never the newest."""
+    import hashlib as _hashlib
+
+    from hlsjs_p2p_wrapper_tpu.engine.mesh import MAX_REMOTE_HAVE
+    clock, net, (mesh_a, _), (mesh_b, _) = duo
+    mesh_a.connect_to("b")
+    clock.advance(50.0)
+    evil = net._endpoints["b"]  # a handshaked peer gone hostile
+    digest = _hashlib.sha256(b"x").digest()
+    total = MAX_REMOTE_HAVE + 500
+    for sn in range(total):
+        evil.send("a", P.encode(P.Have(key(sn), 1, digest)))
+    clock.advance(2_000.0)
+    have = mesh_a.peers["b"].have
+    assert len(have) == MAX_REMOTE_HAVE
+    assert key(total - 1) in have        # newest kept
+    assert key(0) not in have            # oldest evicted
+    # oversized BITFIELD keeps the TAIL (bitfields list oldest-first,
+    # so the tail is the fresh half — the one worth holding)
+    entries = tuple((key(sn), 1, digest) for sn in range(total))
+    evil.send("a", P.encode(P.Bitfield(entries)))
+    clock.advance(2_000.0)
+    have = mesh_a.peers["b"].have
+    assert len(have) == MAX_REMOTE_HAVE
+    assert key(total - 1) in have and key(0) not in have
