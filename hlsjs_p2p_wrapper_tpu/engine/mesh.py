@@ -260,6 +260,11 @@ class PeerMesh:
         peers map grows with every churned neighbor for the life of
         the session (tests/test_swarm.py
         test_churn_soak_mesh_state_stays_bounded)."""
+        # expired bans otherwise only clear when that exact id is
+        # queried again — churned-and-banned ids would accumulate
+        for peer_id in [p for p, exp in self._banned.items()
+                        if now >= exp]:
+            del self._banned[peer_id]
         stale = []
         for peer_id, state in self.peers.items():
             if not state.handshaked:

@@ -179,6 +179,14 @@ class _Connection:
         self._queued_bytes = 0   # enqueued but not yet handed to the OS
         self._drain_bps = 0.0    # EWMA of observed sendall throughput
         self._send_started: Optional[float] = None  # in-flight sendall t0
+        #: last send/receive on this link (monotonic s) — the idle
+        #: signal the endpoint's at-cap LRU eviction ranks by.
+        #: INTENTIONALLY unsynchronized (written by writer/reader
+        #: threads, read under _conn_lock): it is a monotonic hint
+        #: whose worst-case staleness is one store, and eviction
+        #: already tolerates minutes of slack — unlike the
+        #: queue-state fields, no invariant hangs off it
+        self.last_activity = time.monotonic()
         self._cond = threading.Condition()
         self._writer = threading.Thread(target=self._write_loop, daemon=True,
                                         name=f"p2p-writer-{remote_id}")
@@ -196,6 +204,7 @@ class _Connection:
         with self._cond:
             if self.closed or len(self._queue) >= self.MAX_QUEUED_FRAMES:
                 return False
+            self.last_activity = time.monotonic()
             self._queue.append(frame)
             self._queued_bytes += len(frame)
             self._cond.notify()
@@ -381,6 +390,7 @@ class TcpEndpoint:
         self._conns: Dict[str, _Connection] = {}
         self._extra_conns: list = []  # crossed-dial inbound links
         self._conn_lock = threading.Lock()
+        self._pending_handshakes = 0  # guarded by _conn_lock
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -408,12 +418,36 @@ class TcpEndpoint:
             conns = list(self._conns.values()) + list(self._extra_conns)
         return max((conn.backlog_ms() for conn in conns), default=0.0)
 
+    def _evict_for_admission_locked(self):
+        """Caller holds ``_conn_lock``.  Decide whether a NEW
+        connection may register: under the cap → yes; at the cap →
+        evict the least-recently-active link idle past
+        CONN_IDLE_EVICT_S (returned for the caller to close OUTSIDE
+        the lock — close() re-enters via _forget); every link busy →
+        refuse.  See MAX_CONNECTIONS."""
+        total = len(self._conns) + len(self._extra_conns)
+        if total < self.MAX_CONNECTIONS:
+            return True, None
+        now = time.monotonic()
+        candidates = [
+            c for c in list(self._conns.values()) + self._extra_conns
+            if not c.closed
+            and now - c.last_activity >= self.CONN_IDLE_EVICT_S]
+        if not candidates:
+            return False, None
+        victim = min(candidates, key=lambda c: c.last_activity)
+        if self._conns.get(victim.remote_id) is victim:
+            del self._conns[victim.remote_id]
+        elif victim in self._extra_conns:
+            self._extra_conns.remove(victim)
+        return True, victim
+
     # -- outbound ------------------------------------------------------
     def send(self, dest_id: str, frame: bytes) -> bool:
         """Queue a frame; never blocks.  True means queued — like the
         loopback fabric, delivery is not acknowledged and receivers
         rely on protocol timeouts."""
-        started = None
+        started = victim = None
         with self._conn_lock:
             # closed-check inside the lock: a send racing close() must
             # not register a fresh connection on a dead endpoint
@@ -421,8 +455,13 @@ class TcpEndpoint:
                 return False
             conn = self._conns.get(dest_id)
             if conn is None or conn.closed:
+                admit, victim = self._evict_for_admission_locked()
+                if not admit:
+                    return False  # every link busy; like a full queue
                 conn = started = _Connection(self, dest_id)
                 self._conns[dest_id] = conn
+        if victim is not None:
+            victim.close()
         queued = conn.enqueue(frame)
         if started is not None:
             started.start()
@@ -443,13 +482,52 @@ class TcpEndpoint:
                 sock, _addr = self._listener.accept()
             except OSError:
                 return
-            threading.Thread(target=self._handshake_inbound, args=(sock,),
-                             daemon=True).start()
+            with self._conn_lock:
+                # gate BEFORE spawning: a connect flood must not pin
+                # one thread + fd per dial for the handshake timeout
+                admit = (not self.closed and self._pending_handshakes
+                         < self.MAX_PENDING_HANDSHAKES)
+                if admit:
+                    self._pending_handshakes += 1
+            if not admit:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            threading.Thread(target=self._handshake_tracked,
+                             args=(sock,), daemon=True).start()
+
+    def _handshake_tracked(self, sock: socket.socket) -> None:
+        try:
+            self._handshake_inbound(sock)
+        finally:
+            with self._conn_lock:
+                self._pending_handshakes -= 1
 
     #: a peer-id preamble is a short host:port string — an
     #: unauthenticated connection must not get to buffer a full-size
     #: frame before identity validation
     MAX_PREAMBLE_BYTES = 512
+    #: bound on live connections (each one holds a socket + writer
+    #: thread + reader thread): a swarm neighbor set is tracker-fed
+    #: and small, so hundreds is already generous.  At the cap, the
+    #: least-recently-active connection idle past
+    #: CONN_IDLE_EVICT_S is evicted to admit the newcomer (so
+    #: neighbor churn can never wedge the endpoint deaf behind dead
+    #: links); if every link is genuinely active, the newcomer is
+    #: refused.  Enforced on BOTH inbound registration and outbound
+    #: connection creation.
+    MAX_CONNECTIONS = 256
+    #: a connection this long without a frame either way is fair
+    #: game for at-cap eviction (the mesh's announce cadence keeps
+    #: healthy neighbors far below this)
+    CONN_IDLE_EVICT_S = 60.0
+    #: concurrent inbound handshakes allowed to be in flight; past
+    #: this, accepted sockets are closed immediately — a connect
+    #: flood must not pin one thread + fd per dial for the whole
+    #: handshake timeout
+    MAX_PENDING_HANDSHAKES = 64
 
     def _handshake_inbound(self, sock: socket.socket) -> None:
         # the whole identity handshake runs under ONE absolute
@@ -509,6 +587,7 @@ class TcpEndpoint:
             sock.close()
             return
         conn = _Connection(self, remote_id, sock)
+        victim = None
         with self._conn_lock:
             # a handshake racing close() must not register a fresh
             # connection on a dead endpoint (same guard as send()):
@@ -517,20 +596,30 @@ class TcpEndpoint:
             if self.closed:
                 register = False
             else:
-                register = True
                 # reuse: an inbound link doubles as our outbound to
                 # them; a stale dead entry must not shadow the fresh
                 # link
                 existing = self._conns.get(remote_id)
-                if existing is None or existing.closed:
-                    self._conns[remote_id] = conn
-                else:
+                if existing is not None and not existing.closed:
                     # crossed dial: both sides connected
                     # simultaneously.  This inbound IS the remote's
                     # working outbound — keep reading from it, but
                     # track it separately so close() still reaps it
-                    # (untracked = socket+thread leak)
-                    self._extra_conns.append(conn)
+                    # (untracked = socket+thread leak).  A duplicate
+                    # link to an ALREADY-CONNECTED peer never evicts
+                    # a third party (a re-dialing neighbor must not
+                    # be able to churn out idle legitimate links);
+                    # admit only if the cap has room.
+                    register = (len(self._conns) + len(self._extra_conns)
+                                < self.MAX_CONNECTIONS)
+                    if register:
+                        self._extra_conns.append(conn)
+                else:
+                    register, victim = self._evict_for_admission_locked()
+                    if register:
+                        self._conns[remote_id] = conn
+        if victim is not None:
+            victim.close()  # outside the lock: close() re-enters _forget
         if not register:
             conn.close()
             return
@@ -542,6 +631,7 @@ class TcpEndpoint:
             if frame is None:
                 conn.close()
                 return
+            conn.last_activity = time.monotonic()
             self.bytes_received += len(frame)
             src = conn.remote_id
 

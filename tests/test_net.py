@@ -618,3 +618,103 @@ def test_handshake_completing_after_close_does_not_register():
         gate.close()
     finally:
         network.close()
+
+
+def test_connection_cap_refuses_flood_and_evicts_idle():
+    """Each live connection holds a socket + two threads, so the
+    endpoint caps them.  While every link is ACTIVE a newcomer is
+    refused (deterministically observed: the refused dialer's link
+    gets EOF and is pruned on its side); once a link has been idle
+    past CONN_IDLE_EVICT_S, the newcomer evicts it instead — churn
+    can never wedge the endpoint deaf behind dead links."""
+    from hlsjs_p2p_wrapper_tpu.engine.net import TcpEndpoint
+
+    network = TcpNetwork()
+    orig_cap = TcpEndpoint.MAX_CONNECTIONS
+    orig_idle = TcpEndpoint.CONN_IDLE_EVICT_S
+    TcpEndpoint.MAX_CONNECTIONS = 2
+    # refusal phase first with eviction effectively OFF: a scheduling
+    # pause must not flip refusal into eviction mid-test
+    TcpEndpoint.CONN_IDLE_EVICT_S = 3600.0
+    try:
+        target = network.register()
+        got = []
+        target.on_receive = lambda src, f: got.append((src, f))
+        friends = [network.register() for _ in range(2)]
+        for i, ep in enumerate(friends):
+            ep.send(target.peer_id, b"hi%d" % i)
+        assert wait_for(lambda: len(got) == 2)
+        assert len(target._conns) == 2
+
+        flooder = network.register()
+        flooder.on_receive = lambda src, f: None
+        assert flooder.send(target.peer_id, b"overflow")
+        # deterministic refusal signal: the target closed the new
+        # link, so the flooder's outbound conn dies and is pruned
+        assert wait_for(lambda: target.peer_id not in flooder._conns)
+        assert len(target._conns) + len(target._extra_conns) == 2
+        assert all(f != b"overflow" for _, f in got)
+
+        # the established links still work
+        friends[0].send(target.peer_id, b"keepalive")
+        assert wait_for(lambda: got and got[-1][1] == b"keepalive")
+
+        # eviction phase: shrink the idle window so friends[1]'s
+        # quiet link is now fair game while friends[0] stays active
+        TcpEndpoint.CONN_IDLE_EVICT_S = 1.0
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            friends[0].send(target.peer_id, b"fresh")  # keep 0 active
+            if time.monotonic() - target._conns[
+                    friends[1].peer_id].last_activity > 1.2:
+                break
+            time.sleep(0.2)
+        late = network.register()
+        late.on_receive = lambda src, f: None
+        done = threading.Event()
+        target.on_receive = lambda src, f: (got.append((src, f)),
+                                            f == b"im-in" and done.set())
+        assert late.send(target.peer_id, b"im-in")
+        assert wait_for(done.is_set)
+        assert len(target._conns) + len(target._extra_conns) <= 2
+        assert friends[1].peer_id not in target._conns  # idle one evicted
+    finally:
+        TcpEndpoint.MAX_CONNECTIONS = orig_cap
+        TcpEndpoint.CONN_IDLE_EVICT_S = orig_idle
+        network.close()
+
+
+def test_pending_handshake_gate_sheds_connect_flood():
+    """Accepted-but-unauthenticated connections are capped BEFORE a
+    handshake thread is spawned: with the gate at 1 and one silent
+    dial parked in its handshake, the next dial is closed immediately
+    rather than pinning a second thread + fd for the whole handshake
+    timeout."""
+    import socket as socket_mod
+
+    from hlsjs_p2p_wrapper_tpu.engine.net import TcpEndpoint
+
+    network = TcpNetwork()
+    orig = TcpEndpoint.MAX_PENDING_HANDSHAKES
+    TcpEndpoint.MAX_PENDING_HANDSHAKES = 1
+    try:
+        target = network.register()
+        host, port = target.peer_id.rsplit(":", 1)
+        parked = socket_mod.create_connection((host, int(port)),
+                                              timeout=5.0)
+        time.sleep(0.2)  # its handshake thread is now pending
+        shed = socket_mod.create_connection((host, int(port)),
+                                            timeout=5.0)
+        shed.settimeout(2.0)  # far below HANDSHAKE_TIMEOUT_S
+        try:
+            dropped = shed.recv(1) == b""
+        except socket_mod.timeout:
+            dropped = False
+        except OSError:
+            dropped = True
+        assert dropped, "second dial was not shed at the gate"
+        parked.close()
+        shed.close()
+    finally:
+        TcpEndpoint.MAX_PENDING_HANDSHAKES = orig
+        network.close()
