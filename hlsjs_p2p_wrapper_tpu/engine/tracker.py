@@ -40,6 +40,27 @@ def swarm_id_for(content_url: str, p2p_config: Optional[dict] = None) -> str:
 class Tracker:
     """Authoritative membership store, transport-agnostic core."""
 
+    #: bounds on attacker-mintable state — within one lease window an
+    #: announce flood could otherwise register unlimited
+    #: (swarm, peer) pairs.  At a cap, NEW ids are not registered
+    #: (the service stays up and existing members keep refreshing);
+    #: slots free as leases expire.  Discovery only needs recency
+    #: (max_peers_returned is 30), so the member cap is a discovery
+    #: working set, not an audience size.  RESIDUAL, documented: an
+    #: attacker who keeps refreshing capped-out state squats it for
+    #: as long as it keeps paying announces (first-come admission has
+    #: no eviction) — on a PSK fabric only key-holding members can
+    #: reach the tracker at all, and per-source quotas beyond that
+    #: are a deployment concern (the reference ran its tracker as a
+    #: closed backend service, SURVEY §2.4).
+    MAX_SWARMS = 1_024
+    MAX_MEMBERS_PER_SWARM = 2_048
+    #: global expiry sweep cadence: sweeping every announce would make
+    #: each announce O(total members) — the touched swarm is expired
+    #: inline (bounded by the member cap); everything else on this
+    #: clock throttle
+    EXPIRE_SWEEP_MS = 1_000.0
+
     def __init__(self, clock: Clock, *, lease_ms: float = DEFAULT_LEASE_MS,
                  max_peers_returned: int = 30):
         self.clock = clock
@@ -48,18 +69,30 @@ class Tracker:
         # swarm id -> peer id -> lease expiry (ms)
         self._swarms: Dict[str, Dict[str, float]] = {}
         self.announce_count = 0
+        self._last_sweep_ms = -1e18
 
     def announce(self, swarm_id: str, peer_id: str) -> List[str]:
         """Join/refresh; returns current co-members (excluding self),
         most-recently-announced first, capped at
-        ``max_peers_returned``."""
+        ``max_peers_returned``.  At the state caps (MAX_SWARMS /
+        MAX_MEMBERS_PER_SWARM) a NEW swarm or member is answered but
+        not registered — refusal to remember is not refusal to
+        serve."""
         self.announce_count += 1
         now = self.clock.now()
         self._expire_swarms(now)
-        swarm = self._swarms.setdefault(swarm_id, {})
-        # re-insert to refresh both lease and recency order
-        swarm.pop(peer_id, None)
-        swarm[peer_id] = now + self.lease_ms
+        swarm = self._swarms.get(swarm_id)
+        if swarm is not None:
+            self._expire_members(swarm_id, swarm, now)
+            swarm = self._swarms.get(swarm_id)
+        if swarm is None:
+            if len(self._swarms) >= self.MAX_SWARMS:
+                return []
+            swarm = self._swarms[swarm_id] = {}
+        known = swarm.pop(peer_id, None) is not None
+        if known or len(swarm) < self.MAX_MEMBERS_PER_SWARM:
+            # re-insert to refresh both lease and recency order
+            swarm[peer_id] = now + self.lease_ms
         others = [p for p in swarm if p != peer_id]
         others.reverse()
         return others[: self.max_peers_returned]
@@ -72,12 +105,32 @@ class Tracker:
                 del self._swarms[swarm_id]
 
     def members(self, swarm_id: str) -> List[str]:
-        self._expire_swarms(self.clock.now())
+        now = self.clock.now()
+        self._expire_swarms(now)
+        swarm = self._swarms.get(swarm_id)
+        if swarm is not None:
+            self._expire_members(swarm_id, swarm, now)
         return list(self._swarms.get(swarm_id, {}))
+
+    def _expire_members(self, swarm_id: str, swarm: Dict[str, float],
+                        now: float) -> None:
+        """Expire ONE swarm's leases inline (cost bounded by the
+        member cap) — the swarm being touched must be current even
+        between global sweeps, or a full swarm would refuse newcomers
+        while holding dead leases."""
+        for peer_id in [p for p, exp in swarm.items() if exp <= now]:
+            del swarm[peer_id]
+        if not swarm:
+            del self._swarms[swarm_id]
 
     def _expire_swarms(self, now: float) -> None:
         """Drop expired leases AND emptied swarms — a long-lived
-        tracker must not leak a dict per content ever served."""
+        tracker must not leak a dict per content ever served.
+        Throttled to EXPIRE_SWEEP_MS: the sweep is O(total members),
+        which must not be a per-announce cost (see the cap notes)."""
+        if now - self._last_sweep_ms < self.EXPIRE_SWEEP_MS:
+            return
+        self._last_sweep_ms = now
         for swarm_id in list(self._swarms):
             swarm = self._swarms[swarm_id]
             for peer_id in [p for p, exp in swarm.items() if exp <= now]:

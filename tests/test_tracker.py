@@ -159,6 +159,50 @@ def test_expired_swarms_fully_pruned():
     tracker = Tracker(clock, lease_ms=100.0)
     for i in range(50):
         tracker.announce(f"swarm-{i}", "p")
-    clock.advance(200.0)
+    # the global sweep is throttled (EXPIRE_SWEEP_MS): advance past
+    # both the leases and the sweep cadence
+    clock.advance(Tracker.EXPIRE_SWEEP_MS + 200.0)
     tracker.announce("fresh", "p")
     assert list(tracker._swarms) == ["fresh"]
+
+
+def test_member_cap_refuses_new_but_serves_existing():
+    """Announce floods cannot grow tracker state without limit: at
+    MAX_MEMBERS_PER_SWARM a new id is answered (it still learns
+    co-members) but not registered; existing members keep
+    refreshing; slots free as leases expire."""
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=1_000.0)
+    orig = Tracker.MAX_MEMBERS_PER_SWARM
+    Tracker.MAX_MEMBERS_PER_SWARM = 3
+    try:
+        for i in range(3):
+            tracker.announce("s", f"p{i}")
+        listed = tracker.announce("s", "flood")  # refused, still served
+        assert listed == ["p2", "p1", "p0"]
+        assert "flood" not in tracker.members("s")
+        assert len(tracker.members("s")) == 3
+        tracker.announce("s", "p0")              # refresh always works
+        assert "p0" in tracker.members("s")
+        clock.advance(2_000.0)                   # leases expire
+        tracker.announce("s", "flood")           # slot freed
+        assert tracker.members("s") == ["flood"]
+    finally:
+        Tracker.MAX_MEMBERS_PER_SWARM = orig
+
+
+def test_swarm_cap_refuses_new_swarms():
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=1_000.0)
+    orig = Tracker.MAX_SWARMS
+    Tracker.MAX_SWARMS = 2
+    try:
+        tracker.announce("s1", "p")
+        tracker.announce("s2", "p")
+        assert tracker.announce("s3", "p") == []   # not registered
+        assert tracker.members("s3") == []
+        clock.advance(2_000.0)                     # both swarms expire
+        tracker.announce("s3", "p")                # now admitted
+        assert tracker.members("s3") == ["p"]
+    finally:
+        Tracker.MAX_SWARMS = orig
