@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
-	coverage
+	coverage soak
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -15,6 +15,10 @@ lint:
 # stdlib-only line coverage (sys.monitoring; needs Python >= 3.12)
 coverage:
 	$(PY) tools/coverage.py
+
+# deterministic large churn soak (~35 s; above CI's scale tier)
+soak:
+	$(PY) tools/soak.py
 
 bench:
 	$(PY) bench.py

@@ -1,0 +1,97 @@
+"""Large churn soak — the manual stress tier above the test suite.
+
+A live-mode swarm with continuous random churn (join-heavy, mixed
+uplinks) at a scale the CI suite deliberately stays under, checking
+the long-uptime invariants at the end (explicit checks, not
+asserts — the tool must fail under ``python -O`` too): the long-lived seeder's mesh
+state must track LIVE membership exactly (no leaked PeerStates,
+uploads, downloads, or bans — the round-4 reap/bound work), playback
+must stay healthy (rebuffer < 5%), and the swarm must genuinely
+offload (> 0.3).
+
+Deterministic (seeded RNG + VirtualClock).  ~35 s of wall clock for
+~5 simulated minutes with ~36 churned viewers.
+
+Usage: ``python tools/soak.py [--rounds N] [--seed S]``
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hlsjs_p2p_wrapper_tpu.testing import SwarmHarness  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rounds", type=int, default=40,
+                        help="churn rounds of 7 simulated seconds each")
+    parser.add_argument("--seed", type=int, default=7)
+    args = parser.parse_args()
+
+    t0 = time.time()
+    rng = random.Random(args.seed)
+    swarm = SwarmHarness(cdn_bandwidth_bps=40_000_000.0, live=True,
+                         frag_count=200, seg_duration=4.0)
+    swarm.add_peer("seed", uplink_bps=20_000_000.0)
+    swarm.run(15_000.0)
+    alive = []
+    counter = 0
+    for _ in range(args.rounds):
+        if rng.random() < 0.75 or not alive:
+            counter += 1
+            alive.append(swarm.add_peer(
+                f"v{counter}",
+                uplink_bps=rng.choice([2e6, 5e6, 10e6])))
+        else:
+            alive.pop(rng.randrange(len(alive))).leave()
+        swarm.run(7_000.0)
+    swarm.run(30_000.0)  # quiesce past the announce-cadence reaps
+
+    seed = next(p for p in swarm.peers if p.peer_id == "seed")
+    mesh = seed.agent.mesh
+    live_ids = {p.peer_id for p in swarm.peers if not p.left} - {"seed"}
+    print(f"wall={time.time() - t0:.1f}s  peers_created={counter}  "
+          f"live={len(live_ids)}  offload={swarm.offload_ratio:.2f}  "
+          f"rebuffer={swarm.rebuffer_ratio:.3%}  "
+          f"waste={swarm.upload_waste_ratio:.2f}x")
+    print(f"seed mesh: peers={len(mesh.peers)} "
+          f"uploads={len(mesh._uploads)} "
+          f"downloads={len(mesh._downloads)} banned={len(mesh._banned)} "
+          f"penalties={len(mesh._holder_penalty)}")
+
+    failures = []
+
+    def check(ok: bool, what: str) -> None:
+        # explicit, not assert: the soak must fail loudly even under
+        # python -O / PYTHONOPTIMIZE, where asserts are stripped
+        if not ok:
+            failures.append(what)
+
+    leaked = set(mesh.peers) - live_ids
+    check(not leaked, f"mesh kept state for departed peers: {leaked}")
+    check(len(mesh._uploads) <= len(live_ids),
+          f"upload slots exceed live peers: {len(mesh._uploads)}")
+    check(all(d.peer_id in live_ids for d in mesh._downloads.values()),
+          "in-flight downloads reference departed peers")
+    check(mesh._banned == {}, f"bans outlived clean churn: {mesh._banned}")
+    check(set(mesh._holder_penalty) <= live_ids | {"seed"},
+          "holder penalties reference departed peers")
+    check(swarm.rebuffer_ratio < 0.05,
+          f"rebuffer {swarm.rebuffer_ratio:.3%}")
+    check(swarm.offload_ratio > 0.3,
+          f"offload {swarm.offload_ratio:.2f}")
+    if failures:
+        for what in failures:
+            print(f"SOAK FAILURE: {what}", file=sys.stderr)
+        return 1
+    print("soak: all long-uptime invariants hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
