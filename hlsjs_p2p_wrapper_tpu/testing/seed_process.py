@@ -8,10 +8,16 @@ instant CDN (caching + announcing it), prints ``READY`` on stdout, and
 serves peers until stdin closes — the minimal living proof that two
 OS processes exchange segments through this framework's real-socket
 transport.
+
+On an authenticated fabric, pass the swarm secret via the
+``P2P_SWARM_PSK`` environment variable (env, not argv: secrets must
+not appear in process lists) — the seeder then runs the same HMAC
+challenge-response handshake as every other member.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 
@@ -63,7 +69,14 @@ def main() -> int:
     from ..engine.net import TcpNetwork
     from ..engine.p2p_agent import P2PAgent
 
-    network = TcpNetwork()
+    psk = os.environ.get("P2P_SWARM_PSK")
+    if psk == "":
+        # an empty secret is a misconfiguration (templating rendered
+        # an unset value), not a request for an open fabric — joining
+        # unauthenticated would just die later as an opaque timeout
+        print("SEED-FAILED P2P_SWARM_PSK is set but empty", flush=True)
+        return 1
+    network = TcpNetwork(psk=psk.encode() if psk else None)
     agent = P2PAgent(
         NullBridge(), "http://cdn.example/master.m3u8", NullMediaMap(),
         {"network": network, "clock": network.loop,

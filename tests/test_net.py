@@ -381,16 +381,20 @@ def test_agent_swarm_over_real_sockets(net):
         follower.dispose()
 
 
-def test_cross_process_swarm():
+@pytest.mark.parametrize("psk", [None, b"xproc-secret"],
+                         ids=["open", "psk"])
+def test_cross_process_swarm(psk):
     """Two OS processes exchange a segment over real TCP: a spawned
     seeder process and an in-test follower, rendezvousing through a
     socket tracker — the reference's 'open several browser tabs'
-    scenario as an actual automated test."""
+    scenario as an actual automated test.  The psk variant proves the
+    standalone seeder completes the HMAC handshake on an
+    authenticated fabric (secret via P2P_SWARM_PSK env)."""
     import os
     import subprocess
     import sys
 
-    net = TcpNetwork()
+    net = TcpNetwork(psk=psk)
     tracker_endpoint = net.register()
     TrackerEndpoint(Tracker(net.loop), tracker_endpoint)
     sn, size = 42, 77_000
@@ -398,6 +402,10 @@ def test_cross_process_swarm():
     env = dict(os.environ)
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
+    if psk is not None:
+        env["P2P_SWARM_PSK"] = psk.decode()
+    else:
+        env.pop("P2P_SWARM_PSK", None)
     child = subprocess.Popen(
         [sys.executable, "-m", "hlsjs_p2p_wrapper_tpu.testing.seed_process",
          tracker_endpoint.peer_id, "xproc-demo", str(sn), str(size)],

@@ -74,8 +74,8 @@ def main() -> int:
 
     leaked = set(mesh.peers) - live_ids
     check(not leaked, f"mesh kept state for departed peers: {leaked}")
-    check(len(mesh._uploads) <= len(live_ids),
-          f"upload slots exceed live peers: {len(mesh._uploads)}")
+    check(all(k[0] in live_ids for k in mesh._uploads),
+          "upload slots reference departed peers")
     check(all(d.peer_id in live_ids for d in mesh._downloads.values()),
           "in-flight downloads reference departed peers")
     check(mesh._banned == {}, f"bans outlived clean churn: {mesh._banned}")
