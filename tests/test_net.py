@@ -726,3 +726,39 @@ def test_pending_handshake_gate_sheds_connect_flood():
     finally:
         TcpEndpoint.MAX_PENDING_HANDSHAKES = orig
         network.close()
+
+
+def test_resolver_budget_and_cache_bounds(monkeypatch):
+    """The resolver's GLOBAL token bucket and cache cap: past
+    MAX_RESOLVES_PER_WINDOW lookups in one window, unverifiable
+    claims fail closed without resolving (the per-host throttle
+    alone is bypassable with ever-changing claimed hosts), and the
+    cache evicts its stalest entry at MAX_RESOLVE_CACHE."""
+    import socket as socket_mod
+
+    network = TcpNetwork()
+    orig_cache = TcpNetwork.MAX_RESOLVE_CACHE
+    TcpNetwork.MAX_RESOLVE_CACHE = 4
+    try:
+        calls = []
+
+        def fake_getaddrinfo(host, port):
+            calls.append(host)
+            return [(0, 0, 0, "", ("10.0.0.1", 0))]
+
+        monkeypatch.setattr(socket_mod, "getaddrinfo", fake_getaddrinfo)
+        budget = network.MAX_RESOLVES_PER_WINDOW
+        for i in range(budget):
+            assert network._host_matches(f"mint-{i}.example",
+                                         "10.0.0.1") is True
+        # budget exhausted: fail closed, resolver NOT consulted
+        assert network._host_matches("one-more.example",
+                                     "10.0.0.1") is False
+        assert len(calls) == budget
+        # and the cache stayed bounded, evicting stalest entries
+        assert len(network._resolve_cache) == 4
+        assert f"mint-{budget - 1}.example" in network._resolve_cache
+        assert "mint-0.example" not in network._resolve_cache
+    finally:
+        TcpNetwork.MAX_RESOLVE_CACHE = orig_cache
+        network.close()

@@ -503,3 +503,24 @@ def test_budget_expiry_aborts_live_p2p_leg_and_cdn_delivers():
     assert seeder.stats["upload"] > 0
     seeder.dispose()
     slowpoke.dispose()
+
+
+def test_default_construction_wall_clock_and_real_transport():
+    """The zero-config path (no clock, no network, no transport):
+    defaults resolve to SystemClock + HttpCdnTransport and the agent
+    constructs, answers its surface, and disposes cleanly — the
+    'just give me an agent' integration the README's quick start
+    implies."""
+    from hlsjs_p2p_wrapper_tpu.core.clock import SystemClock
+    from hlsjs_p2p_wrapper_tpu.engine.cdn import HttpCdnTransport
+    agent = P2PAgent(FakeBridge(), "http://cdn.example/master.m3u8",
+                     FakeMediaMap(), {}, SegmentView, "hls", "v2")
+    try:
+        assert isinstance(agent.clock, SystemClock)
+        assert isinstance(agent.cdn_transport, HttpCdnTransport)
+        assert agent.stats == {"cdn": 0, "p2p": 0, "upload": 0,
+                               "peers": 0}
+        assert agent.p2p_download_on and agent.p2p_upload_on
+    finally:
+        agent.dispose()
+    assert agent.disposed
