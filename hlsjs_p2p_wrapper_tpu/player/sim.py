@@ -289,6 +289,12 @@ class SimPlayer(EventEmitter):
             if self.is_live and frags:
                 self._resync_to_live_edge(frags)
             if self.next_sn is None:
+                if not self.is_live:
+                    # VOD seek past the end: nothing will ever be
+                    # fetchable again — without this, the playhead
+                    # sits at an empty buffer accruing rebuffer time
+                    # forever
+                    self.ended = True
                 return
         if self.buffer_length >= self.config["max_buffer_length"]:
             return
@@ -372,6 +378,13 @@ class SimPlayer(EventEmitter):
                                      "event": event})
             self.emit(Events.LEVEL_SWITCH, {"level": frag.level})
             return  # next tick refetches this sn from the backup
+        # DELIBERATE divergence from hls.js, which halts loading on a
+        # fatal error until the app intervenes: the sim player keeps
+        # refetching (each cycle paced by the loader's full retry
+        # ladder), so harness scenarios recover from transient total
+        # outages without modeling an app-recovery layer.  The fatal
+        # ERROR event below is still emitted for the session's
+        # fatal/non-fatal logging parity (wrapper-private.js:228-235).
         self.emit(Events.ERROR, {"type": "networkError",
                                  "details": "fragLoadError", "fatal": True,
                                  "frag": frag, "event": event})

@@ -114,6 +114,19 @@ def test_seek_completes_and_plays_past_target():
     assert player.media.current_time > 31.0
 
 
+def test_seek_past_vod_end_ends_without_rebuffer():
+    """A VOD seek beyond the timeline must settle into `ended`, not
+    sit at an empty buffer accruing rebuffer time forever."""
+    clock, player, wrapper, cdn = make_session()
+    clock.advance(5_000)
+    player.seek(10_000.0)  # far past the timeline
+    clock.advance(200)     # one fetch decision
+    assert player.ended
+    stalled_at = player.rebuffer_ms
+    clock.advance(10_000)
+    assert player.rebuffer_ms == stalled_at  # no infinite stall accrual
+
+
 # --- ABR under shaping (test/html/bundle.js:80-101) -------------------
 
 def test_abr_pins_to_lowest_level_under_64kbps():
