@@ -174,6 +174,15 @@ class _Connection:
         self.endpoint = endpoint
         self.remote_id = remote_id
         self.sock = sock  # None → outbound; writer thread connects
+        #: constructed around an accepted socket (inbound)?  start()
+        #: must key its reader-spawn on THIS, not on `sock is not
+        #: None`: for an outbound conn the writer thread may complete
+        #: a (localhost-fast) connect and set `sock` before start()'s
+        #: check runs, and the sock-based test then spawned a SECOND
+        #: reader — two readers on one socket steal bytes from each
+        #: other and permanently desync the frame stream (the
+        #: long-standing intermittent mesh-never-connects flake)
+        self._inbound = sock is not None
         self.closed = False
         self._queue: list = []
         self._queued_bytes = 0   # enqueued but not yet handed to the OS
@@ -194,9 +203,13 @@ class _Connection:
     def start(self) -> None:
         """Begin I/O.  Called AFTER the endpoint has registered this
         connection — a fast connect failure must not race the
-        registration and resurrect a pruned entry."""
+        registration and resurrect a pruned entry.  The reader is
+        spawned here only for INBOUND connections; an outbound
+        connection's reader is spawned by its writer thread once the
+        connect completes (see the `_inbound` field docs for the
+        double-reader race the sock-based check here used to cause)."""
         self._writer.start()
-        if self.sock is not None:
+        if self._inbound:
             threading.Thread(target=self.endpoint._reader_loop, args=(self,),
                              daemon=True).start()
 

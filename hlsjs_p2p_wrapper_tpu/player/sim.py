@@ -177,7 +177,10 @@ class SimPlayer(EventEmitter):
         self.media.current_time = t
         self.buffer_end = t
         self.next_sn = self._sn_for_time(t)
-        self.ended = False
+        # a VOD seek past the end is ended NOW: deciding it on the
+        # next tick would let _advance_playback charge one spurious
+        # TICK_MS of rebuffer first (tick order: playback then fetch)
+        self.ended = self.next_sn is None and not self.is_live
 
     def destroy(self) -> None:
         self.emit(Events.DESTROYING, {})

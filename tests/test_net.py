@@ -762,3 +762,43 @@ def test_resolver_budget_and_cache_bounds(monkeypatch):
     finally:
         TcpNetwork.MAX_RESOLVE_CACHE = orig_cache
         network.close()
+
+
+def test_outbound_start_never_spawns_reader_even_if_connect_won_race():
+    """The double-reader race: an outbound connection's writer thread
+    can finish a (localhost-fast) connect and set `conn.sock` BEFORE
+    start() runs its reader-spawn check.  A sock-based check then
+    started a second reader; two readers on one socket steal bytes
+    from each other and permanently desync the frame stream (the
+    historical intermittent mesh-never-connects flake).  start() must
+    key on how the connection was CONSTRUCTED, not on current sock
+    state."""
+    import socket as socket_mod
+
+    from hlsjs_p2p_wrapper_tpu.engine.net import _Connection
+
+    network = TcpNetwork()
+    try:
+        endpoint = network.register()
+        reader_spawns = []
+        endpoint._reader_loop = lambda conn: reader_spawns.append(conn)
+
+        a, b = socket_mod.socketpair()
+        # outbound-constructed conn; simulate the racing writer having
+        # already connected by the time start() runs
+        conn = _Connection(endpoint, "127.0.0.1:1")
+        conn.sock = a
+        conn.start()
+        time.sleep(0.2)
+        assert reader_spawns == []  # writer owns the outbound reader
+
+        # inbound-constructed conn still gets its reader from start()
+        conn_in = _Connection(endpoint, "127.0.0.1:2", sock=b)
+        conn_in.start()
+        assert wait_for(lambda: len(reader_spawns) == 1)
+        conn.close()
+        conn_in.close()
+        a.close()
+        b.close()
+    finally:
+        network.close()
