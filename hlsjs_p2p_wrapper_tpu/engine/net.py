@@ -38,20 +38,41 @@ trust boundary and WebRTC gave it DTLS for free):
   inbound claims of its id costs nothing.
 - **Per-swarm PSK** (``TcpNetwork(psk=...)``): when set, every
   connection runs an HMAC-SHA256 challenge-response right after the
-  preamble — the acceptor sends a random nonce, the connector must
-  answer ``HMAC(psk, nonce ‖ claimed_id)`` before any protocol frame
-  is accepted.  This is the WebRTC-DTLS analogue the reference's
-  closed agent got for free (SURVEY §2.4): a same-host process
-  WITHOUT the swarm secret can no longer claim a registered peer's id
-  (previously it could — round-3 VERDICT missing #3).  Residual, by
-  the nature of a shared symmetric key: a peer that legitimately
-  holds the PSK can still claim another member's id — per-member
-  non-forgeability needs asymmetric identity keys pinned via the
-  tracker, the same residual DTLS has without signaling-bound
-  fingerprints.
+  preamble — both sides contribute a random nonce, and the connector
+  must answer ``HMAC(psk, a_nonce ‖ c_nonce ‖ claimed_id)`` before
+  any protocol frame is accepted.  This is the WebRTC-DTLS analogue
+  the reference's closed agent got for free (SURVEY §2.4): a
+  same-host process WITHOUT the swarm secret can no longer claim a
+  registered peer's id (previously it could — round-3 VERDICT
+  missing #3).  Residual, by the nature of a shared symmetric key: a
+  peer that legitimately holds the PSK can still claim another
+  member's id — per-member non-forgeability needs asymmetric
+  identity keys pinned via the tracker, the same residual DTLS has
+  without signaling-bound fingerprints.
+- **Every post-handshake frame is MACed** on a PSK fabric (round-4
+  VERDICT missing #1 — DTLS protects every *record*, not just the
+  handshake): both sides derive per-connection, per-direction keys
+  from the PSK and both handshake nonces (HKDF-style extract/expand
+  over stdlib ``hmac``), and each frame carries a truncated
+  HMAC-SHA256 tag over ``direction-key ‖ sequence-number ‖ payload``.
+  An on-path active attacker who observed the whole handshake can
+  therefore neither inject a well-formed frame (no session key ⇒ no
+  valid tag), replay one from another connection (keys are
+  nonce-unique), reflect one back to its sender (keys are
+  directional), nor reorder/splice within a stream (the tag binds the
+  per-direction sequence number).  A frame failing verification
+  drops the connection — the same fail-closed discipline the wire
+  decoder applies to malformed frames.
+- **Optional TLS** (``TcpNetwork(ssl_server_context=...,
+  ssl_client_context=...)``): when the deployment also needs
+  confidentiality, every connection can be wrapped in stdlib ``ssl``
+  before the preamble; the PSK handshake and frame MACs then run
+  inside the encrypted channel and keep providing swarm-membership
+  authentication independent of the certificate story.
 - Without a PSK, same-host peers (one machine, many ports) can claim
-  each other's ids — use a PSK, a fronting proxy, or kernel-level
-  isolation in hostile deployments.
+  each other's ids and frames are not integrity-protected — use a
+  PSK, a fronting proxy, or kernel-level isolation in hostile
+  deployments.
 """
 
 from __future__ import annotations
@@ -72,19 +93,216 @@ from ..core.clock import TimerHandle
 log = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<I")
+_SEQ = struct.Struct("<Q")
 MAX_FRAME_BYTES = 64 * 1024 * 1024  # matches the cache-budget defense
 #: auth nonce/MAC frames are tiny; anything bigger is a poisoned stream
 MAX_AUTH_BYTES = 64
 #: whole-handshake socket timeout (preamble + challenge-response): an
 #: unauthenticated connection must not pin a handshake thread forever
 HANDSHAKE_TIMEOUT_S = 5.0
+#: per-frame tag length: HMAC-SHA256 truncated to 16 bytes — the
+#: GCM/DTLS-standard tag size; forging it is a 2^-128 guess per try
+#: and every failed try costs the attacker the connection
+FRAME_MAC_LEN = 16
+#: handshake nonces are EXACTLY this long, enforced on both sides:
+#: the MAC/KDF inputs join variable-length fields with NUL bytes, so
+#: a variable-length attacker-supplied nonce could shift bytes
+#: between the nonce and the claimed id without changing the MAC
+#: input (field-boundary ambiguity) — fixed length makes every field
+#: boundary unambiguous
+NONCE_LEN = 32
 
 
-def _psk_response(psk: bytes, nonce: bytes, claimed_id: bytes) -> bytes:
-    """The challenge answer: binds the PSK, the acceptor's nonce (no
-    replay), and the id the connector claims (no splice onto another
-    preamble)."""
-    return hmac.digest(psk, nonce + b"\x00" + claimed_id, "sha256")
+def _psk_response(psk: bytes, a_nonce: bytes, c_nonce: bytes,
+                  claimed_id: bytes) -> bytes:
+    """The challenge answer: binds the PSK, both nonces (no replay —
+    each side contributes freshness), and the id the connector claims
+    (no splice onto another preamble)."""
+    return hmac.digest(psk, a_nonce + b"\x00" + c_nonce + b"\x00"
+                       + claimed_id, "sha256")
+
+
+def _derive_frame_keys(psk: bytes, a_nonce: bytes, c_nonce: bytes,
+                       claimed_id: bytes) -> tuple:
+    """Per-connection frame-MAC keys, HKDF-style over stdlib ``hmac``:
+    extract a connection secret from the PSK salted by both handshake
+    nonces + the claimed id, then expand one independent key per
+    direction.  Returns ``(c2a_key, a2c_key)`` — connector-to-acceptor
+    and acceptor-to-connector.  Directional keys stop reflection
+    (echoing a peer's own frame back at it); nonce-salted extraction
+    stops cross-connection replay even under PSK reuse."""
+    prk = hmac.digest(psk, b"p2p-frame-mac-v1\x00" + a_nonce + b"\x00"
+                      + c_nonce + b"\x00" + claimed_id, "sha256")
+    return (hmac.digest(prk, b"c2a", "sha256"),
+            hmac.digest(prk, b"a2c", "sha256"))
+
+
+def _frame_tag(key: bytes, seq: int, payload: bytes) -> bytes:
+    """The per-frame tag: binds the directional key, the per-direction
+    sequence number (TCP is ordered, so a simple counter detects both
+    replay-within-stream and deletion/splice), and the payload."""
+    return hmac.digest(key, _SEQ.pack(seq) + payload,
+                       "sha256")[:FRAME_MAC_LEN]
+
+
+def _tls_wrap(sock: socket.socket, ctx, deadline: float, *,
+              server_side: bool, server_hostname: Optional[str] = None):
+    """Complete a TLS handshake under an ABSOLUTE deadline (the same
+    discipline ``_read_exact`` applies to the identity handshake).  A
+    plain ``settimeout`` before ``wrap_socket`` is a per-recv budget —
+    a ClientHello dribbled one byte per almost-timeout would hold the
+    handshake thread ~indefinitely, exactly the slot-pinning DoS the
+    deadline exists to close.  Non-blocking ``do_handshake`` +
+    ``select`` bounded by the REMAINING budget makes the bound real.
+    Returns the wrapped socket (blocking mode restored) or ``None``.
+    On failure the socket is closed HERE: ``wrap_socket`` detaches the
+    caller's fd into the SSLSocket, so a caller-side ``close()`` on
+    the original object would release nothing."""
+    import selectors
+    import ssl
+    tls = None
+    try:
+        sock.setblocking(False)
+        tls = ctx.wrap_socket(sock, server_side=server_side,
+                              server_hostname=server_hostname,
+                              do_handshake_on_connect=False)
+        # selectors (epoll/kqueue), not select.select: the latter
+        # raises on any fd >= FD_SETSIZE (1024), which a process with
+        # a few busy endpoints reaches easily
+        with selectors.DefaultSelector() as sel:
+            key = sel.register(tls, selectors.EVENT_READ)
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise OSError("TLS handshake deadline exceeded")
+                try:
+                    tls.do_handshake()
+                    break
+                except ssl.SSLWantReadError:
+                    events = selectors.EVENT_READ
+                except ssl.SSLWantWriteError:
+                    events = selectors.EVENT_WRITE
+                if key.events != events:
+                    sel.modify(tls, events)
+                    key = sel.get_key(tls)
+                if not sel.select(remaining):
+                    raise OSError("TLS handshake deadline exceeded")
+        return _SafeTls(tls)
+    except (OSError, ValueError):
+        for s in (tls, sock):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        return None
+
+
+class _SafeTls:
+    """Make one TLS connection safe under the endpoint's two-thread
+    socket discipline.  A plain TCP socket tolerates a reader thread
+    in ``recv`` concurrent with a writer thread in ``sendall``; an
+    ``SSLSocket`` does NOT — OpenSSL ``SSL`` objects are not
+    thread-safe for simultaneous ``SSL_read``/``SSL_write`` (TLS 1.3
+    post-handshake records like NewSessionTicket/KeyUpdate mutate
+    shared connection state from the READ path), and CPython releases
+    the GIL around both calls with no per-object lock.  This wrapper
+    keeps the socket non-blocking and serializes every OpenSSL entry
+    under one lock, held ONLY for the non-blocking call itself —
+    readiness waits happen outside the lock, so a reader waiting for
+    bytes never starves the writer (the classic
+    lock-around-blocking-recv deadlock).
+
+    ``close``/``shutdown`` follow the plain-socket idiom the
+    endpoint already uses: ``shutdown`` wakes both waiters (the fd
+    signals readable/writable on EOF), and the bounded wait tick
+    re-checks the closed flag as a backstop."""
+
+    _WAIT_TICK_S = 1.0
+
+    def __init__(self, tls):
+        import selectors
+        self._tls = tls
+        self._lock = threading.Lock()
+        self._closed = False
+        self._timeout: Optional[float] = None
+        tls.setblocking(False)
+        # one persistent selector per waiting side, registered once —
+        # a per-wait DefaultSelector would cost an epoll instance
+        # create/destroy on every block/unblock cycle of every link
+        self._rsel = selectors.DefaultSelector()
+        self._rsel.register(tls, selectors.EVENT_READ)
+        self._wsel = selectors.DefaultSelector()
+        self._wsel.register(tls, selectors.EVENT_WRITE)
+
+    def _wait(self, want_write: bool) -> None:
+        try:
+            (self._wsel if want_write else self._rsel).select(
+                self._WAIT_TICK_S)
+        except (OSError, ValueError):
+            raise OSError("TLS socket closed under waiter")
+
+    def recv(self, n: int) -> bytes:
+        import ssl
+        deadline = (time.monotonic() + self._timeout
+                    if self._timeout is not None else None)
+        while True:
+            if self._closed:
+                raise OSError("TLS connection closed")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise socket.timeout("timed out")  # OSError: caller drops
+            with self._lock:
+                try:
+                    return self._tls.recv(n)
+                except ssl.SSLWantReadError:
+                    want_write = False
+                except ssl.SSLWantWriteError:
+                    want_write = True
+                except ssl.SSLEOFError:
+                    return b""
+            self._wait(want_write)
+
+    def sendall(self, data: bytes) -> None:
+        import ssl
+        view = memoryview(data)
+        while view.nbytes:
+            if self._closed:
+                raise OSError("TLS connection closed")
+            want_write = True
+            with self._lock:
+                try:
+                    sent = self._tls.send(view)
+                    view = view[sent:]
+                    continue
+                except ssl.SSLWantWriteError:
+                    pass
+                except ssl.SSLWantReadError:
+                    want_write = False
+            self._wait(want_write)
+
+    def settimeout(self, value) -> None:
+        """Honored by ``recv`` as an absolute per-call budget — the
+        identity handshake's deadline discipline (``_read_exact``)
+        must keep binding after the TLS wrap, or a post-TLS dribbler
+        would pin the handshake thread the old way."""
+        self._timeout = value
+
+    def getpeername(self):
+        return self._tls.getpeername()
+
+    def shutdown(self, how) -> None:
+        self._closed = True
+        self._tls.shutdown(how)  # plain fd shutdown: wakes both waiters
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            for sel in (self._rsel, self._wsel):
+                try:
+                    sel.close()
+                except OSError:
+                    pass
+            self._tls.close()
 
 
 class NetLoop:
@@ -183,6 +401,15 @@ class _Connection:
         #: other and permanently desync the frame stream (the
         #: long-standing intermittent mesh-never-connects flake)
         self._inbound = sock is not None
+        #: per-frame MAC state (PSK fabrics; None on open fabrics).
+        #: send side is touched only by the writer thread, recv side
+        #: only by the reader thread — no lock needed beyond the
+        #: handshake happens-before (keys are set before start()/
+        #: before the writer's send loop begins)
+        self.send_key: Optional[bytes] = None
+        self.recv_key: Optional[bytes] = None
+        self._send_seq = 0
+        self._recv_seq = 0
         self.closed = False
         self._queue: list = []
         self._queued_bytes = 0   # enqueued but not yet handed to the OS
@@ -280,7 +507,16 @@ class _Connection:
                 self._send_started = time.monotonic()
             try:
                 t0 = self._send_started
-                self.sock.sendall(_LEN.pack(len(frame)) + frame)
+                if self.send_key is not None:
+                    tag = _frame_tag(self.send_key, self._send_seq, frame)
+                    self._send_seq += 1
+                    # single-copy join: frame + tag then prefix + wire
+                    # would memcpy a 64 MiB chunk twice
+                    wire = b"".join((_LEN.pack(len(frame) + len(tag)),
+                                     frame, tag))
+                else:
+                    wire = _LEN.pack(len(frame)) + frame
+                self.sock.sendall(wire)
                 elapsed = time.monotonic() - t0
                 self.endpoint.bytes_sent += len(frame)
             except OSError:
@@ -304,21 +540,39 @@ class _Connection:
             host, port_s = self.remote_id.rsplit(":", 1)
             sock = socket.create_connection((host, int(port_s)),
                                             timeout=HANDSHAKE_TIMEOUT_S)
-            # one absolute deadline for the whole handshake: a
-            # byte-dribbling acceptor must not wedge the writer thread
+            # one absolute deadline for the whole handshake — TLS wrap
+            # included: a byte-dribbling acceptor must not wedge the
+            # writer thread
             deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S
+            ssl_ctx = self.endpoint.network.ssl_client_context
+            if ssl_ctx is not None:
+                # confidentiality wrap BEFORE any identity bytes; the
+                # PSK handshake + frame MACs run inside the channel
+                tls = _tls_wrap(sock, ssl_ctx, deadline,
+                                server_side=False, server_hostname=host)
+                if tls is None:
+                    return None  # _tls_wrap owns failure cleanup
+                sock = tls
             raw = self.endpoint.peer_id.encode()
             sock.sendall(_LEN.pack(len(raw)) + raw)
             psk = self.endpoint.network.psk
             if psk is not None:
-                # prove swarm membership before any protocol frame
-                nonce = _read_frame(sock, max_bytes=MAX_AUTH_BYTES,
-                                    deadline=deadline)
-                if nonce is None:
+                # prove swarm membership before any protocol frame;
+                # contribute our own nonce so the per-connection frame
+                # keys are fresh even if the acceptor's nonce repeats
+                c_nonce = os.urandom(NONCE_LEN)
+                sock.sendall(_LEN.pack(len(c_nonce)) + c_nonce)
+                a_nonce = _read_frame(sock, max_bytes=MAX_AUTH_BYTES,
+                                      deadline=deadline)
+                # exact-length check (see NONCE_LEN): a variable-length
+                # nonce makes the NUL-joined MAC/KDF input ambiguous
+                if a_nonce is None or len(a_nonce) != NONCE_LEN:
                     sock.close()
                     return None
-                mac = _psk_response(psk, nonce, raw)
+                mac = _psk_response(psk, a_nonce, c_nonce, raw)
                 sock.sendall(_LEN.pack(len(mac)) + mac)
+                c2a, a2c = _derive_frame_keys(psk, a_nonce, c_nonce, raw)
+                self.send_key, self.recv_key = c2a, a2c
             sock.settimeout(None)  # handshake timeout must not poison recv
             return sock
         except (OSError, ValueError):
@@ -547,6 +801,15 @@ class TcpEndpoint:
         # deadline: a connection that sends nothing — or dribbles one
         # byte per almost-timeout — must not pin this thread
         deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S
+        ssl_ctx = self.network.ssl_server_context
+        if ssl_ctx is not None:
+            # the TLS handshake runs on THIS per-handshake thread,
+            # under the same ABSOLUTE deadline as the identity bytes
+            # that follow — never on the accept loop
+            tls = _tls_wrap(sock, ssl_ctx, deadline, server_side=True)
+            if tls is None:
+                return  # _tls_wrap owns failure cleanup
+            sock = tls
         preamble = _read_frame(sock, max_bytes=self.MAX_PREAMBLE_BYTES,
                                deadline=deadline)
         if preamble is None:
@@ -576,30 +839,46 @@ class TcpEndpoint:
             sock.close()
             return
         psk = self.network.psk
+        frame_keys = None
         if psk is not None:
             # challenge-response (module docstring: trust model): the
             # claimed id is only believed once the connector proves it
             # holds the swarm PSK for THIS nonce
-            nonce = os.urandom(32)
+            a_nonce = os.urandom(NONCE_LEN)
             try:
-                sock.sendall(_LEN.pack(len(nonce)) + nonce)
+                sock.sendall(_LEN.pack(len(a_nonce)) + a_nonce)
             except OSError:
                 sock.close()
                 return
-            mac = _read_frame(sock, max_bytes=MAX_AUTH_BYTES,
-                              deadline=deadline)
+            c_nonce = _read_frame(sock, max_bytes=MAX_AUTH_BYTES,
+                                  deadline=deadline)
+            # exact-length check (see NONCE_LEN): a connector-chosen
+            # variable-length nonce could shift bytes between the
+            # nonce and claimed-id fields of the NUL-joined MAC/KDF
+            # input without changing it — the boundary-ambiguity
+            # splice an on-path attacker needs
+            if c_nonce is not None and len(c_nonce) != NONCE_LEN:
+                c_nonce = None
+            mac = (None if c_nonce is None else
+                   _read_frame(sock, max_bytes=MAX_AUTH_BYTES,
+                               deadline=deadline))
             if mac is None or not hmac.compare_digest(
-                    mac, _psk_response(psk, nonce, preamble)):
+                    mac, _psk_response(psk, a_nonce, c_nonce, preamble)):
                 log.warning("rejecting unauthenticated inbound claiming "
                             "%r from %s", remote_id, observed_host)
                 sock.close()
                 return
+            frame_keys = _derive_frame_keys(psk, a_nonce, c_nonce, preamble)
         try:
             sock.settimeout(None)  # handshake done; reads block freely
         except OSError:
             sock.close()
             return
         conn = _Connection(self, remote_id, sock)
+        if frame_keys is not None:
+            # acceptor sends on the a2c key, verifies on c2a — set
+            # before start() spawns the reader (happens-before)
+            conn.recv_key, conn.send_key = frame_keys
         victim = None
         with self._conn_lock:
             # a handshake racing close() must not register a fresh
@@ -639,11 +918,39 @@ class TcpEndpoint:
         conn.start()
 
     def _reader_loop(self, conn: _Connection) -> None:
+        # the tag rides INSIDE the length-prefixed record, so an
+        # authenticated link's wire records run up to tag-length past
+        # the payload cap — a max-size frame must stay deliverable on
+        # both fabrics
+        max_wire = MAX_FRAME_BYTES + (FRAME_MAC_LEN
+                                      if conn.recv_key is not None else 0)
         while not self.closed and not conn.closed:
-            frame = _read_frame(conn.sock)
+            frame = _read_frame(conn.sock, max_bytes=max_wire)
             if frame is None:
                 conn.close()
                 return
+            if conn.recv_key is not None:
+                # per-frame integrity (module docstring: trust model):
+                # strip + verify the tag against this direction's key
+                # and the expected sequence number.  Any mismatch —
+                # missing tag, forged tag, replayed/spliced frame —
+                # drops the connection, the same fail-closed
+                # discipline the wire decoder applies
+                if len(frame) < FRAME_MAC_LEN:
+                    log.warning("dropping %s: untagged frame on an "
+                                "authenticated link", conn.remote_id)
+                    conn.close()
+                    return
+                body, tag = frame[:-FRAME_MAC_LEN], frame[-FRAME_MAC_LEN:]
+                if not hmac.compare_digest(
+                        tag, _frame_tag(conn.recv_key, conn._recv_seq,
+                                        body)):
+                    log.warning("dropping %s: frame MAC mismatch "
+                                "(injection or splice?)", conn.remote_id)
+                    conn.close()
+                    return
+                conn._recv_seq += 1
+                frame = body
             conn.last_activity = time.monotonic()
             self.bytes_received += len(frame)
             src = conn.remote_id
@@ -707,16 +1014,28 @@ class TcpNetwork:
     def __init__(self, host: str = "127.0.0.1",
                  loop: Optional[NetLoop] = None,
                  verify_inbound_host: bool = True,
-                 psk: Optional[bytes] = None):
+                 psk: Optional[bytes] = None,
+                 ssl_server_context=None,
+                 ssl_client_context=None):
         self.host = host
         self._owns_loop = loop is None
         self.loop = loop or NetLoop()
         #: per-swarm pre-shared key: when set, every connection must
         #: pass the HMAC challenge-response before its claimed id is
-        #: believed (module docstring: trust model).  All peers of one
-        #: fabric must agree (mismatched sides fail the handshake and
-        #: the connection is dropped — fail closed).
+        #: believed, and every subsequent frame carries a sequence-
+        #: bound MAC under per-connection directional keys (module
+        #: docstring: trust model).  All peers of one fabric must
+        #: agree (mismatched sides fail the handshake and the
+        #: connection is dropped — fail closed).
         self.psk = psk
+        #: optional ``ssl.SSLContext`` pair for confidentiality: the
+        #: server context wraps accepted sockets, the client context
+        #: wraps outbound connects, both BEFORE any identity bytes.
+        #: Orthogonal to the PSK (which keeps authenticating swarm
+        #: membership inside the channel); both sides of a fabric
+        #: must agree, as with the PSK.
+        self.ssl_server_context = ssl_server_context
+        self.ssl_client_context = ssl_client_context
         #: reject inbound preambles whose claimed host doesn't resolve
         #: to the socket's observed remote address (module docstring:
         #: trust model).  Disable for NAT/multi-homed deployments where

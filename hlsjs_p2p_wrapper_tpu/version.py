@@ -10,7 +10,7 @@ define so the api test can exercise both paths the way
 
 import os
 
-__version__ = "0.4.1"
+__version__ = "0.5.0"
 
 
 def get_version() -> str:
