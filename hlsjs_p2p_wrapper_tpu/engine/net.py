@@ -692,14 +692,18 @@ class TcpEndpoint:
         CONN_IDLE_EVICT_S (returned for the caller to close OUTSIDE
         the lock — close() re-enters via _forget); every link busy →
         refuse.  See MAX_CONNECTIONS."""
-        total = len(self._conns) + len(self._extra_conns)
-        if total < self.MAX_CONNECTIONS:
+        # count only live links: a conn sets closed=True before its
+        # close() reaches _forget, and a replacement racing that
+        # window must not evict a healthy third party (or be refused)
+        # on account of a dead entry that is already on its way out
+        live = [c for c in list(self._conns.values()) + self._extra_conns
+                if not c.closed]
+        if len(live) < self.MAX_CONNECTIONS:
             return True, None
         now = time.monotonic()
         candidates = [
-            c for c in list(self._conns.values()) + self._extra_conns
-            if not c.closed
-            and now - c.last_activity >= self.CONN_IDLE_EVICT_S]
+            c for c in live
+            if now - c.last_activity >= self.CONN_IDLE_EVICT_S]
         if not candidates:
             return False, None
         victim = min(candidates, key=lambda c: c.last_activity)
@@ -981,8 +985,15 @@ class TcpEndpoint:
         except OSError:
             pass
         try:
-            wake = socket.create_connection(
-                self._listener.getsockname()[:2], timeout=1.0)
+            wake_host, wake_port = self._listener.getsockname()[:2]
+            if wake_host in ("0.0.0.0", "::"):
+                # a wildcard bind address is not dialable; the wake
+                # must target a concrete loopback or BSD/macOS
+                # (where shutdown doesn't wake accept) re-leaks the
+                # accept thread this self-connect exists to free
+                wake_host = "127.0.0.1" if wake_host == "0.0.0.0" else "::1"
+            wake = socket.create_connection((wake_host, wake_port),
+                                            timeout=1.0)
             wake.close()
         except OSError:
             pass  # already woken (Linux) or listener already dead

@@ -18,6 +18,9 @@ from __future__ import annotations
 import hashlib
 from typing import Callable, Dict, List, Optional, Tuple
 
+#: a member-attribution key: (swarm id, peer id)
+_MemberKey = Tuple[str, str]
+
 from ..core.clock import Clock
 from .protocol import Announce, Leave, Peers, ProtocolError, decode, encode
 from .transport import Endpoint
@@ -46,15 +49,25 @@ class Tracker:
     #: (the service stays up and existing members keep refreshing);
     #: slots free as leases expire.  Discovery only needs recency
     #: (max_peers_returned is 30), so the member cap is a discovery
-    #: working set, not an audience size.  RESIDUAL, documented: an
-    #: attacker who keeps refreshing capped-out state squats it for
-    #: as long as it keeps paying announces (first-come admission has
-    #: no eviction) — on a PSK fabric only key-holding members can
-    #: reach the tracker at all, and per-source quotas beyond that
-    #: are a deployment concern (the reference ran its tracker as a
-    #: closed backend service, SURVEY §2.4).
+    #: working set, not an audience size.
     MAX_SWARMS = 1_024
     MAX_MEMBERS_PER_SWARM = 2_048
+    #: per-SOURCE quotas (round-4 verdict weak #6: the global caps
+    #: alone let one paying announcer squat them all).  The source is
+    #: the transport-level sender identity the adapter observes —
+    #: on the TCP fabric an address-verified ``host:port``, quota-
+    #: keyed by HOST so one machine opening many ports stays one
+    #: bucket.  A source at its member quota evicts ITS OWN least-
+    #: recently-refreshed (swarm, peer) entry — the attacker hurts
+    #: only itself, and the global table keeps room for others.  A
+    #: source at its swarm-creation quota is refused new swarms
+    #: (refusal, not eviction: evicting an attacker-created swarm
+    #: would also kick innocent members who since joined it).
+    #: Deployment-tunable class attributes; generous for honest
+    #: clients (a NAT'd audience shares a host, but honest watchers
+    #: hold ONE membership each).
+    MAX_SWARM_CREATES_PER_SOURCE = 64
+    MAX_MEMBERS_PER_SOURCE = 256
     #: global expiry sweep cadence: sweeping every announce would make
     #: each announce O(total members) — the touched swarm is expired
     #: inline (bounded by the member cap); everything else on this
@@ -70,14 +83,35 @@ class Tracker:
         self._swarms: Dict[str, Dict[str, float]] = {}
         self.announce_count = 0
         self._last_sweep_ms = -1e18
+        # per-source quota state (see the quota class attributes):
+        # who created each live swarm, per-source creation counts,
+        # and each source's memberships in refresh order (dict
+        # insertion order IS the LRU — refresh reinserts at the end)
+        self._swarm_creator: Dict[str, str] = {}
+        self._creates_by_source: Dict[str, int] = {}
+        self._member_source: Dict[_MemberKey, str] = {}
+        self._members_by_source: Dict[str, Dict[_MemberKey, None]] = {}
+        self._last_forced_sweep_ms = -1e18
 
-    def announce(self, swarm_id: str, peer_id: str) -> List[str]:
+    @staticmethod
+    def _source_key(source: Optional[str]) -> Optional[str]:
+        """Quota bucket for a transport-level sender identity: the
+        HOST of a ``host:port`` id (one machine, many ports = one
+        bucket), the id itself otherwise."""
+        if source is None:
+            return None
+        return source.rsplit(":", 1)[0] if ":" in source else source
+
+    def announce(self, swarm_id: str, peer_id: str,
+                 source: Optional[str] = None) -> List[str]:
         """Join/refresh; returns current co-members (excluding self),
         most-recently-announced first, capped at
         ``max_peers_returned``.  At the state caps (MAX_SWARMS /
-        MAX_MEMBERS_PER_SWARM) a NEW swarm or member is answered but
-        not registered — refusal to remember is not refusal to
-        serve."""
+        MAX_MEMBERS_PER_SWARM / the per-``source`` quotas) a NEW
+        swarm or member is answered but not registered — refusal to
+        remember is not refusal to serve.  ``source`` is the
+        transport-level sender identity (the adapter passes it; the
+        un-sourced core API applies no per-source quotas)."""
         self.announce_count += 1
         now = self.clock.now()
         self._expire_swarms(now)
@@ -85,24 +119,122 @@ class Tracker:
         if swarm is not None:
             self._expire_members(swarm_id, swarm, now)
             swarm = self._swarms.get(swarm_id)
+        key = self._source_key(source)
         if swarm is None:
             if len(self._swarms) >= self.MAX_SWARMS:
-                return []
+                # before refusing, sweep past the throttle: swarms
+                # whose leases all expired between throttled sweeps
+                # must not hold slots against a live newcomer.  At
+                # most ONE forced sweep per EXPIRE_SWEEP_MS window —
+                # a refused-announce flood at the cap must not make
+                # every announce O(total members), the exact cost the
+                # throttle exists to amortize
+                if now - self._last_forced_sweep_ms \
+                        >= self.EXPIRE_SWEEP_MS:
+                    self._last_forced_sweep_ms = now
+                    self._last_sweep_ms = -1e18
+                    self._expire_swarms(now)
+                if len(self._swarms) >= self.MAX_SWARMS:
+                    return []
+            if key is not None and self._creates_by_source.get(key, 0) \
+                    >= self.MAX_SWARM_CREATES_PER_SOURCE:
+                return []  # this source's creation quota is spent
             swarm = self._swarms[swarm_id] = {}
+            if key is not None:
+                self._swarm_creator[swarm_id] = key
+                self._creates_by_source[key] = \
+                    self._creates_by_source.get(key, 0) + 1
         known = swarm.pop(peer_id, None) is not None
         if known or len(swarm) < self.MAX_MEMBERS_PER_SWARM:
+            if key is not None:
+                self._attribute_member(swarm_id, peer_id, key)
             # re-insert to refresh both lease and recency order
             swarm[peer_id] = now + self.lease_ms
         others = [p for p in swarm if p != peer_id]
         others.reverse()
         return others[: self.max_peers_returned]
 
-    def leave(self, swarm_id: str, peer_id: str) -> None:
-        swarm = self._swarms.get(swarm_id)
+    def _attribute_member(self, swarm_id: str, peer_id: str,
+                          key: str) -> None:
+        """Charge ``(swarm_id, peer_id)`` to source ``key``, evicting
+        the source's own least-recently-refreshed membership at its
+        quota — one squatter can fill only its own bucket, never the
+        global table."""
+        mkey = (swarm_id, peer_id)
+        prior = self._member_source.get(mkey)
+        if prior is not None and prior != key:
+            # FIRST attribution wins while the membership lives: the
+            # ANNOUNCE body's peer id is unauthenticated, so letting a
+            # different source re-charge an existing membership to its
+            # own bucket would let an attacker adopt victims'
+            # memberships and then evict them via its own LRU — the
+            # exact cross-source denial the quotas exist to stop.  A
+            # peer that genuinely moves hosts re-attributes when its
+            # old lease expires.
+            return
+        bucket = self._members_by_source.setdefault(key, {})
+        if mkey not in bucket and len(bucket) >= self.MAX_MEMBERS_PER_SOURCE:
+            victim_swarm, victim_peer = next(iter(bucket))
+            self._remove_member_attribution(victim_swarm, victim_peer)
+            vswarm = self._swarms.get(victim_swarm)
+            if vswarm is not None:
+                vswarm.pop(victim_peer, None)
+                # never drop the swarm being announced INTO, even if
+                # the victim was its last member — the caller is about
+                # to insert into the dict it holds a reference to
+                if not vswarm and victim_swarm != swarm_id:
+                    self._drop_swarm(victim_swarm)
+            bucket = self._members_by_source.setdefault(key, {})
+        bucket.pop(mkey, None)  # refresh = reinsert at the LRU tail
+        bucket[mkey] = None
+        self._member_source[mkey] = key
+
+    def _remove_member_attribution(self, swarm_id: str,
+                                   peer_id: str) -> None:
+        mkey = (swarm_id, peer_id)
+        src = self._member_source.pop(mkey, None)
+        if src is not None:
+            bucket = self._members_by_source.get(src)
+            if bucket is not None:
+                bucket.pop(mkey, None)
+                if not bucket:
+                    del self._members_by_source[src]
+
+    def _drop_swarm(self, swarm_id: str) -> None:
+        """Remove a swarm and every quota attribution hanging off it
+        (members AND the creator's creation charge) — quota state
+        must never outlive the state it charges for."""
+        swarm = self._swarms.pop(swarm_id, None)
         if swarm:
-            swarm.pop(peer_id, None)
-            if not swarm:
-                del self._swarms[swarm_id]
+            for peer_id in list(swarm):
+                self._remove_member_attribution(swarm_id, peer_id)
+        creator = self._swarm_creator.pop(swarm_id, None)
+        if creator is not None:
+            n = self._creates_by_source.get(creator, 0) - 1
+            if n > 0:
+                self._creates_by_source[creator] = n
+            else:
+                self._creates_by_source.pop(creator, None)
+
+    def leave(self, swarm_id: str, peer_id: str,
+              source: Optional[str] = None) -> None:
+        """Remove a membership.  With a ``source``, only the source
+        that OWNS the membership's attribution may remove it — the
+        LEAVE body's peer id is as unauthenticated as ANNOUNCE's, and
+        without this check any sender could deny any member for free
+        (cheaper than the squatting the quotas close).  The un-sourced
+        core API (operator use) removes unconditionally."""
+        swarm = self._swarms.get(swarm_id)
+        if not swarm or peer_id not in swarm:
+            return
+        if source is not None:
+            owner = self._member_source.get((swarm_id, peer_id))
+            if owner is not None and owner != self._source_key(source):
+                return  # not yours to remove
+        swarm.pop(peer_id, None)
+        self._remove_member_attribution(swarm_id, peer_id)
+        if not swarm:
+            self._drop_swarm(swarm_id)
 
     def members(self, swarm_id: str) -> List[str]:
         now = self.clock.now()
@@ -120,8 +252,9 @@ class Tracker:
         while holding dead leases."""
         for peer_id in [p for p, exp in swarm.items() if exp <= now]:
             del swarm[peer_id]
+            self._remove_member_attribution(swarm_id, peer_id)
         if not swarm:
-            del self._swarms[swarm_id]
+            self._drop_swarm(swarm_id)
 
     def _expire_swarms(self, now: float) -> None:
         """Drop expired leases AND emptied swarms — a long-lived
@@ -135,8 +268,9 @@ class Tracker:
             swarm = self._swarms[swarm_id]
             for peer_id in [p for p, exp in swarm.items() if exp <= now]:
                 del swarm[peer_id]
+                self._remove_member_attribution(swarm_id, peer_id)
             if not swarm:
-                del self._swarms[swarm_id]
+                self._drop_swarm(swarm_id)
 
 
 class TrackerEndpoint:
@@ -155,11 +289,16 @@ class TrackerEndpoint:
             # one malformed peer must not take down the shared service
             return
         if isinstance(msg, Announce):
-            peers = self.tracker.announce(msg.swarm_id, msg.peer_id)
+            # the transport-level sender identity is the quota source:
+            # on the TCP fabric it is address-verified (engine/net.py
+            # trust model), so quota buckets cannot be minted by
+            # claiming fresh ids in the ANNOUNCE body
+            peers = self.tracker.announce(msg.swarm_id, msg.peer_id,
+                                          source=src_id)
             self.endpoint.send(src_id,
                                encode(Peers(msg.swarm_id, tuple(peers))))
         elif isinstance(msg, Leave):
-            self.tracker.leave(msg.swarm_id, msg.peer_id)
+            self.tracker.leave(msg.swarm_id, msg.peer_id, source=src_id)
 
 
 class TrackerClient:

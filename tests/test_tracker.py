@@ -206,3 +206,174 @@ def test_swarm_cap_refuses_new_swarms():
         assert tracker.members("s3") == ["p"]
     finally:
         Tracker.MAX_SWARMS = orig
+
+
+def test_per_source_swarm_creation_quota():
+    """One source cannot squat MAX_SWARMS: its creations cap at
+    MAX_SWARM_CREATES_PER_SOURCE (quota-keyed by HOST, so minting
+    ports does not mint buckets), while other sources keep their
+    full capacity."""
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=10_000.0)
+    orig = Tracker.MAX_SWARM_CREATES_PER_SOURCE
+    Tracker.MAX_SWARM_CREATES_PER_SOURCE = 3
+    try:
+        for i in range(10):
+            tracker.announce(f"s{i}", f"p{i}", source="10.0.0.9:4444")
+        assert len(tracker._swarms) == 3  # quota, not MAX_SWARMS
+        # minting a new port on the same host buys nothing
+        tracker.announce("s-port", "p", source="10.0.0.9:5555")
+        assert "s-port" not in tracker._swarms
+        # a different source still has full capacity
+        tracker.announce("fresh", "victim", source="10.0.0.7:1111")
+        assert tracker.members("fresh") == ["victim"]
+        # refused creators can still JOIN existing swarms (the quota
+        # binds creation, not membership)
+        tracker.announce("fresh", "p-late", source="10.0.0.9:6666")
+        assert "p-late" in tracker.members("fresh")
+    finally:
+        Tracker.MAX_SWARM_CREATES_PER_SOURCE = orig
+
+
+def test_per_source_member_quota_evicts_own_lru():
+    """A member-minting source fills only its OWN bucket: at
+    MAX_MEMBERS_PER_SOURCE its least-recently-refreshed membership
+    is evicted, and other sources' members are untouched."""
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=10_000.0)
+    orig = Tracker.MAX_MEMBERS_PER_SOURCE
+    Tracker.MAX_MEMBERS_PER_SOURCE = 3
+    try:
+        tracker.announce("s", "honest", source="10.0.0.7:1")
+        for i in range(6):
+            tracker.announce("s", f"mint{i}", source="10.0.0.9:1")
+        members = tracker.members("s")
+        assert "honest" in members            # bystander untouched
+        assert len(members) == 4              # honest + 3-quota
+        assert "mint0" not in members         # LRU evicted
+        assert {"mint3", "mint4", "mint5"} <= set(members)
+        # refreshing moves an entry off the LRU head
+        tracker.announce("s2", "a", source="10.0.0.5:1")
+        tracker.announce("s2", "b", source="10.0.0.5:1")
+        tracker.announce("s2", "c", source="10.0.0.5:1")
+        tracker.announce("s2", "a", source="10.0.0.5:1")  # refresh a
+        tracker.announce("s2", "d", source="10.0.0.5:1")  # evicts b
+        assert set(tracker.members("s2")) == {"a", "c", "d"}
+    finally:
+        Tracker.MAX_MEMBERS_PER_SOURCE = orig
+
+
+def test_source_quotas_release_with_state():
+    """Quota charges die with the state they charge for: lease
+    expiry, LEAVE, and swarm death all refund the source."""
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=1_000.0)
+    orig = Tracker.MAX_SWARM_CREATES_PER_SOURCE
+    Tracker.MAX_SWARM_CREATES_PER_SOURCE = 2
+    try:
+        tracker.announce("s1", "p", source="10.0.0.9:1")
+        tracker.announce("s2", "p", source="10.0.0.9:1")
+        tracker.announce("s3", "p", source="10.0.0.9:1")  # refused
+        assert "s3" not in tracker._swarms
+        # LEAVE empties s1 -> its creation charge refunds
+        tracker.leave("s1", "p")
+        tracker.announce("s3", "p", source="10.0.0.9:1")
+        assert tracker.members("s3") == ["p"]
+        # expiry refunds the rest; the bookkeeping empties fully
+        clock.advance(Tracker.EXPIRE_SWEEP_MS + 2_000.0)
+        tracker.announce("poke", "p", source="10.0.0.1:1")  # trigger sweep
+        assert tracker._creates_by_source == {"10.0.0.1": 1}
+        assert list(tracker._member_source) == [("poke", "p")]
+        assert list(tracker._swarm_creator) == ["poke"]
+    finally:
+        Tracker.MAX_SWARM_CREATES_PER_SOURCE = orig
+
+
+def test_swarm_cap_sweeps_dead_state_before_refusing():
+    """ADVICE r4: at MAX_SWARMS the refusal must not count swarms
+    whose leases all expired between throttled sweeps — the sweep
+    runs unthrottled before a newcomer is turned away."""
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=100.0)
+    orig = Tracker.MAX_SWARMS
+    Tracker.MAX_SWARMS = 2
+    try:
+        tracker.announce("s1", "p")
+        tracker.announce("s2", "p")
+        # expire the leases but stay INSIDE the throttled-sweep
+        # window, so the dead swarms are still in the table
+        clock.advance(150.0)
+        assert len(tracker._swarms) == 2
+        tracker.announce("s3", "p")  # must sweep, then admit
+        assert tracker.members("s3") == ["p"]
+    finally:
+        Tracker.MAX_SWARMS = orig
+
+
+def test_cross_source_member_adoption_blocked():
+    """An ANNOUNCE body's peer id is unauthenticated, so a different
+    source re-announcing an existing membership must NOT adopt it
+    into its own quota bucket — else the attacker evicts the victim
+    via its own LRU (cross-source denial through re-attribution)."""
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=60_000.0)
+    orig = Tracker.MAX_MEMBERS_PER_SOURCE
+    Tracker.MAX_MEMBERS_PER_SOURCE = 3
+    try:
+        tracker.announce("s", "victim", source="10.0.0.7:1")
+        # attacker "adopts" the victim's membership...
+        tracker.announce("s", "victim", source="10.0.0.9:1")
+        # ...then floods its own bucket to push the LRU head out
+        for i in range(5):
+            tracker.announce("s", f"mint{i}", source="10.0.0.9:1")
+        assert "victim" in tracker.members("s")  # survived
+        assert tracker._member_source[("s", "victim")] == "10.0.0.7"
+    finally:
+        Tracker.MAX_MEMBERS_PER_SOURCE = orig
+
+
+def test_foreign_leave_ignored():
+    """A LEAVE for a membership another source owns is ignored — the
+    body's peer id is unauthenticated and member removal must not be
+    free for arbitrary senders.  The owner's LEAVE (and the
+    un-sourced operator API) still work."""
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=60_000.0)
+    tracker.announce("s", "victim", source="10.0.0.7:1")
+    tracker.leave("s", "victim", source="10.0.0.9:1")   # foreign: no-op
+    assert tracker.members("s") == ["victim"]
+    tracker.leave("s", "victim", source="10.0.0.7:2")   # owner host
+    assert tracker.members("s") == []
+    tracker.announce("s", "victim", source="10.0.0.7:1")
+    tracker.leave("s", "victim")                        # operator API
+    assert tracker.members("s") == []
+
+
+def test_forced_sweep_throttled_at_cap():
+    """A refused-announce flood at MAX_SWARMS must not make every
+    announce O(total members): the forced pre-refusal sweep runs at
+    most once per EXPIRE_SWEEP_MS window."""
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=60_000.0)
+    orig = Tracker.MAX_SWARMS
+    Tracker.MAX_SWARMS = 2
+    try:
+        tracker.announce("s1", "p")
+        tracker.announce("s2", "p")
+        sweeps = []
+        real = tracker._expire_swarms
+
+        def counting(now):
+            before = tracker._last_sweep_ms
+            real(now)
+            if tracker._last_sweep_ms != before:
+                sweeps.append(now)  # the sweep actually EXECUTED
+
+        tracker._expire_swarms = counting
+        for _ in range(10):  # flood inside one window; leases live
+            tracker.announce("mint", "p")
+        # one regular throttled sweep + at most one forced re-run;
+        # the other 9 refusals must not pay the O(members) walk
+        assert len(sweeps) <= 2, sweeps
+    finally:
+        Tracker.MAX_SWARMS = orig
