@@ -18,12 +18,12 @@ from __future__ import annotations
 import hashlib
 from typing import Callable, Dict, List, Optional, Tuple
 
-#: a member-attribution key: (swarm id, peer id)
-_MemberKey = Tuple[str, str]
-
 from ..core.clock import Clock
 from .protocol import Announce, Leave, Peers, ProtocolError, decode, encode
 from .transport import Endpoint
+
+#: a member-attribution key: (swarm id, peer id)
+_MemberKey = Tuple[str, str]
 
 TRACKER_PEER_ID = "tracker"
 DEFAULT_LEASE_MS = 30_000.0
@@ -144,6 +144,18 @@ class Tracker:
                 self._swarm_creator[swarm_id] = key
                 self._creates_by_source[key] = \
                     self._creates_by_source.get(key, 0) + 1
+        if key is not None and peer_id in swarm:
+            owner = self._member_source.get((swarm_id, peer_id))
+            if owner is not None and owner != key:
+                # a membership another source owns: answer the peer
+                # list but touch NOTHING — refreshing the lease or
+                # recency here would let an attacker keep a crashed
+                # victim alive at the head of discovery forever (and
+                # at zero quota cost).  The announce bodies are
+                # unauthenticated, so ownership is the only signal.
+                others = [p for p in swarm if p != peer_id]
+                others.reverse()
+                return others[: self.max_peers_returned]
         known = swarm.pop(peer_id, None) is not None
         if known or len(swarm) < self.MAX_MEMBERS_PER_SWARM:
             if key is not None:

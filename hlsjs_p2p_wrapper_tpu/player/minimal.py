@@ -22,9 +22,12 @@ contract allows —
 - fragments handed to the loader as **plain dicts** — the loader
   contract tolerates dict or attribute access (core/loader.py _attr)
   and this player exercises the dict half
-- a coarser scheduler tick, no seek, no redundant-stream rotation,
-  no live-window resync (VOD + static-window focus; ``details.live``
-  passes through for the bridge's tri-state)
+- a coarser scheduler tick; seek, redundant-stream rotation, and
+  live-window resync exist in their SIMPLEST contract-honoring form
+  (round-5 contract obligations 9-11), each shaped differently from
+  SimPlayer's: seek keeps the segment store (no buffer flush), the
+  rotation counter never resets, and live playback is
+  segment-quantized off the same stall rule as VOD
 
 The contract itself is executable: ``testing/player_contract.py``
 runs the same assertions against ANY media engine, and the swarm
@@ -123,6 +126,7 @@ class MinimalPlayer(EventEmitter):
         self._loading_sn: Optional[int] = None
         self._loader = None
         self._timer = None
+        self._rotations = 0          # redundant-URL switches (never reset)
 
     # -- app surface ---------------------------------------------------
     def load_source(self, url: str) -> None:
@@ -142,6 +146,8 @@ class MinimalPlayer(EventEmitter):
             self._level = min(self._level, len(manifest.levels) - 1)
             self.levels = [_LevelView(spec, manifest.live)
                            for spec in manifest.levels]
+            if self.media is not None and self._live():
+                self._live_resync()  # media attached first: jump now
             self.emit(self.Events.MANIFEST_PARSED,
                       {"levels": len(self.levels)})
 
@@ -149,6 +155,8 @@ class MinimalPlayer(EventEmitter):
 
     def attach_media(self) -> None:
         self.media = _Media()
+        if self.levels is not None and self._live():
+            self._live_resync()  # join near the live edge
         self.emit(self.Events.MEDIA_ATTACHING, {})
         self._arm()
 
@@ -160,6 +168,19 @@ class MinimalPlayer(EventEmitter):
         self._level = index
         self.emit(self.Events.LEVEL_SWITCH, {"level": index})
 
+    def seek(self, t: float) -> None:
+        """Move the playhead (contract obligation 9): the in-flight
+        request is aborted and the next tick fetches at the new
+        position.  Unlike SimPlayer there is no buffer to flush —
+        the segment store keeps everything already fetched."""
+        if self.media is None:
+            raise RuntimeError("seek before attach_media")
+        self._abort_inflight()
+        self.media.current_time = t
+        frags = self._frags() if self.levels is not None else []
+        self.ended = bool(frags) and t >= frags[-1].start + \
+            frags[-1].duration and not self._live()
+
     def destroy(self) -> None:
         if self.destroyed:
             return
@@ -167,9 +188,27 @@ class MinimalPlayer(EventEmitter):
         self.destroyed = True
         if self._timer is not None:
             self._timer.cancel()
+        self._abort_inflight()
+
+    def _abort_inflight(self) -> None:
         if self._loader is not None:
             self._loader.abort()
             self._loader = None
+        self._loading_sn = None
+
+    def _live(self) -> bool:
+        return bool(self._manifest is not None and self._manifest.live)
+
+    def _live_resync(self) -> None:
+        """Jump to the live sync position (window end minus three
+        segments, clamped into the window) — the contract's sliding-
+        window obligation in its simplest form."""
+        frags = self._frags()
+        if not frags:
+            return
+        edge = frags[-1].start + frags[-1].duration
+        target = max(frags[0].start, edge - 3 * frags[-1].duration)
+        self.media.current_time = max(self.media.current_time, target)
 
     # -- scheduler -----------------------------------------------------
     def _arm(self) -> None:
@@ -199,8 +238,15 @@ class MinimalPlayer(EventEmitter):
         current = next((f for f in frags
                         if f.start <= t < f.start + f.duration), None)
         if current is None:
-            self.ended = self.ended or (t >= frags[-1].start
-                                        + frags[-1].duration)
+            if self._live():
+                if t < frags[0].start:
+                    # fell out of the sliding window: jump back in
+                    # (contract obligation 10); ahead-of-edge seeks
+                    # simply wait for the window to catch up
+                    self._live_resync()
+            else:
+                self.ended = self.ended or (t >= frags[-1].start
+                                            + frags[-1].duration)
             return
         if self._have.get(current.sn):
             self.media.current_time = t + TICK_MS / 1000.0
@@ -251,8 +297,10 @@ class MinimalPlayer(EventEmitter):
         self._loader.load(
             target.url_for(level.url_id), "arraybuffer",
             lambda event, stats, sn=target.sn: self._on_loaded(sn, event),
-            lambda event, sn=target.sn: self._on_error(sn, event),
-            lambda event, stats, sn=target.sn: self._on_error(sn, event),
+            lambda event, sn=target.sn, lvl=self._level:
+                self._on_error(sn, event, lvl),
+            lambda event, stats, sn=target.sn, lvl=self._level:
+                self._on_error(sn, event, lvl),
             self.config["frag_load_timeout"],
             self.config["frag_load_max_retry"],
             self.config["frag_load_retry_delay"],
@@ -269,12 +317,34 @@ class MinimalPlayer(EventEmitter):
             self._have[sn] = True
             self.frags_loaded += 1
 
-    def _on_error(self, sn: int, event) -> None:
+    def _on_error(self, sn: int, event, level_index: int = 0) -> None:
         if self.destroyed:
             return
         self._loading_sn = None
         self._loader = None
         self.last_error = event
+        # rotate the level the FAILED REQUEST was issued on (bound at
+        # request time), not whatever level is current now — an app-
+        # driven set_level between request and failure must not burn
+        # the rotation budget on an innocent level's backup
+        level = (self.levels[level_index]
+                 if self.levels is not None else None)
+        if (level is not None and len(level.url) > 1
+                and self._rotations < len(level.url) - 1):
+            # redundant-stream failover (contract obligation 11, the
+            # hls.js behavior media-map.js:60-73 depends on): rotate
+            # to the backup URL and refetch the same sn.  url_id is
+            # track identity, so the rotation is announced.  The
+            # counter never resets — a deliberately different shape
+            # from SimPlayer's per-run counter the contract must
+            # tolerate.
+            self._rotations += 1
+            level.url_id = (level.url_id + 1) % len(level.url)
+            self.emit(self.Events.ERROR,
+                      {"type": "networkError", "details": "fragLoadError",
+                       "fatal": False, "frag": {"sn": sn}, "event": event})
+            self.emit(self.Events.LEVEL_SWITCH, {"level": level_index})
+            return  # next tick refetches this sn from the backup
         self.emit(self.Events.ERROR,
                   {"type": "networkError", "details": "fragLoadError",
                    "fatal": True, "frag": {"sn": sn}, "event": event})

@@ -33,6 +33,26 @@ What the contract requires of a player class:
     progress: ``media.current_time`` advances once content arrives.
 7.  A terminal loader error surfaces as the player's ERROR event.
 8.  ``destroy()`` emits DESTROYING (the session's dispose hook).
+
+Round-5 obligations — the seams that historically broke in the
+reference (CHANGELOG.md:20-22 redundant streams, :76,95-96,146-147
+seek/retry races; the seek e2e at test/html/bundle.js:56-78):
+
+9.  ``seek(t)`` aborts the in-flight fragment request, the next
+    request covers the seek target, and playback progresses from
+    there once content arrives.
+10. On a LIVE manifest whose window slid past the player's position
+    (driven by :class:`~..player.manifest.LiveFeeder` while the
+    loader blackouts), the player resyncs: requests land inside the
+    current window and the playhead re-enters it.
+11. A fragment failure on a level with redundant streams rotates
+    ``url_id`` and refetches the SAME sn from the backup URL before
+    any fatal error; the rotation is announced via LEVEL_SWITCH
+    (url_id is track identity — the agent re-reads it there).
+12. Buffer steering through the bridge
+    (``PlayerInterface.set_buffer_margin_live``) binds at runtime:
+    fetching pauses once the buffered margin is reached and resumes
+    when the margin is raised.
 """
 
 from __future__ import annotations
@@ -46,10 +66,14 @@ from ..player.manifest import make_vod_manifest
 
 class RecordingLoader:
     """Captures fLoader instantiations + load() calls; the kit
-    completes or fails them by script."""
+    completes, fails, or HOLDS them by script (``hold_next`` leaves
+    the request in flight so seek-abort behavior is observable;
+    ``fail_all`` blackouts every request until cleared)."""
 
     calls: list = []
     fail_next = False
+    fail_all = False
+    hold_next = False
 
     def __init__(self, config):
         self.config = config
@@ -62,7 +86,10 @@ class RecordingLoader:
              "on_success": on_success, "on_error": on_error,
              "on_progress": on_progress, "timeout": timeout,
              "max_retry": max_retry, "retry_delay": retry_delay})
-        if RecordingLoader.fail_next:
+        if RecordingLoader.hold_next:
+            RecordingLoader.hold_next = False
+            return  # in flight until the player aborts (or forever)
+        if RecordingLoader.fail_all or RecordingLoader.fail_next:
             RecordingLoader.fail_next = False
             on_error({"target": {"status": 404}})
             return
@@ -171,3 +198,168 @@ def run_player_contract(player_cls) -> None:
     player.destroy()
     assert "DESTROYING" in seen, \
         "contract 8: destroy() must emit DESTROYING"
+
+    # round-5 obligations, each on a fresh player (module docstring)
+    _check_seek(player_cls)
+    _check_live_window_resync(player_cls)
+    _check_redundant_url_rotation(player_cls)
+    _check_buffer_steering(player_cls)
+
+
+def _fresh_player(player_cls, manifest, **config):
+    """A playing player over ``manifest`` with a clean RecordingLoader
+    ledger; returns ``(player, clock)``."""
+    clock = VirtualClock()
+    RecordingLoader.calls = []
+    RecordingLoader.fail_next = False
+    RecordingLoader.fail_all = False
+    RecordingLoader.hold_next = False
+    player = player_cls({"clock": clock, "manifest": manifest,
+                         "f_loader": RecordingLoader,
+                         "max_buffer_length": 30, **config})
+    player.load_source("http://origin.example/master.m3u8")
+    player.attach_media()
+    clock.advance(1_000.0)
+    return player, clock
+
+
+def _check_seek(player_cls) -> None:
+    """Obligation 9: seek aborts the in-flight request, re-requests at
+    the target, and playback progresses from there."""
+    manifest = make_vod_manifest(level_bitrates=(300_000,),
+                                 frag_count=40, seg_duration=4.0)
+    player, clock = _fresh_player(player_cls, manifest)
+    clock.advance(2_000.0)
+    assert RecordingLoader.calls, "contract 9: player never started loading"
+    # park a request in flight, then seek far past it
+    RecordingLoader.hold_next = True
+    clock.advance(5_000.0)
+    held = RecordingLoader.calls[-1]["loader"]
+    before = len(RecordingLoader.calls)
+    player.seek(100.0)
+    clock.advance(3_000.0)
+    assert held.aborted, \
+        "contract 9: seek must abort the in-flight fragment request"
+    fresh = RecordingLoader.calls[before:]
+    assert fresh, "contract 9: seek must trigger a re-request"
+    first = fresh[0]["frag"]
+    start = _attr(first, "start")
+    assert start is not None and 100.0 - 4.0 < start <= 100.0 + 4.0, \
+        f"contract 9: first post-seek request must cover the seek " \
+        f"target (got start={start})"
+    clock.advance(10_000.0)
+    assert player.media.current_time > 100.0, \
+        "contract 9: playback must progress from the seek point"
+    player.destroy()
+
+
+def _check_live_window_resync(player_cls) -> None:
+    """Obligation 10: a live player whose position fell out of the
+    sliding window resyncs into the current window."""
+    from ..player.manifest import LiveFeeder, make_live_manifest
+    manifest = make_live_manifest(level_bitrates=(300_000,),
+                                  window_count=6, seg_duration=4.0,
+                                  first_sn=100)
+    player, clock = _fresh_player(player_cls, manifest)
+    feeder = LiveFeeder(manifest, clock)
+    feeder.start()
+    clock.advance(3_000.0)
+    assert RecordingLoader.calls, "contract 10: live player never loaded"
+    # blackout: every request fails while the window keeps sliding
+    # far past anything the player ever buffered
+    RecordingLoader.fail_all = True
+    clock.advance(120_000.0)
+    RecordingLoader.fail_all = False
+    before = len(RecordingLoader.calls)
+    # snapshot the window BEFORE the observation period: it keeps
+    # sliding underneath, so requests are judged against the oldest
+    # window they could legitimately target
+    window_start = manifest.levels[0].fragments[0].start
+    clock.advance(6_000.0)
+    fresh = RecordingLoader.calls[before:]
+    assert fresh, "contract 10: player stopped requesting after blackout"
+    for call in fresh:
+        start = _attr(call["frag"], "start")
+        assert start is not None and start >= window_start - 4.0, \
+            f"contract 10: post-blackout request at start={start} is " \
+            f"outside the live window (window started {window_start})"
+    assert player.media.current_time >= window_start - 4.0, \
+        "contract 10: the playhead must re-enter the live window"
+    feeder.stop()
+    player.destroy()
+
+
+def _check_redundant_url_rotation(player_cls) -> None:
+    """Obligation 11: a fragment failure on a redundant level rotates
+    url_id, announces the rotation, and refetches the SAME sn from
+    the backup before any fatal error."""
+    manifest = make_vod_manifest(level_bitrates=(300_000,),
+                                 frag_count=30, seg_duration=4.0,
+                                 redundant=True)
+    # small buffer bound so fetches keep flowing (a full buffer would
+    # leave the armed failure waiting until the playhead drains it)
+    player, clock = _fresh_player(player_cls, manifest,
+                                  max_buffer_length=8)
+    clock.advance(2_000.0)
+    assert RecordingLoader.calls, "contract 11: player never started"
+    switches: list = []
+    fatals: list = []
+    player.on(player_cls.Events.LEVEL_SWITCH,
+              lambda data=None: switches.append(data))
+    player.on(player_cls.Events.ERROR,
+              lambda data=None: (isinstance(data, dict)
+                                 and data.get("fatal")) and
+              fatals.append(data))
+    before = len(RecordingLoader.calls)
+    RecordingLoader.fail_next = True
+    clock.advance(8_000.0)
+    new_calls = RecordingLoader.calls[before:]
+    assert new_calls, "contract 11: nothing was requested to fail"
+    failed_call = new_calls[0]
+    failed_sn = _attr(failed_call["frag"], "sn")
+    retries = [c for c in RecordingLoader.calls[before + 1:]
+               if _attr(c["frag"], "sn") == failed_sn]
+    assert retries, \
+        "contract 11: the failed sn must be refetched from the backup"
+    assert retries[0]["url"] != failed_call["url"], \
+        "contract 11: the refetch must use a DIFFERENT (backup) URL"
+    assert not fatals, \
+        "contract 11: rotation must pre-empt the fatal error surface"
+    assert switches, \
+        "contract 11: the url_id rotation must be announced via " \
+        "LEVEL_SWITCH (url_id is track identity)"
+    level = player.levels[_attr(failed_call["frag"], "level") or 0]
+    assert level.url_id != 0, \
+        "contract 11: level.url_id must reflect the rotation"
+    clock.advance(5_000.0)
+    assert player.media.current_time > 0.5, \
+        "contract 11: playback must continue on the backup stream"
+    player.destroy()
+
+
+def _check_buffer_steering(player_cls) -> None:
+    """Obligation 12: set_buffer_margin_live through the bridge binds
+    at runtime — fetching pauses at the margin, resumes when raised."""
+    from ..core.player_interface import PlayerInterface
+    manifest = make_vod_manifest(level_bitrates=(300_000,),
+                                 frag_count=60, seg_duration=4.0)
+    player, clock = _fresh_player(player_cls, manifest)
+    bridge = PlayerInterface(player, player_cls.Events, lambda: None)
+    bridge.set_buffer_margin_live(8.0)
+    assert bridge.get_buffer_level_max() == 8.0, \
+        "contract 12: the bridge must read back the steered margin"
+    clock.advance(20_000.0)
+    # the playhead moves ~20 s; with an 8 s margin the player may buffer
+    # at most playhead + margin + one segment of slack
+    t = player.media.current_time
+    highest = max(_attr(c["frag"], "start") or 0.0
+                  for c in RecordingLoader.calls)
+    assert highest <= t + 8.0 + 4.0 + 0.5, \
+        f"contract 12: with margin 8 the player fetched {highest:.1f}s " \
+        f"while playing at {t:.1f}s — steering did not bind"
+    before = len(RecordingLoader.calls)
+    bridge.set_buffer_margin_live(24.0)
+    clock.advance(4_000.0)
+    assert len(RecordingLoader.calls) > before, \
+        "contract 12: raising the margin must resume fetching"
+    player.destroy()

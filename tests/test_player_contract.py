@@ -102,3 +102,31 @@ def test_minimal_player_error_and_guard_paths():
     player.destroy()
     player.destroy()
     assert len(destroying) == 1 and player.destroyed
+
+
+def test_mixed_swarm_mid_stream_seek():
+    """Contract obligation 9 in the FULL stack: players of both
+    engines seek mid-stream while the swarm runs — the in-flight
+    request aborts through the real P2PLoader, re-requests flow
+    through the agent (backward seeks hit the peer's own cache), and
+    every player still finishes the stream."""
+    swarm = SwarmHarness(seg_duration=4.0, frag_count=20,
+                         level_bitrates=(800_000,),
+                         cdn_bandwidth_bps=8_000_000.0)
+    kinds = [SimPlayer, MinimalPlayer, SimPlayer, MinimalPlayer]
+    for i, cls in enumerate(kinds):
+        swarm.add_peer(f"p{i}", uplink_bps=10_000_000.0,
+                       player_class=cls)
+    swarm.run(12_000.0)
+    # forward seek past anything buffered, one player of EACH engine
+    swarm.peers[2].player.seek(48.0)
+    swarm.peers[3].player.seek(48.0)
+    # backward seek on the seeder: re-requests hit its own agent cache
+    swarm.peers[0].player.seek(0.0)
+    swarm.run(6_000.0)
+    assert swarm.peers[2].position_s >= 48.0, "SimPlayer seek stalled"
+    assert swarm.peers[3].position_s >= 48.0, "MinimalPlayer seek stalled"
+    assert swarm.run_until_all_finished()
+    assert swarm.offload_ratio > 0.2
+    for peer in swarm.peers:
+        assert peer.stats["p2p"] + peer.stats["cdn"] > 0

@@ -377,3 +377,31 @@ def test_forced_sweep_throttled_at_cap():
         assert len(sweeps) <= 2, sweeps
     finally:
         Tracker.MAX_SWARMS = orig
+
+
+def test_foreign_announce_cannot_refresh_others_lease():
+    """Blocking re-attribution is not enough: a foreign ANNOUNCE must
+    not refresh the lease or recency of a membership another source
+    owns, or an attacker could keep a crashed victim at the head of
+    discovery forever at zero quota cost."""
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=1_000.0)
+    tracker.announce("s", "victim", source="10.0.0.7:1")
+    tracker.announce("s", "other", source="10.0.0.5:1")
+    # attacker re-announces the victim's id while its lease runs; the
+    # answers must still be served (answer, don't touch)
+    clock.advance(400.0)
+    assert "other" in tracker.announce("s", "victim",
+                                       source="10.0.0.9:1")
+    clock.advance(400.0)
+    tracker.announce("s", "victim", source="10.0.0.9:1")
+    # attribution unmoved, and the lease expires at the victim's OWN
+    # horizon (1000 ms) despite the foreign refresh attempts
+    assert tracker._member_source[("s", "victim")] == "10.0.0.7"
+    clock.advance(300.0)  # t=1100 > victim's lease; other re-announces
+    tracker.announce("s", "other", source="10.0.0.5:1")
+    assert tracker.members("s") == ["other"]
+    # after expiry a re-registration of that id is charged to whoever
+    # makes it — the attacker spends its OWN quota, not the victim's
+    tracker.announce("s", "victim", source="10.0.0.9:1")
+    assert tracker._member_source[("s", "victim")] == "10.0.0.9"
