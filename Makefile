@@ -30,9 +30,12 @@ sweep-live:
 	$(PY) tools/sweep.py --live
 
 # dryrun_multichip self-provisions the virtual 8-CPU mesh (subprocess
-# with JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count).
+# with JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count);
+# it asserts the compiled halo-exchange bytes match the boundary-rows
+# formula, and the scaling curve records step-time vs D alongside.
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+	$(PY) tools/scaling_curve.py --out SCALING_r05.json
 
 examples:
 	$(PY) examples/bundle_demo.py

@@ -14,18 +14,23 @@ lower to gather collectives.  Either way that is the simulator's
 only cross-device traffic, riding the fast fabric by construction,
 and O(P·K) on the wire instead of round 2's dense O(P²).
 
-Weak-scaling expectation (circulant path, analytic — only one real
-chip is reachable in this environment, so this is the design claim
-the dryrun compiles-and-executes rather than a measurement): with
-the peer axis split D ways, a roll by offset ``o`` exchanges |o|
-boundary rows per device per step, so per-device ICI traffic is
-``Σ_k |o_k| · (4·W + a few f32) ≈ (K/2)²·(4·W + 16)`` bytes —
-CONSTANT in P and D (≈ 2 KB/step for the degree-8 ring at 256
-segments), while per-device compute shrinks as P/D.  Halo cost is
-amortized to noise for any realistic shard size, i.e. near-ideal
-weak scaling; contrast round 2's dense form, whose sharded
-eligibility matvec moved O((P/D)·P) bytes per device per step.  The
-scan carries everything else device-local; nothing crosses DCN."""
+Weak-scaling property (circulant path — now a CHECKED property of
+the compiled program, not an analytic claim): with the peer axis
+split D ways, a roll by offset ``o`` exchanges |o| boundary rows per
+device per step, so per-device ICI traffic is
+``Σ_k |o_k| · (4·W + 12)`` bytes — the bit-packed u32 row plus the
+three rolled per-peer f32 fields — CONSTANT in P and D (≈ 2 KB/step
+for the degree-8 ring at 256 segments), while per-device compute
+shrinks as P/D.  ``__graft_entry__._assert_ici_lowering`` parses the
+collective-permute operand shapes out of the compiled HLO and
+asserts their summed bytes match this formula (they match it
+EXACTLY on current XLA: e.g. 400 B at W=2, 720 B at W=6, invariant
+as P doubles); ``make dryrun`` and CI run the check on every build.
+Halo cost is amortized to noise for any realistic shard size, i.e.
+near-ideal weak scaling; contrast round 2's dense form, whose
+sharded eligibility matvec moved O((P/D)·P) bytes per device per
+step.  The scan carries everything else device-local; nothing
+crosses DCN."""
 
 from __future__ import annotations
 

@@ -126,7 +126,9 @@ class MinimalPlayer(EventEmitter):
         self._loading_sn: Optional[int] = None
         self._loader = None
         self._timer = None
-        self._rotations = 0          # redundant-URL switches (never reset)
+        #: redundant-URL switches PER LEVEL (never reset): one level's
+        #: failures must not burn another level's failover budget
+        self._rotations: dict = {}
 
     # -- app surface ---------------------------------------------------
     def load_source(self, url: str) -> None:
@@ -330,7 +332,8 @@ class MinimalPlayer(EventEmitter):
         level = (self.levels[level_index]
                  if self.levels is not None else None)
         if (level is not None and len(level.url) > 1
-                and self._rotations < len(level.url) - 1):
+                and self._rotations.get(level_index, 0)
+                < len(level.url) - 1):
             # redundant-stream failover (contract obligation 11, the
             # hls.js behavior media-map.js:60-73 depends on): rotate
             # to the backup URL and refetch the same sn.  url_id is
@@ -338,7 +341,8 @@ class MinimalPlayer(EventEmitter):
             # counter never resets — a deliberately different shape
             # from SimPlayer's per-run counter the contract must
             # tolerate.
-            self._rotations += 1
+            self._rotations[level_index] = \
+                self._rotations.get(level_index, 0) + 1
             level.url_id = (level.url_id + 1) % len(level.url)
             self.emit(self.Events.ERROR,
                       {"type": "networkError", "details": "fragLoadError",

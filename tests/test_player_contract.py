@@ -130,3 +130,44 @@ def test_mixed_swarm_mid_stream_seek():
     assert swarm.offload_ratio > 0.2
     for peer in swarm.peers:
         assert peer.stats["p2p"] + peer.stats["cdn"] > 0
+
+
+def test_minimal_player_rotation_budget_is_per_level():
+    """The redundant-failover budget is PER LEVEL: a rotation on one
+    level must not exhaust another level's backup (a player-global
+    counter compared against a single level's URL count did exactly
+    that)."""
+    from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+    from hlsjs_p2p_wrapper_tpu.player.manifest import make_vod_manifest
+    from hlsjs_p2p_wrapper_tpu.testing.player_contract import RecordingLoader
+
+    clock = VirtualClock()
+    manifest = make_vod_manifest(level_bitrates=(300_000, 800_000),
+                                 frag_count=30, seg_duration=4.0,
+                                 redundant=True)
+    RecordingLoader.calls = []
+    RecordingLoader.fail_next = False
+    RecordingLoader.fail_all = False
+    RecordingLoader.hold_next = False
+    player = MinimalPlayer({"clock": clock, "manifest": manifest,
+                            "f_loader": RecordingLoader,
+                            "max_buffer_length": 8})
+    fatals = []
+    player.on(player.Events.ERROR,
+              lambda d=None: (isinstance(d, dict) and d.get("fatal"))
+              and fatals.append(d))
+    player.load_source("http://x/m.m3u8")
+    player.attach_media()
+    clock.advance(1_000.0)
+    # burn level 0's one rotation
+    RecordingLoader.fail_next = True
+    clock.advance(8_000.0)
+    assert player.levels[0].url_id == 1
+    # switch to level 1; its FIRST failure must still rotate
+    player.set_level(1)
+    RecordingLoader.fail_next = True
+    clock.advance(8_000.0)
+    assert player.levels[1].url_id == 1, \
+        "level 1's backup was never tried (budget burned cross-level)"
+    assert not fatals
+    player.destroy()
