@@ -183,7 +183,7 @@ class PeerMesh:
                  is_upload_on: Callable[[], bool] = lambda: True,
                  chunk_bytes: int = CHUNK_PAYLOAD_BYTES,
                  ban_ms: float = DEFAULT_BAN_MS,
-                 holder_selection: str = "adaptive",
+                 holder_selection: str = "spread",
                  max_total_serves: int = MAX_TOTAL_SERVES):
         if holder_selection not in ("adaptive", "spread", "ranked"):
             raise ValueError(f"unknown holder_selection "
@@ -344,13 +344,20 @@ class PeerMesh:
         sim's contention model, ops/swarm_sim.py holder_selection).
         Three policies:
 
-        - "adaptive" (default): least-loaded, then holders that
-          recently denied BUSY or timed out on us sort LAST for
-          :data:`HOLDER_PENALTY_MS` (congestion feedback — we route
-          around a loaded uplink before burning a round-trip to be
-          told it's busy), then the rendezvous-hash tie-break.
-        - "spread": the round-3 policy — least-loaded + rendezvous
-          hash over (my id, holder id, key), no feedback.
+        - "spread" (default since round 5): least-loaded + rendezvous
+          hash over (my id, holder id, key).  Round 5 re-measured the
+          round-4 "adaptive" default against the full model (the sim
+          now carries both the load key and the penalty window) and
+          across heterogeneous-uplink / flash-crowd / slow-majority
+          regimes: the feedback never beat spread by the +0.03
+          acceptance bar anywhere — the load key already routes
+          around busy holders — and in slow-majority swarms the
+          penalty window actively HERDS demand onto the few fast
+          holders (measured −0.13 offload at the harness level), so
+          the simpler policy ships (POLICY_AB_r05.json meta).
+        - "adaptive": spread + holders that recently denied BUSY or
+          timed out on us sort LAST for :data:`HOLDER_PENALTY_MS`
+          (kept for A/B study).
         - "ranked": announce order (the round-2 herding behavior,
           kept for A/B study).
         """

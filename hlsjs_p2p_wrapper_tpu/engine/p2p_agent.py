@@ -169,13 +169,16 @@ class P2PAgent:
                 request_timeout_ms=cfg.get("request_timeout_ms",
                                            DEFAULT_REQUEST_TIMEOUT_MS),
                 is_upload_on=lambda: self.p2p_upload_on and not self.disposed,
-                # "adaptive" by default: rendezvous-hash spread PLUS
-                # BUSY/timeout feedback that routes around loaded
-                # holders — announce-order ("ranked") herds the whole
-                # swarm onto one uplink under contention, and static
-                # "spread" keeps re-electing a denying holder by hash
+                # "spread" by default (round 5): least-loaded +
+                # rendezvous hash + retry rotation.  The round-4
+                # "adaptive" feedback (BUSY/timeout penalty window)
+                # measured a net loss — it never paid the +0.03 A/B
+                # bar and herds demand onto the few fast holders in
+                # slow-majority swarms (POLICY_AB_r05.json meta);
+                # announce-order ("ranked") still herds the whole
+                # swarm onto one uplink under contention
                 # (mesh.holders_of)
-                holder_selection=cfg.get("holder_selection", "adaptive"),
+                holder_selection=cfg.get("holder_selection", "spread"),
                 # serve admission control (mesh.MAX_TOTAL_SERVES)
                 max_total_serves=cfg.get("max_total_serves",
                                          MAX_TOTAL_SERVES))

@@ -125,7 +125,8 @@ def state_shardings(mesh: Mesh) -> SwarmState:
         dl_level=peer_vec, dl_done_bytes=peer_vec,
         dl_total_bytes=peer_vec, dl_elapsed_ms=peer_vec,
         dl_budget_ms=peer_vec, dl_cooldown_ms=peer_vec,
-        dl_attempts=peer_vec, fg_wait_ms=peer_vec)
+        dl_attempts=peer_vec, fg_wait_ms=peer_vec,
+        holder_penalty_ms=avail, dl_holder_off=peer_vec)
 
 
 def scenario_shardings(mesh: Mesh) -> SwarmScenario:
@@ -147,7 +148,8 @@ def scenario_shardings(mesh: Mesh) -> SwarmScenario:
         p2p_budget_cap_ms=rep, p2p_budget_floor_ms=rep,
         live_spread_s=rep, request_timeout_ms=rep,
         announce_delay_s=rep, p2p_setup_ms=rep,
-        uplink_efficiency=rep, retry_dead_ms=rep)
+        uplink_efficiency=rep, retry_dead_ms=rep,
+        holder_penalty_ms=rep)
 
 
 def shard_swarm(mesh: Mesh, scenario: SwarmScenario, state: SwarmState):
@@ -166,9 +168,11 @@ def sharded_run(mesh: Mesh, config: SwarmConfig, bitrates, neighbors,
     """jit the swarm scan with explicit input shardings over the mesh.
     XLA inserts the ICI collectives for the neighbor gathers and the
     holder-load scatter; all other ops stay local to their shard."""
-    from ..ops.swarm_sim import _run_swarm, make_scenario
+    from ..ops.swarm_sim import (_run_swarm, ensure_penalty_width,
+                                 make_scenario)
     scenario = make_scenario(config, bitrates, neighbors, cdn_bps, join_s,
                              **scenario_kwargs)
+    state = ensure_penalty_width(config, scenario, state)
     scenario, state = shard_swarm(mesh, scenario, state)
     with mesh:
         return _run_swarm(config, scenario, state, n_steps)

@@ -530,16 +530,17 @@ def test_edge_attribution_prunes_lazily_keeping_fresh_edges():
 
 
 def test_adaptive_selection_routes_around_busy_holder():
-    """"adaptive" (the default): a holder that denies BUSY or times
-    out is deprioritized for HOLDER_PENALTY_MS, then restored — the
-    congestion feedback VERDICT r3 #3 asked for, so a requester stops
-    re-electing a loaded holder by hash while its uplink drains."""
+    """"adaptive" (the A/B-study policy; "spread" is the round-5
+    default after the penalty window measured a net loss —
+    POLICY_AB_r05.json): a holder that denies BUSY or times out is
+    deprioritized for HOLDER_PENALTY_MS, then restored."""
     from hlsjs_p2p_wrapper_tpu.engine.mesh import HOLDER_PENALTY_MS
 
     clock = VirtualClock()
     net = LoopbackNetwork(clock, default_latency_ms=5.0)
-    mesh_a, _ = make_mesh(net, clock, "a")
-    assert mesh_a.holder_selection == "adaptive"  # the default
+    mesh_a, _ = make_mesh(net, clock, "a", holder_selection="adaptive")
+    assert make_mesh(net, clock, "z")[0].holder_selection == "spread", \
+        "the shipped default demoted to spread in round 5"
     meshes = {}
     for name in ("b", "c"):
         meshes[name], cache = make_mesh(net, clock, name)

@@ -154,6 +154,14 @@ def test_self_padding_is_inert():
                      steps_for(config, 60.0), join)
     b, _ = run_swarm(config, bitrates, padded, cdn, state,
                      steps_for(config, 60.0), join)
+    # the per-edge penalty field is topology-WIDTH-shaped bookkeeping
+    # (zero-width under non-adaptive policies): padding columns can
+    # never be selected, so they must stay zero — then drop them so
+    # the semantic state trees compare exactly
+    if b.holder_penalty_ms.shape[1] > 8:
+        assert float(jnp.max(b.holder_penalty_ms[:, 8:])) == 0.0, \
+            "a self-padding edge collected a penalty"
+        b = b._replace(holder_penalty_ms=b.holder_penalty_ms[:, :8])
     assert_trees_match(a, b, exact=True, what="self-padding changed dynamics")
 
 
@@ -496,10 +504,12 @@ def test_busy_fastfail_flips_denied_foreground_to_cdn():
     # peer 0 holds segment 5; peers 1 and 2 (buffer 20 s → next_seg 5,
     # margin 20 s: not urgent) both start it this step.  The slow
     # uplink keeps the admitted transfer in flight past the step.
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import ensure_penalty_width
     state = _crafted_state(config, [(0, 5)], [32.0, 20.0, 20.0])
     scenario = make_scenario(config, jnp.array([800_000.0]),
                              full_neighbors(3), jnp.full((3,), 8e6),
                              uplink_bps=jnp.full((3,), 2_000_000.0))
+    state = ensure_penalty_width(config, scenario, state)
     new = jax.jit(lambda s: swarm_step(config, scenario, s))(state)
     started = [bool(new.dl_active[p, 0]) for p in (1, 2)]
     p2p = [bool(new.dl_is_p2p[p, 0]) for p in (1, 2)]
@@ -520,10 +530,12 @@ def test_prefetch_denial_sets_retry_cooldown():
     # peer 0 holds segments 5 AND 6; peers 1/2 foreground seg 5 and
     # prefetch seg 6 — cap 1 on the single holder denies three of the
     # four transfers
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import ensure_penalty_width
     state = _crafted_state(config, [(0, 5), (0, 6)],
                            [32.0, 20.0, 20.0])
     scenario = make_scenario(config, jnp.array([800_000.0]),
                              full_neighbors(3), jnp.full((3,), 8e6))
+    state = ensure_penalty_width(config, scenario, state)
     step = jax.jit(lambda s: swarm_step(config, scenario, s))
     new = step(state)
     cooldowns = [float(new.dl_cooldown_ms[p, 1]) for p in (1, 2)]
@@ -571,6 +583,9 @@ def test_live_stagger_is_request_anchored():
                                  edge_rank=jnp.array([0.0, 0.4, 0.7,
                                                       0.95]),
                                  p2p_budget_floor_ms=4_000.0)
+        from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import \
+            ensure_penalty_width
+        state = ensure_penalty_width(config, scenario, state)
         step = jax.jit(lambda s: swarm_step(config, scenario, s))
         waited = False
         for _ in range(16):
@@ -624,17 +639,28 @@ def test_ranked_circulant_matches_general_path():
 
 
 def test_spread_equals_adaptive_single_slot():
-    """At max_concurrency=1 the failure-rotation salt never bumps
-    (only prefetch slots rotate), so "adaptive" must reproduce
-    "spread" EXACTLY — the equivalence bench.py's host baseline
-    asserts (numpy_baseline_throughput's config guards) as a checked
-    property."""
+    """At max_concurrency=1 with UNCAPPED serves, no failure ever
+    arms the penalty window (prefetch aborts need prefetch slots;
+    foreground BUSY denials need the admission cap), so "adaptive"
+    must reproduce "spread" EXACTLY.  Round 5 narrowed the claim:
+    with the cap on, foreground BUSY denials now penalize (matching
+    the mesh's _penalize_holder), so bench.py's host baseline guards
+    on "spread" alone."""
     config, bitrates, neighbors, cdn, join, state = scenario()
+    config = config._replace(max_total_serves=0)
     n = steps_for(config, 60.0)
     spread, _ = run_swarm(config._replace(holder_selection="spread"),
                           bitrates, neighbors, cdn, state, n, join)
     adaptive, _ = run_swarm(config._replace(holder_selection="adaptive"),
                             bitrates, neighbors, cdn, state, n, join)
+    # the penalty field differs in WIDTH by construction (spread
+    # carries the zero-width form); the equivalence claim is that at
+    # C=1 adaptive never ARMS a penalty — assert that, then compare
+    # the semantic trees
+    assert float(jnp.sum(adaptive.holder_penalty_ms)) == 0.0, \
+        "adaptive armed a penalty at C=1"
+    adaptive = adaptive._replace(
+        holder_penalty_ms=spread.holder_penalty_ms)
     assert_trees_match(spread, adaptive, exact=True,
                        what="adaptive != spread at C=1 (the documented "
                             "equivalence)")
