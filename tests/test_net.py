@@ -443,17 +443,19 @@ def test_wrong_length_connector_nonce_rejected():
         network.close()
 
 
-@pytest.mark.skipif(__import__("shutil").which("openssl") is None,
-                    reason="needs the openssl CLI to mint a test cert")
-def test_tls_wrapped_fabric_exchanges_frames(tmp_path):
-    """The confidentiality option: both fabric sides wrap every
-    connection in TLS before any identity bytes; the PSK handshake
-    and frame MACs run inside the channel.  The client VERIFIES the
-    fabric certificate (not CERT_NONE theatre)."""
+@pytest.fixture(scope="module")
+def tls_contexts(tmp_path_factory):
+    """One minted self-signed cert + (server, client) context pair for
+    every TLS test in the module — the client VERIFIES the fabric
+    certificate (not CERT_NONE theatre)."""
+    import shutil
     import ssl
     import subprocess
 
-    key, cert = tmp_path / "key.pem", tmp_path / "cert.pem"
+    if shutil.which("openssl") is None:
+        pytest.skip("needs the openssl CLI to mint a test cert")
+    d = tmp_path_factory.mktemp("tls")
+    key, cert = d / "key.pem", d / "cert.pem"
     subprocess.run(
         ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
          "-keyout", str(key), "-out", str(cert), "-days", "1",
@@ -463,7 +465,14 @@ def test_tls_wrapped_fabric_exchanges_frames(tmp_path):
     server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     server_ctx.load_cert_chain(str(cert), str(key))
     client_ctx = ssl.create_default_context(cafile=str(cert))
+    return server_ctx, client_ctx
 
+
+def test_tls_wrapped_fabric_exchanges_frames(tls_contexts):
+    """The confidentiality option: both fabric sides wrap every
+    connection in TLS before any identity bytes; the PSK handshake
+    and frame MACs run inside the channel."""
+    server_ctx, client_ctx = tls_contexts
     network = TcpNetwork(psk=b"swarm-secret",
                          ssl_server_context=server_ctx,
                          ssl_client_context=client_ctx)
@@ -993,4 +1002,74 @@ def test_outbound_start_never_spawns_reader_even_if_connect_won_race():
         a.close()
         b.close()
     finally:
+        network.close()
+
+
+def test_tls_misconfig_and_dribble_fail_closed(tls_contexts):
+    """The TLS wrap's failure paths: a plaintext client dialing a TLS
+    listener is dropped at the wrap; a client dribbling TLS bytes is
+    cut at the ABSOLUTE handshake deadline (not per-recv); and the
+    fabric keeps serving honest TLS peers afterwards."""
+    import socket as socket_mod
+
+    from hlsjs_p2p_wrapper_tpu.engine import net as net_mod
+
+    server_ctx, client_ctx = tls_contexts
+    network = TcpNetwork(psk=b"s", ssl_server_context=server_ctx,
+                         ssl_client_context=client_ctx)
+    orig = net_mod.HANDSHAKE_TIMEOUT_S
+    net_mod.HANDSHAKE_TIMEOUT_S = 0.8
+    try:
+        target = network.register()
+        got = []
+        target.on_receive = lambda src, f: got.append(f)
+        host, port = target.peer_id.rsplit(":", 1)
+
+        # plaintext client: the server's TLS wrap fails and closes
+        plain = socket_mod.create_connection((host, int(port)),
+                                             timeout=2.0)
+        plain.sendall(b"\x00\x01\x02not-tls")
+        plain.settimeout(3.0)
+        try:
+            dropped = plain.recv(64) == b""
+        except OSError:
+            dropped = True
+        assert dropped, "plaintext client was served by a TLS listener"
+        plain.close()
+
+        # TLS-byte dribbler: cut at the absolute deadline
+        drib = socket_mod.create_connection((host, int(port)),
+                                            timeout=2.0)
+        start = time.monotonic()
+        cut = None
+        for _ in range(40):
+            try:
+                drib.sendall(b"\x16")  # one handshake-record byte
+            except OSError:
+                cut = time.monotonic() - start
+                break
+            time.sleep(0.2)
+            drib.setblocking(False)
+            try:
+                if drib.recv(1) == b"":
+                    cut = time.monotonic() - start
+                    break
+            except BlockingIOError:
+                pass
+            except OSError:
+                cut = time.monotonic() - start
+                break
+            drib.setblocking(True)
+        assert cut is not None and cut < 4.0, cut
+        drib.close()
+
+        # honest TLS traffic still flows
+        other = network.register()
+        done = threading.Event()
+        target.on_receive = lambda src, f: (got.append(f), done.set())
+        other.send(target.peer_id, b"healthy")
+        assert wait_for(done.is_set)
+        assert got[-1] == b"healthy"
+    finally:
+        net_mod.HANDSHAKE_TIMEOUT_S = orig
         network.close()
