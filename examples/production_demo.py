@@ -10,7 +10,10 @@ assembles the DEPLOYMENT combination end-to-end on localhost:
 - a socket tracker and three full P2P agents: the seeder pulls the
   segment from the origin over HTTP, both followers fetch it from the
   seeder's cache over TCP — their CDN counters stay at zero,
-- a rogue agent on a WRONG-key fabric, which the swarm never admits.
+- a rogue agent on a WRONG-key fabric, which the swarm never admits,
+- and (when the ``openssl`` CLI is present to mint a throwaway cert)
+  the same exchange over a TLS-wrapped fabric — the confidentiality
+  option — with a plaintext-fabric rogue refused at the wrap.
 
 Run: ``python examples/production_demo.py``
 """
@@ -72,6 +75,19 @@ def fetch(agent, url, segment_view):
     return box["data"]
 
 
+def make_agent(network, base, tracker_peer_id, content_id):
+    """One fully-wired production agent — shared by the PSK and TLS
+    legs so their configurations cannot silently diverge."""
+    return P2PAgent(
+        NullBridge(), f"{base}/master.m3u8", NullMediaMap(),
+        {"network": network, "clock": network.loop,
+         "cdn_transport": HttpCdnTransport(),
+         "tracker_peer_id": tracker_peer_id,
+         "content_id": content_id,
+         "announce_interval_ms": 200.0},
+        SegmentView, "hls", "v2")
+
+
 def main():
     # the rogue peer retries its doomed handshake for the whole demo;
     # one printed line (below) beats a warning per attempt
@@ -80,27 +96,27 @@ def main():
     origin = ThreadingHTTPServer(("127.0.0.1", 0), OriginHandler)
     threading.Thread(target=origin.serve_forever, daemon=True).start()
     base = f"http://127.0.0.1:{origin.server_address[1]}"
+    try:
+        psk_leg(base)
+        tls_leg(base)
+    finally:
+        origin.shutdown()
+        origin.server_close()
 
+
+def psk_leg(base):
     net = TcpNetwork(psk=SWARM_PSK)
     tracker_endpoint = net.register()
     TrackerEndpoint(Tracker(net.loop), tracker_endpoint)
 
-    def make_agent(network):
-        return P2PAgent(
-            NullBridge(), f"{base}/master.m3u8", NullMediaMap(),
-            {"network": network, "clock": network.loop,
-             "cdn_transport": HttpCdnTransport(),
-             "tracker_peer_id": tracker_endpoint.peer_id,
-             "content_id": "production-demo",
-             "announce_interval_ms": 200.0},
-            SegmentView, "hls", "v2")
-
-    agents = [make_agent(net) for _ in range(3)]
+    agents = [make_agent(net, base, tracker_endpoint.peer_id,
+                         "production-demo") for _ in range(3)]
     seeder, followers = agents[0], agents[1:]
     # a rogue peer with the wrong swarm key: its fabric cannot complete
     # the HMAC handshake against ours, so the mesh never admits it
     rogue_net = TcpNetwork(psk=b"wrong-key")
-    rogue = make_agent(rogue_net)
+    rogue = make_agent(rogue_net, base, tracker_endpoint.peer_id,
+                       "production-demo")
 
     try:
         assert wait_for(lambda: all(a.stats["peers"] == 2 for a in agents)), \
@@ -143,8 +159,80 @@ def main():
             agent.dispose()
         net.close()
         rogue_net.close()
-        origin.shutdown()
-        origin.server_close()
+
+
+def tls_leg(base):
+    """The confidentiality option, end-to-end: mint a throwaway cert,
+    wrap every connection in TLS (the PSK handshake + frame MACs run
+    inside the channel), exchange a segment, and show a plaintext
+    fabric refused at the wrap."""
+    import shutil
+    import ssl
+    import subprocess
+    import tempfile
+
+    if shutil.which("openssl") is None:
+        print("tls leg: skipped (no openssl CLI to mint a test cert)")
+        return
+    with tempfile.TemporaryDirectory() as d:  # the private key dies here
+        key = os.path.join(d, "key.pem")
+        cert = os.path.join(d, "cert.pem")
+        try:
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-nodes", "-keyout", key, "-out", cert, "-days", "1",
+                 "-subj", "/CN=127.0.0.1",
+                 "-addext", "subjectAltName = IP:127.0.0.1"],
+                check=True, capture_output=True)
+        except subprocess.CalledProcessError as e:
+            # present-but-incapable openssl (e.g. LibreSSL without
+            # -addext): degrade gracefully, like the absent-CLI path
+            print(f"tls leg: skipped (openssl cannot mint the cert: "
+                  f"{e.stderr.decode(errors='replace').strip()[:120]})")
+            return
+        server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        server_ctx.load_cert_chain(cert, key)
+        client_ctx = ssl.create_default_context(cafile=cert)
+        _run_tls_exchange(base, server_ctx, client_ctx)
+
+
+def _run_tls_exchange(base, server_ctx, client_ctx):
+    tls_net = TcpNetwork(psk=SWARM_PSK, ssl_server_context=server_ctx,
+                         ssl_client_context=client_ctx)
+    plain_net = TcpNetwork(psk=SWARM_PSK)  # right key, no TLS: refused
+    tracker_endpoint = tls_net.register()
+    TrackerEndpoint(Tracker(tls_net.loop), tracker_endpoint)
+
+    seeder = make_agent(tls_net, base, tracker_endpoint.peer_id,
+                        "production-demo-tls")
+    follower = make_agent(tls_net, base, tracker_endpoint.peer_id,
+                          "production-demo-tls")
+    plain_rogue = make_agent(plain_net, base, tracker_endpoint.peer_id,
+                             "production-demo-tls")
+    try:
+        assert wait_for(lambda: seeder.stats["peers"] == 1
+                        and follower.stats["peers"] == 1), \
+            "TLS mesh never connected"
+        sv = SegmentView(sn=9, track_view=TrackView(level=0, url_id=0),
+                         time=90.0)
+        url = f"{base}/seg9.ts"
+        data = fetch(seeder, url, sv)
+        key_bytes = sv.to_bytes()
+        assert wait_for(
+            lambda: seeder.peer_id in follower.mesh.holders_of(key_bytes))
+        got = fetch(follower, url, sv)
+        assert got == data and follower.stats["cdn"] == 0
+        print(f"tls leg: {len(got):,} B over TLS-wrapped TCP P2P "
+              f"(client verifies the fabric certificate)")
+        assert not wait_for(lambda: plain_rogue.stats["peers"] > 0,
+                            timeout_s=2.0)
+        print("tls leg: plaintext fabric (right PSK, no TLS) refused "
+              "at the wrap")
+    finally:
+        for agent in (seeder, follower, plain_rogue):
+            agent.dispose()
+        tls_net.close()
+        plain_net.close()
 
 
 if __name__ == "__main__":
