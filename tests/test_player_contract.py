@@ -171,3 +171,33 @@ def test_minimal_player_rotation_budget_is_per_level():
         "level 1's backup was never tried (budget burned cross-level)"
     assert not fatals
     player.destroy()
+
+
+def test_mixed_live_swarm_both_engines_hold_the_edge():
+    """The live × mixed-engine intersection: SimPlayer and
+    MinimalPlayer (which gained live-window resync in round 5) share
+    one LIVE stream — both engines must track the sliding window and
+    exchange fresh segments P2P through the identical agent
+    contract."""
+    swarm = SwarmHarness(seg_duration=4.0, level_bitrates=(800_000,),
+                         cdn_bandwidth_bps=8_000_000.0, live=True)
+    swarm.add_peer("sim-seed", uplink_bps=10_000_000.0,
+                   player_class=SimPlayer)
+    swarm.run(20_000.0)
+    swarm.add_peer("min-late", uplink_bps=10_000_000.0,
+                   player_class=MinimalPlayer)
+    swarm.run(60_000.0)
+    sim_peer, min_peer = swarm.peers
+    # both playheads track the live window (not stuck at the start)
+    window_start = swarm.manifest.levels[0].fragments[0].start
+    assert sim_peer.position_s >= window_start - 4.0, \
+        (sim_peer.position_s, window_start)
+    assert min_peer.position_s >= window_start - 4.0, \
+        (min_peer.position_s, window_start)
+    # the late MinimalPlayer pulled fresh segments from the SimPlayer
+    # seeder over P2P
+    assert min_peer.stats["p2p"] > 0, min_peer.stats
+    assert sim_peer.stats["upload"] > 0, sim_peer.stats
+    # and playback is healthy on both engines
+    assert sim_peer.rebuffer_ms < 5_000.0
+    assert min_peer.rebuffer_ms < 10_000.0
