@@ -398,7 +398,12 @@ class PeerMesh:
         """Congestion feedback for the "adaptive" selection: this
         holder just signalled overload (BUSY) or silently failed a
         transfer (timeout) — deprioritize it for a window instead of
-        immediately re-electing it by hash."""
+        immediately re-electing it by hash.  A no-op under the other
+        policies: only "adaptive" ever reads the map, and dead
+        bookkeeping on the default path earned the sim twin a review
+        finding (ops/swarm_sim.py init_swarm's zero-width field)."""
+        if self.holder_selection != "adaptive":
+            return
         self._holder_penalty[peer_id] = self.clock.now() + HOLDER_PENALTY_MS
         if len(self._holder_penalty) > self.MAX_EDGE_ENTRIES:
             now = self.clock.now()

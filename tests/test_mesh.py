@@ -678,7 +678,10 @@ def test_holder_penalty_map_prunes_expired_entries():
     from hlsjs_p2p_wrapper_tpu.engine.mesh import HOLDER_PENALTY_MS
     clock = VirtualClock()
     net = LoopbackNetwork(clock, default_latency_ms=5.0)
-    mesh, _cache = make_mesh(net, clock, "a")
+    # only "adaptive" arms penalties (round 5: no dead bookkeeping on
+    # the spread default)
+    mesh, _cache = make_mesh(net, clock, "a",
+                             holder_selection="adaptive")
     for i in range(PeerMesh.MAX_EDGE_ENTRIES):
         mesh._penalize_holder(f"old-{i}")
     clock.advance(HOLDER_PENALTY_MS + 1.0)   # all of those expire

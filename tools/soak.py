@@ -37,7 +37,14 @@ def main() -> int:
     rng = random.Random(args.seed)
     swarm = SwarmHarness(cdn_bandwidth_bps=40_000_000.0, live=True,
                          frag_count=200, seg_duration=4.0)
-    swarm.add_peer("seed", uplink_bps=20_000_000.0)
+    # the soak runs the "adaptive" policy deliberately: under the
+    # "spread" default the penalty map is empty BY CONSTRUCTION
+    # (mesh._penalize_holder is a no-op), which would make the
+    # penalties-reference-departed-peers invariant below vacuous —
+    # adaptive exercises the richer state surface the soak audits
+    soak_cfg = {"holder_selection": "adaptive"}
+    swarm.add_peer("seed", uplink_bps=20_000_000.0,
+                   p2p_config=dict(soak_cfg))
     swarm.run(15_000.0)
     alive = []
     counter = 0
@@ -46,7 +53,8 @@ def main() -> int:
             counter += 1
             alive.append(swarm.add_peer(
                 f"v{counter}",
-                uplink_bps=rng.choice([2e6, 5e6, 10e6])))
+                uplink_bps=rng.choice([2e6, 5e6, 10e6]),
+                p2p_config=dict(soak_cfg)))
         else:
             alive.pop(rng.randrange(len(alive))).leave()
         swarm.run(7_000.0)
