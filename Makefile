@@ -16,7 +16,8 @@ lint:
 coverage:
 	$(PY) tools/coverage.py
 
-# deterministic large churn soak (~35 s; above CI's scale tier)
+# deterministic large churn soak (~35 s; above the pytest suite's
+# scale tier — CI runs it as its own step)
 soak:
 	$(PY) tools/soak.py
 

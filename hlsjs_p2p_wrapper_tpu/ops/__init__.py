@@ -3,6 +3,7 @@ device-resident swarm simulator."""
 
 from .ewma import EwmaState, get_estimate, init_state, scan_samples, update
 from .swarm_sim import (SwarmConfig, SwarmScenario, SwarmState,
+                        ensure_penalty_width,
                         full_neighbors, full_offsets, init_swarm,
                         invert_neighbors, isolated_neighbors,
                         make_scenario, neighbors_from_adjacency,
@@ -14,6 +15,7 @@ from .swarm_sim import (SwarmConfig, SwarmScenario, SwarmState,
 
 __all__ = ["EwmaState", "get_estimate", "init_state", "scan_samples",
            "update", "SwarmConfig", "SwarmScenario", "SwarmState",
+           "ensure_penalty_width",
            "full_neighbors", "full_offsets", "init_swarm",
            "invert_neighbors", "isolated_neighbors", "make_scenario",
            "neighbors_from_adjacency", "offload_ratio",

@@ -1,8 +1,8 @@
-"""Large churn soak — the manual stress tier above the test suite.
+"""Large churn soak — the stress tier above the unit/swarm suites.
 
 A live-mode swarm with continuous random churn (join-heavy, mixed
-uplinks) at a scale the CI suite deliberately stays under, checking
-the long-uptime invariants at the end (explicit checks, not
+uplinks) at a scale the pytest suite deliberately stays under,
+checking the long-uptime invariants at the end (explicit checks, not
 asserts — the tool must fail under ``python -O`` too): the long-lived seeder's mesh
 state must track LIVE membership exactly (no leaked PeerStates,
 uploads, downloads, or bans — the round-4 reap/bound work), playback
