@@ -317,3 +317,20 @@ def test_full_stack_tcp_swarm_with_http_origin(origin):
         for agent in agents:
             agent.dispose()
         net.close()
+
+
+def test_slice_for_range_covers_the_wire_conventions():
+    """The Range slicing helper honors the loader's on-wire forms:
+    full range (inclusive end), open-ended suffix, and missing
+    header."""
+    from hlsjs_p2p_wrapper_tpu.engine.cdn import slice_for_range
+
+    payload = bytes(range(100))
+    assert slice_for_range(payload, None) == payload
+    assert slice_for_range(payload, {}) == payload
+    assert slice_for_range(payload, {"Range": "bytes=10-19"}) \
+        == payload[10:20]
+    assert slice_for_range(payload, {"Range": "bytes=90-"}) \
+        == payload[90:]
+    assert slice_for_range(payload, {"Range": "bytes=-0"}) \
+        == payload[:1]

@@ -49,3 +49,22 @@ def test_construct_from_mapping_and_object():
 def test_hashable():
     assert len({TrackView(level=0, url_id=0), TrackView(level=0, url_id=0),
                 TrackView(level=0, url_id=1)}) == 2
+
+
+def test_duck_typed_object_and_repr():
+    """The constructor's third input shape: a plain object exposing
+    level/url_id attributes (hls.js level objects are exactly this),
+    including the camelCase fallback; repr is the debug surface."""
+    class LevelObj:
+        level = 2
+        url_id = 1
+
+    view = TrackView(LevelObj())
+    assert (view.level, view.url_id) == (2, 1)
+    assert repr(view) == "TrackView(level=2, url_id=1)"
+
+    class CamelObj:
+        level = 1
+        urlId = 3  # noqa: N815 — hls.js field name
+
+    assert TrackView(CamelObj()).url_id == 3
