@@ -1114,3 +1114,46 @@ def test_mutated_wire_frames_never_deliver():
             target.close()
     finally:
         network.close()
+
+
+def test_tls_churn_soak_no_thread_or_selector_leak(tls_contexts):
+    """_SafeTls under churn: endpoints joining, exchanging MACed
+    frames through TLS, and closing in rounds must return the process
+    to its thread baseline — reader/writer threads blocked inside the
+    serialized SSL paths must wake on shutdown, and the per-
+    connection selectors must close with their sockets (a leaked
+    epoll fd shows up as an OSError storm on later rounds)."""
+    server_ctx, client_ctx = tls_contexts
+    baseline = threading.active_count()
+    network = TcpNetwork(psk=b"churn", ssl_server_context=server_ctx,
+                         ssl_client_context=client_ctx)
+    endpoints = []
+    received = []
+
+    def attach(ep):
+        ep.on_receive = lambda src, f: received.append((ep.peer_id, src))
+        endpoints.append(ep)
+
+    for _ in range(4):
+        attach(network.register())
+    try:
+        for round_no in range(3):
+            before = len(received)
+            for ep in endpoints:
+                for other in endpoints:
+                    if other is not ep:
+                        ep.send(other.peer_id, b"tls-ping" * 100)
+            # let most of the round land BEFORE churning, so closes
+            # race only the stragglers (TLS handshakes are slow
+            # enough that an immediate close would starve delivery)
+            assert wait_for(lambda: len(received) >= before + 6,
+                            20.0), (round_no, len(received) - before)
+            victim = endpoints.pop(0)
+            victim.close()
+            attach(network.register())
+    finally:
+        network.close()
+    assert wait_for(
+        lambda: threading.active_count() <= baseline + 1,
+        timeout_s=10.0), \
+        f"threads leaked: {threading.active_count()} vs {baseline}"
