@@ -303,8 +303,12 @@ class PeerMesh:
 
     def drop_peer(self, peer_id: str) -> None:
         """Forget a neighbor; fail its in-flight downloads and stop
-        serving it."""
+        serving it.  The penalty entry goes with the peer — a departed
+        neighbor's unexpired window is dead state (found by the
+        100-round churn soak: penalties referencing departed peers
+        linger up to HOLDER_PENALTY_MS after every reap)."""
         self.peers.pop(peer_id, None)
+        self._holder_penalty.pop(peer_id, None)
         for request_id in [r for r, d in self._downloads.items()
                            if d.peer_id == peer_id]:
             self._fail_download(request_id, {"status": 0})

@@ -755,3 +755,19 @@ def test_remote_have_map_bounded_under_announce_storm(duo):
     have = mesh_a.peers["b"].have
     assert len(have) == MAX_REMOTE_HAVE
     assert key(total - 1) in have and key(0) not in have
+
+
+def test_dropped_peer_takes_its_penalty_entry_along():
+    """Found by the 100-round churn soak: a departed neighbor's
+    unexpired penalty window lingered in _holder_penalty for up to
+    HOLDER_PENALTY_MS after the reap — dead state the
+    state-tracks-live-membership invariant forbids."""
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    mesh, _cache = make_mesh(net, clock, "a",
+                             holder_selection="adaptive")
+    mesh._penalize_holder("gone-soon")
+    assert "gone-soon" in mesh._holder_penalty
+    mesh.drop_peer("gone-soon")
+    assert "gone-soon" not in mesh._holder_penalty
+    mesh.close()
