@@ -65,3 +65,19 @@ def test_scaling_artifact_has_flat_and_multihost_rows():
         assert row["step_ms"] > 0
         assert row["step_ms_per_shard"] == pytest.approx(
             row["step_ms"] / row["devices"], abs=5e-3)
+
+
+def test_policy_artifact_matches_shipped_default():
+    """The artifact's recorded default must BE the shipped default —
+    a future policy flip without regenerating the A/B evidence should
+    fail here, not ship silently."""
+    import inspect
+
+    from hlsjs_p2p_wrapper_tpu.engine.mesh import PeerMesh
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import SwarmConfig
+
+    art = load("POLICY_AB_r05.json")
+    mesh_default = inspect.signature(
+        PeerMesh.__init__).parameters["holder_selection"].default
+    sim_default = SwarmConfig._field_defaults["holder_selection"]
+    assert art["meta"]["default_policy"] == mesh_default == sim_default
