@@ -651,6 +651,17 @@ class TcpEndpoint:
         self.closed = False
         self.bytes_sent = 0
         self.bytes_received = 0
+        #: attack visibility (SECURITY.md): EVERY inbound handshake
+        #: turned away — failed TLS wrap, missing/oversized/non-UTF-8
+        #: preamble, host mismatch, protected-id claim, PSK failure,
+        #: and connect-flood shedding at the pending-handshake gate —
+        #: plus post-handshake frames dropped for MAC failure.  Locked
+        #: increments (_count): the counters exist precisely for
+        #: high-concurrency attack bursts, where unlocked += from 64
+        #: handshake threads would drop counts
+        self.handshake_rejects = 0
+        self.mac_drops = 0
+        self._stats_lock = threading.Lock()
         #: ids an inbound preamble may never claim (module docstring:
         #: trust model).  The agent adds its tracker id here.
         self.reject_inbound_ids: set = set()
@@ -666,6 +677,13 @@ class TcpEndpoint:
         self.peer_id = f"{host}:{self._listener.getsockname()[1]}"
         threading.Thread(target=self._accept_loop, daemon=True,
                          name=f"p2p-accept-{self.peer_id}").start()
+
+    def _count(self, counter: str) -> None:
+        """Locked counter bump: these feed alerting during exactly the
+        high-concurrency bursts where unlocked ``+=`` from 64
+        handshake threads would drop increments."""
+        with self._stats_lock:
+            setattr(self, counter, getattr(self, counter) + 1)
 
     def backlog_ms(self, dest_id: Optional[str] = None) -> float:
         """Uplink backlog estimate for the mesh's serve pacing
@@ -761,6 +779,10 @@ class TcpEndpoint:
                 if admit:
                     self._pending_handshakes += 1
             if not admit:
+                if not self.closed:
+                    # flood shedding — but the close()-time wake
+                    # self-connect must not count as an attack
+                    self._count("handshake_rejects")
                 try:
                     sock.close()
                 except OSError:
@@ -812,16 +834,19 @@ class TcpEndpoint:
             # that follow — never on the accept loop
             tls = _tls_wrap(sock, ssl_ctx, deadline, server_side=True)
             if tls is None:
+                self._count("handshake_rejects")
                 return  # _tls_wrap owns failure cleanup
             sock = tls
         preamble = _read_frame(sock, max_bytes=self.MAX_PREAMBLE_BYTES,
                                deadline=deadline)
         if preamble is None:
+            self._count("handshake_rejects")
             sock.close()
             return
         try:
             remote_id = preamble.decode("utf-8")
         except UnicodeDecodeError:
+            self._count("handshake_rejects")
             sock.close()
             return
         # identity binding (module docstring: trust model): the
@@ -832,6 +857,7 @@ class TcpEndpoint:
         try:
             observed_host = sock.getpeername()[0]
         except OSError:
+            self._count("handshake_rejects")
             sock.close()
             return
         if remote_id in self.reject_inbound_ids or (
@@ -840,6 +866,7 @@ class TcpEndpoint:
                                                    observed_host)):
             log.warning("rejecting inbound connection claiming %r from %s",
                         remote_id, observed_host)
+            self._count("handshake_rejects")
             sock.close()
             return
         psk = self.network.psk
@@ -870,6 +897,7 @@ class TcpEndpoint:
                     mac, _psk_response(psk, a_nonce, c_nonce, preamble)):
                 log.warning("rejecting unauthenticated inbound claiming "
                             "%r from %s", remote_id, observed_host)
+                self._count("handshake_rejects")
                 sock.close()
                 return
             frame_keys = _derive_frame_keys(psk, a_nonce, c_nonce, preamble)
@@ -943,6 +971,7 @@ class TcpEndpoint:
                 if len(frame) < FRAME_MAC_LEN:
                     log.warning("dropping %s: untagged frame on an "
                                 "authenticated link", conn.remote_id)
+                    self._count("mac_drops")
                     conn.close()
                     return
                 body, tag = frame[:-FRAME_MAC_LEN], frame[-FRAME_MAC_LEN:]
@@ -951,6 +980,7 @@ class TcpEndpoint:
                                         body)):
                     log.warning("dropping %s: frame MAC mismatch "
                                 "(injection or splice?)", conn.remote_id)
+                    self._count("mac_drops")
                     conn.close()
                     return
                 conn._recv_seq += 1

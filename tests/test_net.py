@@ -207,6 +207,7 @@ def test_psk_same_host_impersonation_now_fails():
         time.sleep(0.3)
         assert got == []
         assert victim.peer_id not in target._conns
+        assert target.handshake_rejects == 1  # the attack is countable
         sock.close()
     finally:
         network.close()
@@ -362,6 +363,7 @@ def test_post_handshake_frame_injection_rejected():
         time.sleep(0.2)
         assert got == [(claimed.decode(), b"legit-have")]
         assert claimed.decode() not in target._conns
+        assert target.mac_drops == 1  # the attack is countable
         sock.close()
     finally:
         network.close()
