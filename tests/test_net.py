@@ -1159,3 +1159,36 @@ def test_tls_churn_soak_no_thread_or_selector_leak(tls_contexts):
         lambda: threading.active_count() <= baseline + 1,
         timeout_s=10.0), \
         f"threads leaked: {threading.active_count()} vs {baseline}"
+
+
+def test_tls_client_silent_after_wrap_times_out(tls_contexts):
+    """A client that completes the TLS handshake and then sends no
+    identity bytes must be cut at the ABSOLUTE handshake deadline —
+    the deadline discipline flows through _SafeTls.recv's timeout,
+    not just plain-socket reads."""
+    import socket as socket_mod
+
+    from hlsjs_p2p_wrapper_tpu.engine import net as net_mod
+
+    server_ctx, client_ctx = tls_contexts
+    network = TcpNetwork(psk=b"s", ssl_server_context=server_ctx,
+                         ssl_client_context=client_ctx)
+    orig = net_mod.HANDSHAKE_TIMEOUT_S
+    net_mod.HANDSHAKE_TIMEOUT_S = 0.6
+    try:
+        target = network.register()
+        host, port = target.peer_id.rsplit(":", 1)
+        raw = socket_mod.create_connection((host, int(port)),
+                                           timeout=3.0)
+        tls = client_ctx.wrap_socket(raw, server_hostname=host)
+        # TLS established; now go silent.  The server must give up.
+        start = time.monotonic()
+        tls.settimeout(5.0)
+        assert tls.recv(1) == b""  # orderly close from the server
+        elapsed = time.monotonic() - start
+        assert elapsed < 4.0, elapsed  # deadline, not forever
+        assert target.handshake_rejects == 1
+        tls.close()
+    finally:
+        net_mod.HANDSHAKE_TIMEOUT_S = orig
+        network.close()
