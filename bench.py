@@ -96,8 +96,10 @@ def scenario_sizes():
         # the sparse formulation's scale demonstration (VERDICT r2
         # next #1 asked for ≥32k; dense adjacency alone would need
         # 275 GB here) and the measured best-utilization point —
-        # the same program steps a 1M-peer swarm at ~370M
-        # peer-steps/s.
+        # the same program steps a 1M-peer swarm at ~260M
+        # peer-steps/s (the 1M shape fuses less efficiently under
+        # the current XLA; the round-4 code measures the same there,
+        # so it is toolchain behavior, not model cost).
         peers = int(os.environ.get("BENCH_PEERS", 262144))
         # 2,400 steps (600 s of a 1,024 s timeline; every peer still
         # mid-stream at the horizon, playhead_mean ≈ 570 s): long
