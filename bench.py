@@ -313,7 +313,7 @@ def main():
         "peers": P, "segments": S, "steps": T, "degree": DEGREE,
         "formulation": "circulant roll/stencil over bit-packed "
                        "availability, O(P·K), shipped agent config "
-                       "(admission cap + frictions; round 4)",
+                       "(admission cap + frictions + holder pinning; rounds 4-5)",
         "host_model": "same sparse model, vectorized NumPy",
         "final_offload": round(float(offload_ratio(final)), 4),
         "host_peer_steps_per_sec": round(host_throughput, 1),
