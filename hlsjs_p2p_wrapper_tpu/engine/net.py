@@ -265,9 +265,13 @@ class _SafeTls:
     def sendall(self, data: bytes) -> None:
         import ssl
         view = memoryview(data)
+        deadline = (time.monotonic() + self._timeout
+                    if self._timeout is not None else None)
         while view.nbytes:
             if self._closed:
                 raise OSError("TLS connection closed")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise socket.timeout("timed out")  # OSError: caller drops
             want_write = True
             with self._lock:
                 try:
@@ -281,10 +285,12 @@ class _SafeTls:
             self._wait(want_write)
 
     def settimeout(self, value) -> None:
-        """Honored by ``recv`` as an absolute per-call budget — the
-        identity handshake's deadline discipline (``_read_exact``)
-        must keep binding after the TLS wrap, or a post-TLS dribbler
-        would pin the handshake thread the old way."""
+        """Honored by ``recv`` AND ``sendall`` as an absolute per-call
+        budget — the identity handshake's deadline discipline
+        (``_read_exact`` / ``_send_with_deadline``) must keep binding
+        after the TLS wrap, or a post-TLS dribbler (or a
+        never-writable backpressuring peer) would pin the handshake
+        thread the old way."""
         self._timeout = value
 
     def getpeername(self):
@@ -554,14 +560,16 @@ class _Connection:
                     return None  # _tls_wrap owns failure cleanup
                 sock = tls
             raw = self.endpoint.peer_id.encode()
-            sock.sendall(_LEN.pack(len(raw)) + raw)
+            _send_with_deadline(sock, _LEN.pack(len(raw)) + raw,
+                                deadline)
             psk = self.endpoint.network.psk
             if psk is not None:
                 # prove swarm membership before any protocol frame;
                 # contribute our own nonce so the per-connection frame
                 # keys are fresh even if the acceptor's nonce repeats
                 c_nonce = os.urandom(NONCE_LEN)
-                sock.sendall(_LEN.pack(len(c_nonce)) + c_nonce)
+                _send_with_deadline(
+                    sock, _LEN.pack(len(c_nonce)) + c_nonce, deadline)
                 a_nonce = _read_frame(sock, max_bytes=MAX_AUTH_BYTES,
                                       deadline=deadline)
                 # exact-length check (see NONCE_LEN): a variable-length
@@ -570,7 +578,8 @@ class _Connection:
                     sock.close()
                     return None
                 mac = _psk_response(psk, a_nonce, c_nonce, raw)
-                sock.sendall(_LEN.pack(len(mac)) + mac)
+                _send_with_deadline(sock, _LEN.pack(len(mac)) + mac,
+                                    deadline)
                 c2a, a2c = _derive_frame_keys(psk, a_nonce, c_nonce, raw)
                 self.send_key, self.recv_key = c2a, a2c
             sock.settimeout(None)  # handshake timeout must not poison recv
@@ -627,6 +636,24 @@ def _read_exact(sock: socket.socket, n: int,
     return bytes(buf)
 
 
+def _send_with_deadline(sock: socket.socket, data: bytes,
+                        deadline: float) -> None:
+    """Handshake-side write under the REMAINING absolute budget —
+    the write mirror of ``_read_exact``'s deadline discipline.  A
+    backpressuring peer (zero receive window, never reads) blocks
+    ``sendall`` just as effectively as a byte-dribbler blocks
+    ``recv``, and each pinned handshake thread holds a
+    MAX_PENDING_HANDSHAKES slot; plain sockets treat ``settimeout``
+    as an overall sendall deadline, and ``_SafeTls`` honors it in
+    its want-write loop.  Raises ``OSError`` on expiry like any
+    other torn-down-connection write."""
+    remaining = deadline - time.monotonic()
+    if remaining <= 0:
+        raise socket.timeout("handshake deadline exceeded")
+    sock.settimeout(remaining)
+    sock.sendall(data)
+
+
 def _read_frame(sock: socket.socket,
                 max_bytes: int = MAX_FRAME_BYTES,
                 deadline: Optional[float] = None) -> Optional[bytes]:
@@ -649,6 +676,14 @@ class TcpEndpoint:
         self.loop = network.loop
         self.on_receive: Optional[Callable[[str, bytes], None]] = None
         self.closed = False
+        #: traffic totals, deliberately UNLOCKED best-effort ``+=``
+        #: from every writer/reader thread: they feed throughput
+        #: dashboards where a dropped increment under a GIL-release
+        #: race skews a rate chart by one frame, which is noise —
+        #: unlike the attack counters below, whose bursts are exactly
+        #: the moments contended increments get lost, so those take
+        #: ``_stats_lock`` (_count).  Don't "fix" the asymmetry by
+        #: locking these: they sit on the per-frame hot path.
         self.bytes_sent = 0
         self.bytes_received = 0
         #: attack visibility (SECURITY.md): EVERY inbound handshake
@@ -877,8 +912,14 @@ class TcpEndpoint:
             # holds the swarm PSK for THIS nonce
             a_nonce = os.urandom(NONCE_LEN)
             try:
-                sock.sendall(_LEN.pack(len(a_nonce)) + a_nonce)
+                # deadline-bounded write: a connector that opens the
+                # connection and never reads would otherwise block
+                # this sendall indefinitely, pinning the
+                # MAX_PENDING_HANDSHAKES slot its dial consumed
+                _send_with_deadline(
+                    sock, _LEN.pack(len(a_nonce)) + a_nonce, deadline)
             except OSError:
+                self._count("handshake_rejects")
                 sock.close()
                 return
             c_nonce = _read_frame(sock, max_bytes=MAX_AUTH_BYTES,
@@ -904,6 +945,10 @@ class TcpEndpoint:
         try:
             sock.settimeout(None)  # handshake done; reads block freely
         except OSError:
+            # the peer passed auth but the socket died under us before
+            # registration — still a turned-away inbound handshake,
+            # and alerting should see it
+            self._count("handshake_rejects")
             sock.close()
             return
         conn = _Connection(self, remote_id, sock)

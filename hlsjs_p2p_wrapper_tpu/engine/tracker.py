@@ -146,20 +146,27 @@ class Tracker:
                     self._creates_by_source.get(key, 0) + 1
         if key is not None and peer_id in swarm:
             owner = self._member_source.get((swarm_id, peer_id))
-            if owner is not None and owner != key:
+            if owner is not None and owner != key and source != peer_id:
                 # a membership another source owns: answer the peer
                 # list but touch NOTHING — refreshing the lease or
                 # recency here would let an attacker keep a crashed
                 # victim alive at the head of discovery forever (and
                 # at zero quota cost).  The announce bodies are
-                # unauthenticated, so ownership is the only signal.
+                # unauthenticated, so ownership is the usual signal —
+                # EXCEPT when the announcer's address-verified
+                # transport id IS the claimed peer id (source ==
+                # peer_id): that peer self-evidently owns its own
+                # listen address, so a squatter who announced it first
+                # must not lock the real peer out of its lease
+                # (SECURITY.md: claim-squatting).
                 others = [p for p in swarm if p != peer_id]
                 others.reverse()
                 return others[: self.max_peers_returned]
         known = swarm.pop(peer_id, None) is not None
         if known or len(swarm) < self.MAX_MEMBERS_PER_SWARM:
             if key is not None:
-                self._attribute_member(swarm_id, peer_id, key)
+                self._attribute_member(swarm_id, peer_id, key,
+                                       reclaim=(source == peer_id))
             # re-insert to refresh both lease and recency order
             swarm[peer_id] = now + self.lease_ms
         others = [p for p in swarm if p != peer_id]
@@ -167,7 +174,7 @@ class Tracker:
         return others[: self.max_peers_returned]
 
     def _attribute_member(self, swarm_id: str, peer_id: str,
-                          key: str) -> None:
+                          key: str, reclaim: bool = False) -> None:
         """Charge ``(swarm_id, peer_id)`` to source ``key``, evicting
         the source's own least-recently-refreshed membership at its
         quota — one squatter can fill only its own bucket, never the
@@ -175,15 +182,22 @@ class Tracker:
         mkey = (swarm_id, peer_id)
         prior = self._member_source.get(mkey)
         if prior is not None and prior != key:
-            # FIRST attribution wins while the membership lives: the
-            # ANNOUNCE body's peer id is unauthenticated, so letting a
-            # different source re-charge an existing membership to its
-            # own bucket would let an attacker adopt victims'
-            # memberships and then evict them via its own LRU — the
-            # exact cross-source denial the quotas exist to stop.  A
-            # peer that genuinely moves hosts re-attributes when its
-            # old lease expires.
-            return
+            if not reclaim:
+                # FIRST attribution wins while the membership lives:
+                # the ANNOUNCE body's peer id is unauthenticated, so
+                # letting a different source re-charge an existing
+                # membership to its own bucket would let an attacker
+                # adopt victims' memberships and then evict them via
+                # its own LRU — the exact cross-source denial the
+                # quotas exist to stop.  A peer that genuinely moves
+                # hosts re-attributes when its old lease expires.
+                return
+            # reclaim: the announcer's address-verified transport id
+            # equals the claimed peer id — stronger evidence of
+            # ownership than announce order, so the prior (squatted)
+            # attribution is uncharged and the membership moves to
+            # its rightful bucket
+            self._remove_member_attribution(swarm_id, peer_id)
         bucket = self._members_by_source.setdefault(key, {})
         if mkey not in bucket and len(bucket) >= self.MAX_MEMBERS_PER_SOURCE:
             victim_swarm, victim_peer = next(iter(bucket))

@@ -60,7 +60,14 @@ Scheduler-policy knobs (urgency margin, P2P time budget, request
 timeout, live-edge spread, announce lag) are **dynamic scenario
 fields**, not compile-time constants: they only feed ``jnp``
 arithmetic, so a whole policy grid reuses ONE compiled program
-(``tools/sweep.py`` sweeps them recompile-free).
+(``tools/sweep.py`` sweeps them recompile-free).  And because
+``SwarmScenario`` is all-dynamic, the grid has a SCENARIO AXIS for
+free: :func:`run_swarm_batch` ``vmap``s the scanned step over a
+stacked ``[B]`` scenario batch, so the whole grid is ONE device
+dispatch (donated carry, no per-point Python round-trips), and the
+batch shards across chips over the ``scenarios`` mesh axis
+(parallel/mesh.py) with zero added cross-device traffic — scenarios
+never exchange bytes.
 
 How far to trust this model is a measured quantity, not a hope:
 ``tests/test_sim_vs_harness_parity.py`` holds it to the discrete
@@ -76,7 +83,6 @@ cross-device ops under a sharded mesh.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -1185,9 +1191,10 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         holder_penalty_ms=pen, dl_holder_off=stack("holder_off"))
 
 
-@partial(jax.jit, static_argnames=("config", "n_steps"))
-def _run_swarm(config: SwarmConfig, scenario: SwarmScenario,
-               state: SwarmState, n_steps: int):
+def _scan_swarm(config: SwarmConfig, scenario: SwarmScenario,
+                state: SwarmState, n_steps: int):
+    """The scanned step — shared body of the single-scenario and
+    scenario-batched entry points (each jits it separately)."""
     def step(carry, _):
         new = swarm_step(config, scenario, carry)
         p2p = jnp.sum(new.p2p_bytes)
@@ -1195,6 +1202,166 @@ def _run_swarm(config: SwarmConfig, scenario: SwarmScenario,
         return new, p2p / jnp.maximum(total, 1.0)
 
     return jax.lax.scan(step, state, None, length=n_steps)
+
+
+_run_swarm = jax.jit(_scan_swarm, static_argnames=("config", "n_steps"))
+
+
+def _run_swarm_batch_impl(config: SwarmConfig, scenarios: SwarmScenario,
+                          states: SwarmState, n_steps: int):
+    return jax.vmap(
+        lambda scenario, state: _scan_swarm(config, scenario, state,
+                                            n_steps))(scenarios, states)
+
+
+#: lazily-jitted batched runner: the donation decision needs the
+#: backend, which must not be initialized at import time
+_RUN_SWARM_BATCH = None
+
+
+def _batched_runner():
+    global _RUN_SWARM_BATCH
+    if _RUN_SWARM_BATCH is None:
+        # donate the [B, P, …] state carry so the batched swarm state
+        # never double-buffers in HBM (at 1M peers × a 16-scenario
+        # chunk the state is multi-GB); CPU has no donation support
+        # and would only warn, so donate on accelerators alone
+        donate = (2,) if jax.default_backend() in ("tpu", "gpu") else ()
+        _RUN_SWARM_BATCH = jax.jit(_run_swarm_batch_impl,
+                                   static_argnames=("config", "n_steps"),
+                                   donate_argnums=donate)
+    return _RUN_SWARM_BATCH
+
+
+def stack_pytrees(items):
+    """Stack same-shaped pytrees (scenarios or states) along a new
+    leading SCENARIO axis — the host-side assembly step for
+    :func:`run_swarm_batch`."""
+    items = list(items)
+    if not items:
+        raise ValueError("cannot stack an empty scenario batch")
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *items)
+
+
+def run_swarm_scenario(config: SwarmConfig, scenario: SwarmScenario,
+                       state: SwarmState, n_steps: int):
+    """Scan one PRE-BUILT scenario (the :func:`make_scenario` output)
+    — the sequential reference path the batched engine is
+    parity-tested against; :func:`run_swarm` is this plus scenario
+    construction from keywords."""
+    state = ensure_penalty_width(config, scenario, state)
+    return _run_swarm(config, scenario, state, n_steps)
+
+
+def run_swarm_batch(config: SwarmConfig, scenarios: SwarmScenario,
+                    states: SwarmState, n_steps: int):
+    """Scan a whole SCENARIO BATCH as one device program.
+
+    ``scenarios``/``states`` are :func:`stack_pytrees`-stacked along a
+    leading ``[B]`` axis; the scanned step is ``vmap``-ed over it, so
+    a policy grid that shares one static ``SwarmConfig`` runs as ONE
+    compiled dispatch instead of B sequential ones (``SwarmScenario``
+    is all-dynamic by construction, so B × the policy knobs reuse one
+    compile).  The state carry is donated on accelerators — the
+    ``[B, P, …]`` swarm state never double-buffers in HBM — which
+    means the passed ``states`` buffers are CONSUMED: build fresh
+    ones per call (the tools do).  Scenarios are embarrassingly
+    parallel: under a ``scenarios`` mesh axis (parallel/mesh.py) the
+    batch shards across chips with zero added cross-device traffic —
+    the circulant halo bytes stay per-peer-axis only, a property
+    ``__graft_entry__`` checks on the compiled HLO.
+
+    Returns ``(final states [B, …], offload-over-time [B, n_steps])``,
+    bit-identical per lane to looping :func:`run_swarm_scenario`
+    (pinned by tests/test_swarm_batch.py)."""
+    states = ensure_penalty_width_batch(config, scenarios, states)
+    return _batched_runner()(config, scenarios, states, n_steps)
+
+
+def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
+                      *, watch_s: float, chunk: int):
+    """Chunked, pipelined host front-end for :func:`run_swarm_batch` —
+    the dispatch engine shared by ``tools/sweep.py`` and
+    ``tools/policy_ab.py``.
+
+    ``build(item)`` returns one item's ``(scenario, join_s [P])``
+    pair; items are dispatched in fixed-size chunks (the tail chunk
+    padded by repeating its last scenario, so every dispatch reuses
+    ONE compiled ``[B, P, …]`` program), and each chunk's host
+    readback is pipelined one chunk behind the device: the ONLY
+    host-blocking step reads the two ``[B]`` metric vectors of the
+    chunk dispatched one iteration ago, while the device computes the
+    current one.  Returns per-item ``(offload, rebuffer)`` floats in
+    item order; padded lanes are dropped at readback."""
+    items = list(items)
+    if not items:
+        return []
+    batch = min(chunk, len(items))
+    out = []
+    pending = None  # (n real lanes, offloads [B], rebuffers [B])
+
+    def drain(entry):
+        n, offs, rebs = entry
+        out.extend((float(o), float(r))
+                   for o, r in zip(offs[:n], rebs[:n]))
+
+    for i in range(0, len(items), batch):
+        chunk_items = items[i:i + batch]
+        built = [build(item) for item in chunk_items]
+        built += [built[-1]] * (batch - len(built))
+        scenarios = stack_pytrees([sc for sc, _ in built])
+        joins = jnp.stack([j for _, j in built])
+        states = stack_pytrees([init_swarm(config)] * batch)
+        finals, _ = run_swarm_batch(config, scenarios, states, n_steps)
+        offs = offload_ratio_batch(finals)
+        rebs = rebuffer_ratio_batch(finals, watch_s, joins)
+        if pending is not None:
+            drain(pending)
+        pending = (len(chunk_items), offs, rebs)
+    drain(pending)
+    return out
+
+
+def ensure_penalty_width_batch(config: SwarmConfig,
+                               scenarios: SwarmScenario,
+                               states: SwarmState) -> SwarmState:
+    """Batched :func:`ensure_penalty_width`: resize a pristine
+    ``[B, P, K]`` penalty field to the width this config reads."""
+    if config.holder_selection != "adaptive":
+        k_topo = 0
+    elif config.neighbor_offsets is not None:
+        k_topo = len(_normalized_offsets(config.neighbor_offsets,
+                                         config.n_peers))
+    else:
+        k_topo = scenarios.neighbors.shape[-1]
+    pen = states.holder_penalty_ms
+    if pen.shape[-1] != k_topo and not bool(jnp.any(pen > 0.0)):
+        states = states._replace(holder_penalty_ms=jnp.zeros(
+            (pen.shape[0], config.n_peers, k_topo), jnp.float32))
+    return states
+
+
+def offload_ratio_batch(states: SwarmState) -> jax.Array:
+    """Per-scenario offload ratios ``[B]`` for a stacked final state."""
+    return jax.vmap(offload_ratio)(states)
+
+
+def rebuffer_ratio_batch(states: SwarmState, elapsed_s: float,
+                         join_s=None, leave_s=None) -> jax.Array:
+    """Per-scenario rebuffer ratios ``[B]``; ``join_s``/``leave_s``
+    are ``[B, P]`` when given (same denominator contract as
+    :func:`rebuffer_ratio`)."""
+    if join_s is None and leave_s is None:
+        return jax.vmap(lambda st: rebuffer_ratio(st, elapsed_s))(states)
+    B, P = states.rebuffer_s.shape
+    join = (jnp.zeros((B, P), jnp.float32) if join_s is None
+            else jnp.asarray(join_s, jnp.float32))
+    if leave_s is None:
+        return jax.vmap(
+            lambda st, j: rebuffer_ratio(st, elapsed_s, j))(states, join)
+    return jax.vmap(
+        lambda st, j, l: rebuffer_ratio(st, elapsed_s, j, l))(
+            states, join, jnp.asarray(leave_s, jnp.float32))
 
 
 def run_swarm(config: SwarmConfig, bitrates: jax.Array,

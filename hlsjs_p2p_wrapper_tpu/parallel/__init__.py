@@ -1,10 +1,17 @@
 """Multi-chip scaling: device meshes + canonical shardings for the
-swarm simulator (peers = data axis, segments = optional second axis)."""
+swarm simulator (peers = data axis, segments = optional second axis,
+scenarios = the sweep-grid batch axis)."""
 
-from .mesh import (CHIP_AXIS, HOST_AXIS, PEER_AXIS, SEGMENT_AXIS,
-                   make_mesh, make_multihost_mesh, scenario_shardings,
-                   shard_swarm, sharded_run, state_shardings)
+from .mesh import (CHIP_AXIS, HOST_AXIS, PEER_AXIS, SCENARIO_AXIS,
+                   SEGMENT_AXIS, batch_scenario_shardings,
+                   batch_state_shardings, make_mesh, make_multihost_mesh,
+                   make_scenario_mesh, scenario_shardings, shard_swarm,
+                   shard_swarm_batch, sharded_run, sharded_run_batch,
+                   state_shardings)
 
-__all__ = ["CHIP_AXIS", "HOST_AXIS", "PEER_AXIS", "SEGMENT_AXIS",
-           "make_mesh", "make_multihost_mesh", "scenario_shardings",
-           "shard_swarm", "sharded_run", "state_shardings"]
+__all__ = ["CHIP_AXIS", "HOST_AXIS", "PEER_AXIS", "SCENARIO_AXIS",
+           "SEGMENT_AXIS", "batch_scenario_shardings",
+           "batch_state_shardings", "make_mesh", "make_multihost_mesh",
+           "make_scenario_mesh", "scenario_shardings", "shard_swarm",
+           "shard_swarm_batch", "sharded_run", "sharded_run_batch",
+           "state_shardings"]

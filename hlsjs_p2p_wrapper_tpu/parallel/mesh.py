@@ -30,7 +30,15 @@ Halo cost is amortized to noise for any realistic shard size, i.e.
 near-ideal weak scaling; contrast round 2's dense form, whose
 sharded eligibility matvec moved O((P/D)·P) bytes per device per
 step.  The scan carries everything else device-local; nothing
-crosses DCN."""
+crosses DCN.
+
+A third data axis, **scenarios**, carries the sweep grid
+(``run_swarm_batch``): no simulator op crosses the batch dim, so a
+grid sharded over a ``(scenarios,)`` mesh compiles to a program with
+NO collectives at all — perfect scaling by construction — and a
+``(scenarios, peers)`` mesh keeps the per-lane halo bytes exactly as
+above (``__graft_entry__._assert_batch_ici_lowering`` pins both on
+the compiled HLO)."""
 
 from __future__ import annotations
 
@@ -44,6 +52,15 @@ from ..ops.swarm_sim import SwarmConfig, SwarmScenario, SwarmState
 
 PEER_AXIS = "peers"
 SEGMENT_AXIS = "segments"
+#: scenario-batch axis (run_swarm_batch): scenarios are
+#: embarrassingly parallel — no simulator op crosses the batch axis —
+#: so sharding a sweep grid over chips adds ZERO cross-device bytes.
+#: On a (scenarios,) mesh the compiled program has no collectives at
+#: all; on a (scenarios, peers) mesh the circulant halo exchange
+#: stays per-peer-axis with per-LANE bytes unchanged
+#: (__graft_entry__._assert_batch_ici_lowering checks both on the
+#: compiled HLO).
+SCENARIO_AXIS = "scenarios"
 #: multi-host deployment axes: ``hosts`` is the DCN (inter-host)
 #: dimension, ``chips`` the ICI (intra-host) dimension.  The peer axis
 #: shards over BOTH, hosts-major, so of a host's two shard boundaries
@@ -92,13 +109,38 @@ def make_multihost_mesh(n_hosts: int, chips_per_host: int,
     return Mesh(grid, (HOST_AXIS, CHIP_AXIS))
 
 
+def make_scenario_mesh(devices: Optional[Sequence] = None,
+                       peer_shards: int = 1) -> Mesh:
+    """Build a ``(scenarios, peers)`` mesh for scenario-batched sweeps
+    (:func:`run_swarm_batch`): the grid's batch axis splits across
+    ``n // peer_shards`` device groups, each group sharding its lanes'
+    peer axis ``peer_shards`` ways.  ``peer_shards=1`` (the right
+    default for sweep grids — whole scenarios per chip, zero
+    collectives) leaves the peer axis unsharded."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % peer_shards:
+        raise ValueError(f"{n} devices not divisible into "
+                         f"{peer_shards} peer shards")
+    if peer_shards == 1:
+        # scenarios-only mesh: leave the peer axis out entirely so
+        # the compiled program provably has no peer-axis collectives
+        # (a size-1 mesh axis would still name the dim "sharded")
+        return Mesh(np.array(devices), (SCENARIO_AXIS,))
+    grid = np.array(devices).reshape(n // peer_shards, peer_shards)
+    return Mesh(grid, (SCENARIO_AXIS, PEER_AXIS))
+
+
 def _peer_spec(mesh: Mesh):
     """The PartitionSpec entry for the peer axis on this mesh: the
-    ``peers`` axis when present, else ALL mesh axes combined
-    (hosts-major multi-host sharding)."""
+    ``peers`` axis when present, else all NON-batch mesh axes combined
+    (hosts-major multi-host sharding); ``None`` (unsharded) on a
+    scenarios-only mesh."""
     if PEER_AXIS in mesh.axis_names:
         return PEER_AXIS
-    return tuple(mesh.axis_names)
+    rest = tuple(a for a in mesh.axis_names
+                 if a not in (SCENARIO_AXIS, SEGMENT_AXIS))
+    return rest if rest else None
 
 
 def state_shardings(mesh: Mesh) -> SwarmState:
@@ -176,3 +218,52 @@ def sharded_run(mesh: Mesh, config: SwarmConfig, bitrates, neighbors,
     scenario, state = shard_swarm(mesh, scenario, state)
     with mesh:
         return _run_swarm(config, scenario, state, n_steps)
+
+
+def _lift_batch(mesh: Mesh, shardings):
+    """Prepend the scenario axis to a per-scenario sharding pytree:
+    every stacked ``[B, …]`` leaf splits its batch dim over
+    ``scenarios`` (when the mesh has that axis) and keeps its
+    per-scenario dims' placement."""
+    batch = SCENARIO_AXIS if SCENARIO_AXIS in mesh.axis_names else None
+    return jax.tree_util.tree_map(
+        lambda ns: NamedSharding(mesh, P(batch, *ns.spec)), shardings)
+
+
+def batch_scenario_shardings(mesh: Mesh) -> SwarmScenario:
+    """Shardings for a :func:`stack_pytrees`-stacked scenario batch:
+    leading ``[B]`` axis over ``scenarios``, per-peer axes as in
+    :func:`scenario_shardings`.  (The formerly replicated policy
+    scalars are ``[B]`` arrays in a batch — they shard over the
+    scenario axis like everything else.)"""
+    return _lift_batch(mesh, scenario_shardings(mesh))
+
+
+def batch_state_shardings(mesh: Mesh) -> SwarmState:
+    """Shardings for a stacked ``[B, P, …]`` state batch."""
+    return _lift_batch(mesh, state_shardings(mesh))
+
+
+def shard_swarm_batch(mesh: Mesh, scenarios: SwarmScenario,
+                      states: SwarmState):
+    """Place a stacked scenario/state batch onto the mesh with the
+    canonical batch shardings."""
+    scenarios = jax.tree_util.tree_map(jax.device_put, scenarios,
+                                       batch_scenario_shardings(mesh))
+    states = jax.tree_util.tree_map(jax.device_put, states,
+                                    batch_state_shardings(mesh))
+    return scenarios, states
+
+
+def sharded_run_batch(mesh: Mesh, config: SwarmConfig,
+                      scenarios: SwarmScenario, states: SwarmState,
+                      n_steps: int):
+    """Run :func:`run_swarm_batch` with the batch sharded over the
+    mesh: scenario lanes split across chips (embarrassingly parallel —
+    zero cross-device traffic on the scenario axis), and within each
+    lane group the peer axis shards as usual when the mesh carries a
+    ``peers`` axis."""
+    from ..ops.swarm_sim import run_swarm_batch
+    scenarios, states = shard_swarm_batch(mesh, scenarios, states)
+    with mesh:
+        return run_swarm_batch(config, scenarios, states, n_steps)

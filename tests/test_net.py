@@ -277,6 +277,39 @@ def test_byte_dribbling_handshake_hits_absolute_deadline():
         network.close()
 
 
+def test_handshake_write_deadline_cuts_backpressuring_peer():
+    """The write mirror of the dribbler test: a peer that opens a
+    connection and never READS can block a handshake-side sendall
+    just as effectively as a dribbler blocks recv, pinning a
+    MAX_PENDING_HANDSHAKES slot.  _send_with_deadline must expire at
+    the remaining absolute budget instead of blocking forever."""
+    import socket as socket_mod
+
+    from hlsjs_p2p_wrapper_tpu.engine.net import _send_with_deadline
+
+    a, b = socket_mod.socketpair()
+    try:
+        a.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_SNDBUF, 4096)
+        b.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_RCVBUF, 4096)
+        # fill the pipe: b never reads, so a's buffers jam
+        a.settimeout(0.05)
+        with pytest.raises(OSError):
+            while True:
+                a.sendall(b"x" * 65536)
+        start = time.monotonic()
+        with pytest.raises(OSError):
+            _send_with_deadline(a, b"y" * 65536,
+                                deadline=time.monotonic() + 0.3)
+        elapsed = time.monotonic() - start
+        assert elapsed < 3.0, elapsed  # deadline bound, not a hang
+        # an already-spent deadline refuses up front
+        with pytest.raises(OSError):
+            _send_with_deadline(a, b"z", deadline=time.monotonic() - 1.0)
+    finally:
+        a.close()
+        b.close()
+
+
 def test_psk_silent_client_times_out_handshake():
     """A connection that sends a preamble but never answers the
     challenge is dropped after HANDSHAKE_TIMEOUT_S — it must not pin

@@ -1,0 +1,166 @@
+"""Scenario-batched sweep engine (ops/swarm_sim.py run_swarm_batch):
+the batched path must be a pure performance transform — bit-identical
+per lane to looping the sequential reference — and the ``scenarios``
+mesh axis must not change results when the batch shards across the
+8 virtual CPU devices (conftest)."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (
+    SwarmConfig, init_swarm, make_scenario, offload_ratio,
+    offload_ratio_batch, rebuffer_ratio, rebuffer_ratio_batch,
+    ring_offsets, run_swarm_batch, run_swarm_scenario, stack_pytrees)
+from hlsjs_p2p_wrapper_tpu.parallel import (make_scenario_mesh,
+                                            sharded_run_batch)
+
+BITRATES = jnp.array([300_000.0, 800_000.0, 2_000_000.0])
+WATCH_S = 30.0
+
+
+def batch_fixture(n_lanes=5, peers=48, segments=32):
+    """One static config + ``n_lanes`` scenarios that differ in
+    DYNAMIC policy knobs only (the sweep-grid shape: one compile,
+    many scenarios)."""
+    config = SwarmConfig(n_peers=peers, n_segments=segments, n_levels=3,
+                         neighbor_offsets=ring_offsets(8))
+    cdn = jnp.full((peers,), 8_000_000.0)
+    join = jnp.linspace(0.0, 20.0, peers)
+    scenarios = [
+        make_scenario(config, BITRATES, None, cdn, join,
+                      urgent_margin_s=0.5 + 2.0 * lane,
+                      p2p_budget_cap_ms=3_000.0 + 1_500.0 * lane)
+        for lane in range(n_lanes)]
+    n_steps = int(WATCH_S * 1000.0 / config.dt_ms)
+    return config, scenarios, join, n_steps
+
+
+def test_batched_metrics_bit_exact_vs_sequential_loop():
+    """The acceptance bar: the same scenarios through
+    ``run_swarm_batch`` and a looped ``run_swarm_scenario`` report
+    bit-identical offload and rebuffer ratios (the numbers the sweep
+    tools publish)."""
+    config, scenarios, join, n_steps = batch_fixture()
+    seq = [run_swarm_scenario(config, sc, init_swarm(config), n_steps)
+           for sc in scenarios]
+    finals, series = run_swarm_batch(
+        config, stack_pytrees(scenarios),
+        stack_pytrees([init_swarm(config)] * len(scenarios)), n_steps)
+
+    offs = offload_ratio_batch(finals)
+    rebs = rebuffer_ratio_batch(
+        finals, WATCH_S, jnp.stack([join] * len(scenarios)))
+    for lane, (final, lane_series) in enumerate(seq):
+        assert float(offs[lane]) == float(offload_ratio(final)), \
+            f"lane {lane} offload diverged from the sequential path"
+        assert float(rebs[lane]) == float(
+            rebuffer_ratio(final, WATCH_S, join)), \
+            f"lane {lane} rebuffer diverged from the sequential path"
+        # the whole offload-over-time series too, not just the endpoint
+        assert jnp.array_equal(series[lane], lane_series), \
+            f"lane {lane} offload series diverged"
+
+
+def test_batched_final_state_bit_exact_per_lane():
+    config, scenarios, _join, n_steps = batch_fixture(n_lanes=3)
+    finals, _ = run_swarm_batch(
+        config, stack_pytrees(scenarios),
+        stack_pytrees([init_swarm(config)] * 3), n_steps)
+    for lane, sc in enumerate(scenarios):
+        single, _ = run_swarm_scenario(config, sc, init_swarm(config),
+                                       n_steps)
+        for batched_leaf, single_leaf in zip(
+                jax.tree_util.tree_leaves(finals),
+                jax.tree_util.tree_leaves(single), strict=True):
+            assert jnp.array_equal(batched_leaf[lane], single_leaf), \
+                f"lane {lane} final state diverged"
+
+
+def test_lanes_are_independent():
+    """Adding lanes must not change existing lanes' results — the
+    scenario axis carries no cross-lane interaction by construction
+    (what makes it embarrassingly parallel across chips)."""
+    config, scenarios, _join, n_steps = batch_fixture(n_lanes=4)
+    small, _ = run_swarm_batch(
+        config, stack_pytrees(scenarios[:2]),
+        stack_pytrees([init_swarm(config)] * 2), n_steps)
+    big, _ = run_swarm_batch(
+        config, stack_pytrees(scenarios),
+        stack_pytrees([init_swarm(config)] * 4), n_steps)
+    assert jnp.array_equal(offload_ratio_batch(big)[:2],
+                           offload_ratio_batch(small))
+
+
+def test_stack_pytrees_rejects_empty_batch():
+    with pytest.raises(ValueError, match="empty"):
+        stack_pytrees([])
+
+
+# -- multi-device scenario sharding (8 virtual CPU devices) ------------
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_scenario_sharded_batch_matches_unsharded():
+    """One lane per device over the (scenarios,) mesh — the sharded
+    grid must report the same metrics as the same batch on one
+    device (zero cross-device interaction to get wrong)."""
+    config, scenarios, join, n_steps = batch_fixture(n_lanes=8)
+    stacked = stack_pytrees(scenarios)
+    joins = jnp.stack([join] * 8)
+
+    unsharded, _ = run_swarm_batch(
+        config, stacked, stack_pytrees([init_swarm(config)] * 8), n_steps)
+    mesh = make_scenario_mesh(jax.devices()[:8])
+    sharded, _ = sharded_run_batch(
+        config=config, mesh=mesh, scenarios=stacked,
+        states=stack_pytrees([init_swarm(config)] * 8), n_steps=n_steps)
+
+    assert jnp.array_equal(offload_ratio_batch(sharded),
+                           offload_ratio_batch(unsharded)), \
+        "scenario-sharded offload diverged from unsharded"
+    assert jnp.array_equal(rebuffer_ratio_batch(sharded, WATCH_S, joins),
+                           rebuffer_ratio_batch(unsharded, WATCH_S,
+                                                joins)), \
+        "scenario-sharded rebuffer diverged from unsharded"
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_hybrid_scenario_peer_mesh_matches_unsharded():
+    """The (scenarios, peers) hybrid: 2 scenario shards x 4-way peer
+    sharding.  The peer axis reorders f32 reductions across shard
+    boundaries, so this holds to the same tolerance as the existing
+    peer-sharded tests, not bit-exactness."""
+    config, scenarios, _join, n_steps = batch_fixture(n_lanes=4, peers=64)
+    stacked = stack_pytrees(scenarios)
+    unsharded, _ = run_swarm_batch(
+        config, stacked, stack_pytrees([init_swarm(config)] * 4), n_steps)
+    mesh = make_scenario_mesh(jax.devices()[:8], peer_shards=4)
+    sharded, _ = sharded_run_batch(
+        config=config, mesh=mesh, scenarios=stacked,
+        states=stack_pytrees([init_swarm(config)] * 4), n_steps=n_steps)
+    assert jnp.allclose(offload_ratio_batch(sharded),
+                        offload_ratio_batch(unsharded), atol=1e-4)
+
+
+# -- the sweep tool's engines agree ------------------------------------
+
+def test_sweep_grid_batched_equals_sequential_rows():
+    """tools/sweep.py end to end: the batched engine (chunked, padded
+    tail, pipelined readback) reports row-identical metrics to the
+    per-point sequential reference on a grid slice whose size does
+    NOT divide the chunk — the padding/drain bookkeeping is exactly
+    what this pins."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import sweep as sweep_tool
+
+    grid = sweep_tool.vod_grid()[:7]  # 7 % chunk(3) != 0: forces a pad
+    common = dict(peers=32, segments=16, watch_s=10.0, live=False,
+                  seed=0)
+    batched, _ = sweep_tool.run_grid_batched(grid, chunk=3, **common)
+    sequential, _ = sweep_tool.run_grid_sequential(grid, **common)
+    assert batched == sequential

@@ -332,6 +332,37 @@ def test_cross_source_member_adoption_blocked():
         Tracker.MAX_MEMBERS_PER_SOURCE = orig
 
 
+def test_owner_transport_id_reclaims_squatted_lease():
+    """ADVICE r5: first-announce-wins let a squatter own someone
+    else's peer id until lease expiry, locking the real peer out of
+    its own lease refresh.  A source whose OBSERVED transport id
+    equals the claimed peer id IS that peer — its announce reclaims
+    ownership (unchaining the squatter's quota bucket) and refreshes
+    the lease again.  The pre-claim residual (discovery-slot
+    occupation until the owner shows up, same-host forgery, NAT'd
+    announcers) stays documented in SECURITY.md."""
+    clock = VirtualClock()
+    tracker = Tracker(clock, lease_ms=100.0)
+    victim_id = "10.0.0.7:4000"
+    # squatter claims the victim's id first, from its own address
+    tracker.announce("s", victim_id, source="10.0.0.9:1")
+    assert tracker._member_source[("s", victim_id)] == "10.0.0.9"
+    # the real peer announces: observed transport id == claimed id
+    tracker.announce("s", victim_id, source=victim_id)
+    assert tracker._member_source[("s", victim_id)] == "10.0.0.7"
+    assert "10.0.0.9" not in tracker._members_by_source  # uncharged
+    # reclaimed = refreshable: survive past the squat-era expiry on
+    # the real peer's own cadence (pre-fix, the foreign-owner guard
+    # silently dropped these refreshes and the lease died at 100ms)
+    clock.advance(80.0)
+    tracker.announce("s", victim_id, source=victim_id)
+    clock.advance(80.0)
+    assert victim_id in tracker.members("s")
+    # a non-owner still cannot adopt it back
+    tracker.announce("s", victim_id, source="10.0.0.9:1")
+    assert tracker._member_source[("s", victim_id)] == "10.0.0.7"
+
+
 def test_foreign_leave_ignored():
     """A LEAVE for a membership another source owns is ignored — the
     body's peer id is unauthenticated and member removal must not be

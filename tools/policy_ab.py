@@ -29,8 +29,12 @@ Usage::
 Defaults: the random (tracker-like) mesh runs at 8,192 peers — its
 general [P, K] gather path pays TPU's per-element gather cost, so
 keep it small — and the ring runs at 262,144 on the circulant fast
-path.  Six compiles (2 topologies × 3 static policies); every uplink
-point reuses them (uplink is scenario data).
+path.  Six compiles (2 topologies × 3 static policies); since this
+round each compile's 20 regime cells (pattern × wave × uplink — all
+dynamic scenario data) run as chunked ``run_swarm_batch`` dispatches
+over a stacked scenario axis instead of 20 sequential
+dispatch+readback round-trips (``--chunk`` bounds the ``[B, P, …]``
+batch state; readback is pipelined one chunk behind the device).
 """
 
 import argparse
@@ -45,9 +49,8 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
-    SwarmConfig, init_swarm, offload_ratio, random_neighbors,
-    rebuffer_ratio, ring_offsets, run_swarm, stable_ranks,
-    staggered_joins)
+    SwarmConfig, make_scenario, random_neighbors, ring_offsets,
+    run_batch_chunked, stable_ranks, staggered_joins)
 
 BITRATE = 800_000.0
 UPLINK_GRID_MBPS = (1.2, 1.6, 2.4, 4.0, 10.0)
@@ -67,31 +70,34 @@ WAVES = ("stagger", "crowd")
 _TOPOLOGY_CACHE = {}
 
 
-def run_point(peers, segments, watch_s, uplink_bps, policy, seed,
-              topology, pattern="uniform", wave="stagger"):
-    if topology == "ring":
-        config = SwarmConfig(n_peers=peers, n_segments=segments,
-                             n_levels=1, max_concurrency=3,
-                             holder_selection=policy,
-                             neighbor_offsets=ring_offsets(8))
-        neighbors = None
-    else:  # "random": the tracker-fed mesh, where policy matters
-        if (peers, seed) not in _TOPOLOGY_CACHE:
-            _TOPOLOGY_CACHE[(peers, seed)] = random_neighbors(
-                peers, 8, seed)
-        neighbors = _TOPOLOGY_CACHE[(peers, seed)]
-        config = SwarmConfig(n_peers=peers, n_segments=segments,
-                             n_levels=1, max_concurrency=3,
-                             holder_selection=policy)
-    # INDEPENDENT seeded permutations for the two splits: reusing one
-    # ranks array would make every t=0 seed slow and every fast peer
-    # a latecomer in hetero×crowd cells — a confound, not a regime
-    wave_ranks = stable_ranks(peers, seed)
-    speed_ranks = stable_ranks(peers, seed + 1)
+def build_audience(peers, seed):
+    """The seed-only per-peer arrays every cell of one topology
+    shares, built ONCE per (peers, seed) instead of per cell —
+    O(grid) host PRNG work would otherwise sit on the dispatch path
+    the batched engine exists to clear (the same reasoning as
+    sweep.py's ``_ARRAY_CACHE``).
+
+    INDEPENDENT seeded permutations for the two splits: reusing one
+    ranks array would make every t=0 seed slow and every fast peer
+    a latecomer in hetero×crowd cells — a confound, not a regime."""
+    return {"wave_ranks": stable_ranks(peers, seed),
+            "speed_ranks": stable_ranks(peers, seed + 1),
+            "stagger_join": staggered_joins(peers, 60.0, seed)}
+
+
+def build_cell_scenario(config, neighbors, audience, *, uplink_bps,
+                        pattern, wave, watch_s):
+    """One regime cell's dynamic scenario + its join times (the
+    rebuffer denominator) — pattern, wave, and uplink are all
+    scenario DATA, so every cell of one (topology, policy) compile
+    group batches into one program."""
+    peers = config.n_peers
+    speed_ranks = audience["speed_ranks"]
     if wave == "crowd":
-        join = jnp.where(wave_ranks < 0.25, 0.0, watch_s / 4.0)
+        join = jnp.where(audience["wave_ranks"] < 0.25, 0.0,
+                         watch_s / 4.0)
     else:
-        join = staggered_joins(peers, 60.0, seed)
+        join = audience["stagger_join"]
     if pattern == "hetero":
         # 10× speed ratio with the ARITHMETIC mean preserved (a bare
         # ±√10 split would inflate aggregate supply 74% and make
@@ -103,15 +109,26 @@ def run_point(peers, segments, watch_s, uplink_bps, policy, seed,
                            uplink_bps * f * root)
     else:
         uplink = jnp.full((peers,), uplink_bps)
+    scenario = make_scenario(config, jnp.array([BITRATE]), neighbors,
+                             jnp.full((peers,), 8_000_000.0), join,
+                             uplink_bps=uplink)
+    return scenario, join
+
+
+def run_cells_batched(config, neighbors, audience, cells, *, watch_s,
+                      chunk):
+    """All regime cells of one (topology, policy) compile group
+    through the shared chunked/pipelined dispatch engine
+    (``run_batch_chunked``); returns per-cell ``(offload, rebuffer)``
+    floats in cell order."""
     n_steps = int(watch_s * 1000.0 / config.dt_ms)
-    final, _ = run_swarm(config, jnp.array([BITRATE]), neighbors,
-                         jnp.full((peers,), 8_000_000.0),
-                         init_swarm(config), n_steps, join,
-                         uplink_bps=uplink)
-    return {
-        "offload": round(float(offload_ratio(final)), 4),
-        "rebuffer": round(float(rebuffer_ratio(final, watch_s, join)), 5),
-    }
+    metrics = run_batch_chunked(
+        config, cells,
+        lambda cell: build_cell_scenario(
+            config, neighbors, audience, uplink_bps=cell[2] * 1e6,
+            pattern=cell[0], wave=cell[1], watch_s=watch_s),
+        n_steps, watch_s=watch_s, chunk=chunk)
+    return [(round(off, 4), round(reb, 5)) for off, reb in metrics]
 
 
 def main():
@@ -124,9 +141,15 @@ def main():
     ap.add_argument("--segments", type=int, default=128)
     ap.add_argument("--watch-s", type=float, default=240.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--chunk", type=int, default=10,
+                    help="regime cells per batched dispatch (bounds "
+                         "the [B, P, ...] batch state on device)")
     ap.add_argument("--out", metavar="FILE",
                     help="write the A/B table as JSON")
     args = ap.parse_args()
+
+    cells = [(pattern, wave, up) for pattern in PATTERNS
+             for wave in WAVES for up in UPLINK_GRID_MBPS]
 
     t0 = time.perf_counter()
     tables = {}
@@ -135,51 +158,65 @@ def main():
     rebuffer_spread_max = 0.0
     for topology, peers in (("random", args.peers),
                             ("ring", args.ring_peers)):
+        audience = build_audience(peers, args.seed)
+        per_policy = {}
+        for policy in POLICIES:
+            if topology == "ring":
+                config = SwarmConfig(n_peers=peers,
+                                     n_segments=args.segments,
+                                     n_levels=1, max_concurrency=3,
+                                     holder_selection=policy,
+                                     neighbor_offsets=ring_offsets(8))
+                neighbors = None
+            else:  # "random": the tracker-fed mesh, where policy matters
+                if (peers, args.seed) not in _TOPOLOGY_CACHE:
+                    _TOPOLOGY_CACHE[(peers, args.seed)] = \
+                        random_neighbors(peers, 8, args.seed)
+                neighbors = _TOPOLOGY_CACHE[(peers, args.seed)]
+                config = SwarmConfig(n_peers=peers,
+                                     n_segments=args.segments,
+                                     n_levels=1, max_concurrency=3,
+                                     holder_selection=policy)
+            per_policy[policy] = run_cells_batched(
+                config, neighbors, audience, cells,
+                watch_s=args.watch_s, chunk=args.chunk)
         rows = []
-        for pattern in PATTERNS:
-            for wave in WAVES:
-                for uplink_mbps in UPLINK_GRID_MBPS:
-                    row = {"uplink_mbps": uplink_mbps,
-                           "pattern": pattern, "wave": wave}
-                    for policy in POLICIES:
-                        m = run_point(peers, args.segments,
-                                      args.watch_s,
-                                      uplink_mbps * 1e6, policy,
-                                      args.seed, topology,
-                                      pattern=pattern, wave=wave)
-                        row[f"{policy}_offload"] = m["offload"]
-                        row[f"{policy}_rebuffer"] = m["rebuffer"]
-                    # acceptance margin: the SHIPPED default (spread)
-                    # vs adaptive — the two QUANTITATIVE twins.
-                    # "ranked" is recorded but excluded from the bar:
-                    # it is the deliberately stylized swarm-global
-                    # herding bound (tests/test_sim_vs_harness_
-                    # parity.py module docstring), and in the
-                    # hetero/crowd cells where its sim column wins,
-                    # the harness check shows it actually LOSING to
-                    # both hash policies (see meta.harness_checks) —
-                    # using a direction-only model as an acceptance
-                    # alternative would exceed its warrant.
-                    row["default_margin"] = round(
-                        row["spread_offload"]
-                        - row["adaptive_offload"], 4)
-                    row["adaptive_vs_spread"] = round(
-                        row["adaptive_offload"]
-                        - row["spread_offload"], 4)
-                    cell = f"{topology}/{pattern}/{wave}@{uplink_mbps}M"
-                    if row["default_margin"] < worst["margin"]:
-                        worst = {"cell": cell,
-                                 "margin": row["default_margin"]}
-                    if row["adaptive_vs_spread"] > best["margin"]:
-                        best = {"cell": cell,
-                                "margin": row["adaptive_vs_spread"]}
-                    rebuffer_spread_max = max(
-                        rebuffer_spread_max,
-                        round(max(row[f"{p}_rebuffer"]
-                                  for p in POLICIES)
-                              - min(row[f"{p}_rebuffer"]
-                                    for p in POLICIES), 5))
-                    rows.append(row)
+        for i, (pattern, wave, uplink_mbps) in enumerate(cells):
+            row = {"uplink_mbps": uplink_mbps,
+                   "pattern": pattern, "wave": wave}
+            for policy in POLICIES:
+                off, reb = per_policy[policy][i]
+                row[f"{policy}_offload"] = off
+                row[f"{policy}_rebuffer"] = reb
+            # acceptance margin: the SHIPPED default (spread)
+            # vs adaptive — the two QUANTITATIVE twins.
+            # "ranked" is recorded but excluded from the bar:
+            # it is the deliberately stylized swarm-global
+            # herding bound (tests/test_sim_vs_harness_
+            # parity.py module docstring), and in the
+            # hetero/crowd cells where its sim column wins,
+            # the harness check shows it actually LOSING to
+            # both hash policies (see meta.harness_checks) —
+            # using a direction-only model as an acceptance
+            # alternative would exceed its warrant.
+            row["default_margin"] = round(
+                row["spread_offload"]
+                - row["adaptive_offload"], 4)
+            row["adaptive_vs_spread"] = round(
+                row["adaptive_offload"]
+                - row["spread_offload"], 4)
+            cell = f"{topology}/{pattern}/{wave}@{uplink_mbps}M"
+            if row["default_margin"] < worst["margin"]:
+                worst = {"cell": cell,
+                         "margin": row["default_margin"]}
+            if row["adaptive_vs_spread"] > best["margin"]:
+                best = {"cell": cell,
+                        "margin": row["adaptive_vs_spread"]}
+            rebuffer_spread_max = max(
+                rebuffer_spread_max,
+                round(max(row[f"{p}_rebuffer"] for p in POLICIES)
+                      - min(row[f"{p}_rebuffer"] for p in POLICIES), 5))
+            rows.append(row)
         tables[topology] = {"peers": peers, "rows": rows}
     elapsed = time.perf_counter() - t0
 
@@ -214,7 +251,8 @@ def main():
           f"spread across policies: {rebuffer_spread_max}")
     print(f"# 2 topologies x {len(PATTERNS)}x{len(WAVES)} regimes x "
           f"{len(UPLINK_GRID_MBPS)} uplink points x "
-          f"{len(POLICIES)} policies in {elapsed:.1f}s", file=sys.stderr)
+          f"{len(POLICIES)} policies in {elapsed:.1f}s "
+          f"(batched engine, chunk {args.chunk})", file=sys.stderr)
     if args.out:
         device = jax.devices()[0]
         with open(args.out, "w") as f:
@@ -224,6 +262,7 @@ def main():
                     "watch_s": args.watch_s, "bitrate": BITRATE,
                     "degree": 8, "seed": args.seed,
                     "elapsed_s": round(elapsed, 1),
+                    "engine": "batched", "chunk": args.chunk,
                     "platform": device.platform,
                     "device_kind": getattr(device, "device_kind", "?"),
                     "worst_default_margin": worst["margin"],

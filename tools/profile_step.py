@@ -1,7 +1,9 @@
 """Micro-profile of the swarm step's sparse pipeline on the current
 device: times isolated variants of the step's suspicious ops (neighbor
 gather, holder-load scatter-add, cache-map gather/scatter) to find
-what dominates.  Usage: python tools/profile_step.py [--peers N]"""
+what dominates, plus the scenario-batched dispatch vs the per-point
+Python loop (the sweep engine's amortization, run_swarm_batch).
+Usage: python tools/profile_step.py [--peers N] [--batch B]"""
 
 import argparse
 import os
@@ -36,6 +38,9 @@ def main():
     ap.add_argument("--peers", type=int, default=65536)
     ap.add_argument("--segments", type=int, default=256)
     ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=8,
+                    help="scenario-batch width for the grid-dispatch "
+                         "comparison")
     args = ap.parse_args()
     P, S, T = args.peers, args.segments, args.steps
     L, K = 3, 8
@@ -99,6 +104,44 @@ def main():
             x = jnp.where(x > 0.5, x * 0.99 + 0.01, x + 0.001)
         return x
     timeit(f"40 elementwise [P] ops x{T}", scanned(ew), vec)
+
+    # 7. grid dispatch: B scenarios through ONE vmapped scan
+    # (run_swarm_batch, the sweep engine) vs B sequential
+    # dispatch+readback round-trips — isolates the per-dispatch tax
+    # the batched engine amortizes (peers capped so the [B, P, …]
+    # batch state stays device-friendly)
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
+        init_swarm as init_b, run_swarm_batch, run_swarm_scenario,
+        stack_pytrees)
+    B = args.batch
+    Pb = min(P, 8192)
+    bconfig = SwarmConfig(n_peers=Pb, n_segments=S, n_levels=L)
+    bnbr = ring_neighbors(Pb, K)
+    bscens = [make_scenario(
+        bconfig, jnp.array([300_000.0, 800_000.0, 2_000_000.0]), bnbr,
+        jnp.full((Pb,), 8_000_000.0), urgent_margin_s=2.0 + i)
+        for i in range(B)]
+    stacked = stack_pytrees(bscens)
+
+    def batched():
+        states = stack_pytrees([init_b(bconfig)] * B)
+        return run_swarm_batch(bconfig, stacked, states, T)[0]
+
+    def looped():
+        # block on a scalar readback PER point: async dispatch would
+        # otherwise enqueue all B scans back-to-back and coalesce the
+        # B round-trips this comparison exists to isolate (the real
+        # sequential sweep reads each point's metric before the next
+        # dispatch, tools/sweep.py run_grid_sequential)
+        out = []
+        for sc in bscens:
+            final = run_swarm_scenario(bconfig, sc, init_b(bconfig), T)[0]
+            float(final.t_s)
+            out.append(final)
+        return out
+
+    timeit(f"batched {B}-scenario scan x{T} ({Pb} peers)", batched)
+    timeit(f"looped {B}x sequential scan x{T} ({Pb} peers)", looped)
 
 
 if __name__ == "__main__":

@@ -63,7 +63,10 @@ def main():
         artifact = {
             "meta": {
                 "what": "weak-scaling step time vs device count, full "
-                        "sharded swarm scan, 64 peers/shard",
+                        "sharded swarm scan, 64 peers/shard; the "
+                        "(scenarios,) row weak-scales over GRID SIZE "
+                        "instead (one sweep lane per device, zero "
+                        "collectives)",
                 "platform": "cpu (8 virtual devices on ONE physical "
                             "host: ideal weak scaling reads as "
                             "step_ms proportional to D; the per-shard "

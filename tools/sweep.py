@@ -1,11 +1,39 @@
-"""Design-space sweep: the device simulator's concrete payoff.
+"""Design-space sweep on the scenario-batched engine.
 
 Runs the batched swarm simulator (ops/swarm_sim.py) over a grid of
 design knobs and prints the offload/rebuffer frontier, on-device, in
 seconds.  This is the tool the reference could never have: its
 multi-instance story was "open several browser tabs" (reference
 README.md:253); here a hundred-thousand-peer swarm is one
-``lax.scan`` and a whole policy grid is a coffee-length run.
+``lax.scan`` — and, since this round, a whole policy grid is ONE
+device dispatch, not a Python loop over grid points.
+
+Execution model (the batched engine, ``run_swarm_batch``):
+
+1. Grid points are grouped by their STATIC knobs — topology degree
+   and the live-sync cushion, the only fields that live in
+   ``SwarmConfig`` — into compile groups; everything else (urgency
+   margin, budget cap, supply rates, stagger window, announce lag,
+   join wave) is dynamic ``SwarmScenario`` data, so each group is
+   one XLA compile regardless of its point count.
+2. Each group's points are stacked along a SCENARIO AXIS
+   (``stack_pytrees``) and dispatched in fixed-size chunks (padded,
+   so every chunk reuses one compiled ``[B, P, …]`` program).  The
+   scanned step is ``vmap``-ed over the batch and the state carry is
+   donated — one program steps the whole chunk, no per-point Python
+   round-trips, no double-buffered grid state in HBM.
+3. Dispatch is PIPELINED: chunk N's host readback (two ``[B]`` metric
+   vectors) happens while chunk N+1 is already queued on the device,
+   so scenario construction and readback hide under device compute.
+   ``bench.py`` tracks the resulting grid points/sec and whole-grid
+   wall-clock against the old sequential per-point dispatch
+   (``--sequential`` keeps that path alive as the parity reference).
+
+On a multi-chip platform the chunk additionally shards across chips
+over the ``scenarios`` mesh axis (``parallel/mesh.py``): scenarios
+are embarrassingly parallel, so the sharded grid adds ZERO
+cross-device traffic (checked on the compiled HLO by
+``__graft_entry__._assert_batch_ici_lowering``).
 
 The VOD grid (round 4, VERDICT r3 #2) spans supply regimes
 (uplink × CDN rate) where the rebuffer axis genuinely binds, crossed
@@ -18,19 +46,11 @@ late/early CDN rescue, HAVE-propagation lag, scarce-to-ample
 supply, and a flash-crowd join wave — the regimes where the
 stagger's COST binds, so the live rebuffer axis moves too.
 
-Everything but topology degree and the live-sync cushion is a
-dynamic scenario scalar, and short ladders are padded to a common
-level count with an unreachable bitrate the ABR rule can never pick
-— so the whole VOD grid (one degree) is ONE compile, and the live
-grid one per (degree, live_sync) combination.  Round 2
-kept every knob in the static ``SwarmConfig`` and paid a full XLA
-recompile per grid point — 113 s for 18 points at a mere 256 peers;
-the round-4 48-point grid runs in ~30 s at 1,024 peers.
-
 Usage::
 
-    python tools/sweep.py                 # default VOD grid
+    python tools/sweep.py                 # default VOD grid, batched
     python tools/sweep.py --live          # live-edge stagger grid
+    python tools/sweep.py --sequential    # per-point reference path
     python tools/sweep.py --peers 32768 --json --out SWEEP.json
 
 Output: one row per grid point with the north-star pair
@@ -53,9 +73,9 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
-    UNREACHABLE_BITRATE, SwarmConfig, init_swarm, offload_ratio,
-    rebuffer_ratio, ring_offsets, run_swarm, stable_ranks,
-    staggered_joins)
+    UNREACHABLE_BITRATE, SwarmConfig, init_swarm, make_scenario,
+    offload_ratio, rebuffer_ratio, ring_offsets, run_batch_chunked,
+    run_swarm_scenario, stable_ranks, staggered_joins)
 
 LADDERS = {
     "sd": (300_000.0, 800_000.0),
@@ -66,28 +86,95 @@ LADDERS = {
 #: this many levels with UNREACHABLE_BITRATE (never chosen)
 N_LEVELS = max(len(v) for v in LADDERS.values())
 
+#: scenarios per batched dispatch: bounds the [B, P, …] grid state in
+#: device memory and is the pipelining quantum (readback of one chunk
+#: overlaps compute of the next)
+DEFAULT_CHUNK = 16
+
 
 def padded_ladder(name):
     rates = list(LADDERS[name])
     return jnp.array(rates + [UNREACHABLE_BITRATE] * (N_LEVELS - len(rates)))
 
 
-def run_point(*, peers, segments, ladder, degree, urgent_margin_s,
-              budget_cap_ms, watch_s, live, spread_s, uplink_bps,
-              cdn_bps, stagger_s, seed, announce_delay_s=0.0,
-              join_wave="steady", live_sync_s=16.0):
-    # circulant ring: topology degree and the live-sync cushion are
-    # the only static knobs (one compile per combination); everything
-    # else is dynamic scenario data
-    config = SwarmConfig(n_peers=peers, n_segments=segments,
-                         n_levels=N_LEVELS, live=live,
-                         live_sync_s=live_sync_s,
-                         neighbor_offsets=ring_offsets(degree))
-    cdn = jnp.full((peers,), cdn_bps)
-    uplink = jnp.full((peers,), uplink_bps)
-    if not live:
-        join = staggered_joins(peers, stagger_s, seed)
-    elif join_wave == "crowd":
+#: host-side memo for the PRNG-derived per-peer arrays: every VOD
+#: grid point shares one (join, rank) pair, and rebuilding a
+#: permutation per point would put O(grid) host PRNG work on the
+#: dispatch path the batched engine exists to clear
+_ARRAY_CACHE = {}
+
+
+def _cached(kind, fn, *key):
+    memo_key = (kind,) + key
+    if memo_key not in _ARRAY_CACHE:
+        _ARRAY_CACHE[memo_key] = fn(*key)
+    return _ARRAY_CACHE[memo_key]
+
+
+def vod_grid():
+    # the VOD grid deliberately spans BOTH metric regimes
+    # (VERDICT r3 next #2: round-3 grids sat where rebuffer never
+    # binds — a one-axis frontier): scarcity points put uplink AT
+    # OR BELOW the ladder top with a constrained CDN, where the
+    # urgency margin genuinely trades offload against rebuffer;
+    # the ample points (uplink 10 / CDN 8) keep continuity with
+    # the round-3 grid.  One topology degree → ONE compile group
+    # for the whole 48-point grid (everything else is scenario data).
+    urgents = (0.5, 4.0, 8.0)
+    caps = (3_000.0, 12_000.0)
+    supply = ((1.2, 1.2), (2.4, 1.2), (2.4, 4.0), (10.0, 8.0))
+    return [dict(degree=8, ladder=lad, spread_s=0.0,
+                 urgent_margin_s=u, budget_cap_ms=cap,
+                 uplink_mbps=up, cdn_mbps=cd)
+            for lad, u, cap, (up, cd) in itertools.product(
+                ("sd", "hd"), urgents, caps, supply)]
+
+
+def live_grid():
+    # the live grid spans regimes where the edge stagger's COST
+    # binds (round-4 verdict weak #1: 24 rows of rebuffer=0.0 in
+    # ample supply showed only the stagger's benefit): uplinks
+    # at/below the ladder top, a constrained CDN, HAVE-propagation
+    # lag up to a segment duration, stagger windows up to two
+    # segment durations, and a flash-crowd join wave — crossed
+    # with the ample points for continuity.  One compile group per
+    # static (degree, live_sync) combination — two here
+    # (everything else is scenario data).
+    spreads = (0.0, 2.0, 8.0)
+    supply = ((1.2, 1.2), (2.4, 2.4), (10.0, 8.0))
+    announces = (0.0, 4.0)
+    waves = ("steady", "crowd")
+    syncs = (6.0, 12.0)       # tight vs standard live cushion
+    urgents = (0.5, 4.0)      # late vs early CDN rescue
+    return [dict(degree=8, ladder="hd", spread_s=sp,
+                 live_sync_s=sync, urgent_margin_s=u,
+                 budget_cap_ms=6_000.0,
+                 announce_delay_s=ann, join_wave=wave,
+                 uplink_mbps=up, cdn_mbps=cd)
+            for sync, u, sp, (up, cd), ann, wave in
+            itertools.product(syncs, urgents, spreads, supply,
+                              announces, waves)]
+
+
+def build_config(peers, segments, live, degree, live_sync_s=16.0):
+    """The static scenario description: topology degree and the
+    live-sync cushion are the only compile-time knobs."""
+    return SwarmConfig(n_peers=peers, n_segments=segments,
+                      n_levels=N_LEVELS, live=live,
+                      live_sync_s=live_sync_s,
+                      neighbor_offsets=ring_offsets(degree))
+
+
+def build_scenario(config, knobs, *, watch_s, stagger_s, seed):
+    """One grid point's dynamic scenario (plus its join times, which
+    the rebuffer denominator needs).  Everything here is scenario
+    DATA — no recompile across points."""
+    peers = config.n_peers
+    cdn = jnp.full((peers,), knobs["cdn_mbps"] * 1e6)
+    uplink = jnp.full((peers,), knobs["uplink_mbps"] * 1e6)
+    if not config.live:
+        join = _cached("join", staggered_joins, peers, stagger_s, seed)
+    elif knobs.get("join_wave", "steady") == "crowd":
         # flash crowd: a 25% seed population from t=0, then 75% of
         # the audience arrives in ONE wave a quarter into the watch
         # window — the regime where the edge stagger and announce lag
@@ -101,19 +188,75 @@ def run_point(*, peers, segments, ladder, degree, urgent_margin_s,
         join = jnp.where(is_seed, 0.0, watch_s / 4.0)
     else:
         join = jnp.zeros((peers,))
-    ranks = stable_ranks(peers, seed)
-    n_steps = int(watch_s * 1000.0 / config.dt_ms)
-    final, _ = run_swarm(config, padded_ladder(ladder), None, cdn,
-                         init_swarm(config), n_steps, join,
-                         uplink_bps=uplink, edge_rank=ranks,
-                         urgent_margin_s=urgent_margin_s,
-                         p2p_budget_cap_ms=budget_cap_ms,
-                         live_spread_s=spread_s,
-                         announce_delay_s=announce_delay_s)
-    return {
-        "offload": round(float(offload_ratio(final)), 4),
-        "rebuffer": round(float(rebuffer_ratio(final, watch_s, join)), 5),
-    }
+    scenario = make_scenario(
+        config, padded_ladder(knobs["ladder"]), None, cdn, join,
+        uplink_bps=uplink, edge_rank=_cached("rank", stable_ranks,
+                                             peers, seed),
+        urgent_margin_s=knobs["urgent_margin_s"],
+        p2p_budget_cap_ms=knobs["budget_cap_ms"],
+        live_spread_s=knobs["spread_s"],
+        announce_delay_s=knobs.get("announce_delay_s", 0.0))
+    return scenario, join
+
+
+def _static_key(knobs, live):
+    return (knobs["degree"],
+            knobs.get("live_sync_s", 16.0) if live else None)
+
+
+def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
+                     chunk=DEFAULT_CHUNK, stagger_s=60.0):
+    """The batched engine: one ``run_swarm_batch`` dispatch per
+    padded chunk, host readback pipelined one chunk behind the
+    device (``run_batch_chunked``).  Returns ``(rows, n_compiles)``
+    with rows in grid order."""
+    groups = {}
+    for knobs in grid:
+        groups.setdefault(_static_key(knobs, live), []).append(knobs)
+
+    rows = []
+    compiles = set()
+    for (degree, sync), points in groups.items():
+        config = build_config(peers, segments, live, degree,
+                              live_sync_s=sync if live else 16.0)
+        n_steps = int(watch_s * 1000.0 / config.dt_ms)
+        metrics = run_batch_chunked(
+            config, points,
+            lambda k: build_scenario(config, k, watch_s=watch_s,
+                                     stagger_s=stagger_s, seed=seed),
+            n_steps, watch_s=watch_s, chunk=chunk)
+        compiles.add((degree, sync, min(chunk, len(points))))
+        rows.extend({**knobs, "offload": round(off, 4),
+                     "rebuffer": round(reb, 5)}
+                    for knobs, (off, reb) in zip(points, metrics))
+    return rows, len(compiles)
+
+
+def run_grid_sequential(grid, *, peers, segments, watch_s, live, seed,
+                        stagger_s=60.0, **_):
+    """The pre-batching reference path: one ``run_swarm`` dispatch
+    plus one blocking host readback PER grid point.  Kept as the
+    parity/benchmark baseline the batched engine is measured against
+    (bench.py ``sweep_grid``) and as ``--sequential``."""
+    rows = []
+    compiles = set()
+    for knobs in grid:
+        key = _static_key(knobs, live)
+        config = build_config(peers, segments, live, knobs["degree"],
+                              live_sync_s=key[1] if live else 16.0)
+        n_steps = int(watch_s * 1000.0 / config.dt_ms)
+        scenario, join = build_scenario(config, knobs, watch_s=watch_s,
+                                        stagger_s=stagger_s, seed=seed)
+        final, _ = run_swarm_scenario(config, scenario,
+                                      init_swarm(config), n_steps)
+        compiles.add(key)
+        rows.append({
+            **knobs,
+            "offload": round(float(offload_ratio(final)), 4),
+            "rebuffer": round(float(rebuffer_ratio(final, watch_s,
+                                                   join)), 5),
+        })
+    return rows, len(compiles)
 
 
 def main():
@@ -124,67 +267,24 @@ def main():
     ap.add_argument("--live", action="store_true",
                     help="sweep the live-edge stagger grid instead of VOD")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--chunk", type=int, default=DEFAULT_CHUNK,
+                    help="scenarios per batched dispatch")
+    ap.add_argument("--sequential", action="store_true",
+                    help="per-point dispatch (the pre-batching "
+                         "reference path)")
     ap.add_argument("--json", action="store_true",
                     help="one JSON line per grid point")
     ap.add_argument("--out", metavar="FILE",
                     help="write the full sweep (meta + rows) as JSON")
     args = ap.parse_args()
 
-    if args.live:
-        # the live grid spans regimes where the edge stagger's COST
-        # binds (round-4 verdict weak #1: 24 rows of rebuffer=0.0 in
-        # ample supply showed only the stagger's benefit): uplinks
-        # at/below the ladder top, a constrained CDN, HAVE-propagation
-        # lag up to a segment duration, stagger windows up to two
-        # segment durations, and a flash-crowd join wave — crossed
-        # with the ample points for continuity.  One compile per
-        # static (degree, live_sync) combination — two here
-        # (everything else is scenario data).
-        spreads = (0.0, 2.0, 8.0)
-        supply = ((1.2, 1.2), (2.4, 2.4), (10.0, 8.0))
-        announces = (0.0, 4.0)
-        waves = ("steady", "crowd")
-        syncs = (6.0, 12.0)       # tight vs standard live cushion
-        urgents = (0.5, 4.0)      # late vs early CDN rescue
-        grid = [dict(degree=8, ladder="hd", spread_s=sp,
-                     live_sync_s=sync, urgent_margin_s=u,
-                     budget_cap_ms=6_000.0,
-                     announce_delay_s=ann, join_wave=wave,
-                     uplink_mbps=up, cdn_mbps=cd)
-                for sync, u, sp, (up, cd), ann, wave in
-                itertools.product(syncs, urgents, spreads, supply,
-                                  announces, waves)]
-    else:
-        # the VOD grid deliberately spans BOTH metric regimes
-        # (VERDICT r3 next #2: round-3 grids sat where rebuffer never
-        # binds — a one-axis frontier): scarcity points put uplink AT
-        # OR BELOW the ladder top with a constrained CDN, where the
-        # urgency margin genuinely trades offload against rebuffer;
-        # the ample points (uplink 10 / CDN 8) keep continuity with
-        # the round-3 grid.  One topology degree → ONE compile for
-        # the whole grid (everything else is scenario data).
-        urgents = (0.5, 4.0, 8.0)
-        caps = (3_000.0, 12_000.0)
-        supply = ((1.2, 1.2), (2.4, 1.2), (2.4, 4.0), (10.0, 8.0))
-        grid = [dict(degree=8, ladder=lad, spread_s=0.0,
-                     urgent_margin_s=u, budget_cap_ms=cap,
-                     uplink_mbps=up, cdn_mbps=cd)
-                for lad, u, cap, (up, cd) in itertools.product(
-                    ("sd", "hd"), urgents, caps, supply)]
-
+    grid = live_grid() if args.live else vod_grid()
+    engine = run_grid_sequential if args.sequential else run_grid_batched
     t0 = time.perf_counter()
-    rows = []
-    for knobs in grid:
-        knobs = dict(knobs)
-        uplink_mbps = knobs.pop("uplink_mbps")
-        cdn_mbps = knobs.pop("cdn_mbps")
-        metrics = run_point(
-            peers=args.peers, segments=args.segments, watch_s=args.watch_s,
-            live=args.live, uplink_bps=uplink_mbps * 1e6,
-            cdn_bps=cdn_mbps * 1e6, stagger_s=60.0, seed=args.seed,
-            **knobs)
-        rows.append({**knobs, "uplink_mbps": uplink_mbps,
-                     "cdn_mbps": cdn_mbps, **metrics})
+    rows, n_compiles = engine(
+        grid, peers=args.peers, segments=args.segments,
+        watch_s=args.watch_s, live=args.live, seed=args.seed,
+        chunk=args.chunk)
     elapsed = time.perf_counter() - t0
 
     rows.sort(key=lambda r: (-r["offload"], r["rebuffer"]))
@@ -200,13 +300,11 @@ def main():
         for row in rows:
             print(" | ".join(f"{row[k]!s:>15}" for k in knob_names
                              + ["offload", "rebuffer"]))
-    n_compiles = len({(r["degree"], r.get("live_sync_s"))
-                      for r in rows})
+    mode = "sequential" if args.sequential else "batched"
     summary = (f"{len(rows)} grid points x {args.peers} peers x "
                f"{args.watch_s:.0f}s in {elapsed:.1f}s "
-               f"({n_compiles} XLA compile"
-               f"{'s' if n_compiles != 1 else ''}: one per static "
-               f"(degree, live_sync) combination)")
+               f"({len(rows) / elapsed:.2f} points/s, {mode} engine, "
+               f"{n_compiles} XLA compile{'s' if n_compiles != 1 else ''})")
     print(f"# {summary}", file=sys.stderr)
     if args.out:
         device = jax.devices()[0]
@@ -217,6 +315,9 @@ def main():
                     "watch_s": args.watch_s, "live": args.live,
                     "elapsed_s": round(elapsed, 1),
                     "grid_points": len(rows),
+                    "points_per_sec": round(len(rows) / elapsed, 3),
+                    "engine": mode,
+                    "chunk": None if args.sequential else args.chunk,
                     "platform": device.platform,
                     "device_kind": getattr(device, "device_kind", "?"),
                 },
