@@ -17,9 +17,12 @@ coverage:
 	$(PY) tools/coverage.py
 
 # deterministic large churn soak (~35 s; above the pytest suite's
-# scale tier — CI runs it as its own step)
+# scale tier — CI runs it as its own step).  Writes the JSON-lines
+# metrics artifact to an UNCOMMITTED path (the SCALING_local.json
+# pattern) and checks the long-uptime invariants FROM that artifact,
+# so a green soak also proves the telemetry export is complete.
 soak:
-	$(PY) tools/soak.py
+	$(PY) tools/soak.py --metrics-out SOAK_local.jsonl
 
 bench:
 	$(PY) bench.py

@@ -43,6 +43,17 @@ engines are timed WARM (compiles excluded) as interleaved
 best-of-3 full passes: the property under test is dispatch/readback
 amortization, not XLA compile time or a noisy neighbor's burst.
 
+The telemetry round folds two observability numbers into the same
+grid (same sizes, same interleave):
+- ``overlap_efficiency`` — the chunked engine re-run with
+  ``pipeline=False`` under a span tracer (engine/telemetry.py
+  SpanRecorder) measures how much of the drain-per-chunk readback
+  wall-clock the pipelined engine actually hides under device
+  compute, so PR 1's HLO-asserted overlap is now a runtime quantity.
+- ``timeline_overhead`` — the grid re-run with ``record_every=20``
+  (the on-device metrics timeline the sweep tools dump) vs off; the
+  acceptance bar holds it under 3% on the artifact-size config.
+
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
@@ -287,17 +298,30 @@ def numpy_baseline_throughput(config, n_steps, join):
     return P * n_steps / elapsed, offload
 
 
+#: timeline sampling interval the overhead number is measured at —
+#: the same default the sweep tools use for ``--timelines-out``
+TIMELINE_RECORD_EVERY = 20
+
+
 def sweep_grid_benchmark(reps=3):
     """Whole-grid wall-clock of the 48-point VOD sweep
     (tools/sweep.py ``vod_grid``): the scenario-batched engine vs the
-    sequential per-point dispatch path, both WARM (one untimed pass
-    per engine for compiles, then best-of-``reps`` timed full passes
-    — min, like the step bench, because host noise only ever ADDS
-    time).  Single-device CPU sizes keep the comparison honest on
-    hosts without an accelerator."""
+    sequential per-point dispatch path, ALL passes WARM (one untimed
+    pass per program for compiles, then best-of-``reps`` timed full
+    passes — min, like the step bench, because host noise only ever
+    ADDS time).  Single-device CPU sizes keep the comparison honest
+    on hosts without an accelerator.
+
+    Two more programs ride the same interleave (module docstring):
+    the drain-per-chunk batched engine under a span tracer (for
+    ``overlap_efficiency``) and the batched engine with the
+    ``record_every=20`` on-device metrics timeline compiled in (for
+    ``timeline_overhead``)."""
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "tools"))
     import sweep as sweep_tool
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import (
+        SpanRecorder, overlap_efficiency)
 
     if jax.devices()[0].platform in ("tpu", "gpu"):
         # the round-4 artifact grid (SWEEP_r04/r05.json)
@@ -314,23 +338,47 @@ def sweep_grid_benchmark(reps=3):
     def run_sequential():
         return sweep_tool.run_grid_sequential(grid, **common)
 
-    # warm both engines (compiles excluded), then INTERLEAVE the timed
-    # passes — a noisy-neighbor burst on a shared host then lands on
-    # both engines with equal odds instead of biasing one min
+    def run_unpipelined(tracer):
+        # same compiled program as run_batched — pipeline/tracer only
+        # change HOST-side dispatch order and bookkeeping
+        return sweep_tool.run_grid_batched(
+            grid, chunk=chunk, tracer=tracer, pipeline=False, **common)
+
+    def run_timeline():
+        return sweep_tool.run_grid_batched(
+            grid, chunk=chunk,
+            record_every=TIMELINE_RECORD_EVERY, **common)
+
+    # warm every program (compiles excluded), then INTERLEAVE the
+    # timed passes — a noisy-neighbor burst on a shared host then
+    # lands on each program with equal odds instead of biasing one min
     rows, _ = run_batched()
     seq_rows, _ = run_sequential()
+    run_timeline()
     batched_times, sequential_times = [], []
+    unpipelined_passes, timeline_times = [], []
     for _ in range(reps):
-        for run, times in ((run_batched, batched_times),
-                           (run_sequential, sequential_times)):
-            start = time.perf_counter()
-            rows_i, _ = run()
-            times.append(time.perf_counter() - start)
-            if run is run_batched:
-                rows = rows_i
-            else:
-                seq_rows = rows_i
+        start = time.perf_counter()
+        rows, _ = run_batched()
+        batched_times.append(time.perf_counter() - start)
+
+        start = time.perf_counter()
+        seq_rows, _ = run_sequential()
+        sequential_times.append(time.perf_counter() - start)
+
+        tracer = SpanRecorder()
+        start = time.perf_counter()
+        run_unpipelined(tracer)
+        unpipelined_passes.append((time.perf_counter() - start, tracer))
+
+        start = time.perf_counter()
+        run_timeline()
+        timeline_times.append(time.perf_counter() - start)
     batched_s, sequential_s = min(batched_times), min(sequential_times)
+    unpipelined_s, unpipelined_tracer = min(unpipelined_passes,
+                                            key=lambda p: p[0])
+    timeline_s = min(timeline_times)
+    readback_s = unpipelined_tracer.total("readback")
 
     # the engines must be measuring the SAME grid — a silent metric
     # divergence would make the speedup meaningless
@@ -343,6 +391,17 @@ def sweep_grid_benchmark(reps=3):
         "sequential_wall_s": round(sequential_s, 3),
         "points_per_sec": round(len(grid) / batched_s, 2),
         "speedup_vs_sequential": round(sequential_s / batched_s, 2),
+        # dispatch-pipeline tracing (engine/telemetry.py): how much of
+        # the drain-per-chunk readback the pipelining actually hides
+        "unpipelined_wall_s": round(unpipelined_s, 3),
+        "unpipelined_readback_s": round(readback_s, 3),
+        "overlap_efficiency": round(
+            overlap_efficiency(batched_s, unpipelined_s, readback_s), 3),
+        # on-device metrics timeline cost (acceptance bar: < 3% on the
+        # artifact-size accelerator config)
+        "timeline_record_every": TIMELINE_RECORD_EVERY,
+        "timeline_wall_s": round(timeline_s, 3),
+        "timeline_overhead": round(timeline_s / batched_s - 1.0, 4),
     }
 
 

@@ -50,6 +50,11 @@ class CdnOnlyAgent:
     ``p2p_config`` extras understood by the rebuild:
       - ``cdn_transport``: a :class:`CdnTransport` (default real HTTP)
       - ``clock``: a :class:`Clock` (default wall time)
+      - ``metrics_registry`` / ``peer_id``: bind the stats to a
+        shared telemetry registry as a per-peer labeled series, same
+        as the full agent — a CDN-only fallback peer must not vanish
+        from a harness export (the soak checks per-peer series
+        against the swarm-level gauges)
     """
 
     StreamTypes = StreamTypes
@@ -69,7 +74,8 @@ class CdnOnlyAgent:
         self.cdn_transport: CdnTransport = (
             self.p2p_config.get("cdn_transport") or HttpCdnTransport())
 
-        self._stats = AgentStats()
+        self._stats = AgentStats(self.p2p_config.get("metrics_registry"),
+                                 peer_id=self.p2p_config.get("peer_id"))
         self.media_element = None
         self.disposed = False
 

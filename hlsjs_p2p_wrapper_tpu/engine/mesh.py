@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional, Tuple
 from ..core.clock import Clock
 from . import protocol as P
 from .cache import SegmentCache
+from .telemetry import MetricsRegistry
 from .transport import Endpoint
 
 CHUNK_PAYLOAD_BYTES = 16 * 1024
@@ -184,11 +185,22 @@ class PeerMesh:
                  chunk_bytes: int = CHUNK_PAYLOAD_BYTES,
                  ban_ms: float = DEFAULT_BAN_MS,
                  holder_selection: str = "spread",
-                 max_total_serves: int = MAX_TOTAL_SERVES):
+                 max_total_serves: int = MAX_TOTAL_SERVES,
+                 registry: Optional[MetricsRegistry] = None):
         if holder_selection not in ("adaptive", "spread", "ranked"):
             raise ValueError(f"unknown holder_selection "
                              f"{holder_selection!r}")
         self.holder_selection = holder_selection
+        # unified telemetry (engine/telemetry.py): membership
+        # lifecycle events — reaps by kind, poisoning bans, adaptive
+        # congestion penalties — as counters the soak/harness export
+        metrics = registry if registry is not None else MetricsRegistry()
+        self.metrics = metrics
+        self._m_reap_half_open = metrics.counter("mesh.reaps",
+                                                 kind="half_open")
+        self._m_reap_idle = metrics.counter("mesh.reaps", kind="idle")
+        self._m_bans = metrics.counter("mesh.bans")
+        self._m_penalties = metrics.counter("mesh.penalties")
         self.max_total_serves = max_total_serves
         self.endpoint = endpoint
         self.swarm_id = swarm_id
@@ -284,6 +296,7 @@ class PeerMesh:
                     # our per-announce retries keep pushing out
                     self._send(peer_id, P.Bye())
                     stale.append(peer_id)
+                    self._m_reap_half_open.inc()
                 continue
             last = max(state.last_seen_ms, state.hello_at)
             if now - last < PEER_IDLE_REAP_MS:
@@ -298,6 +311,7 @@ class PeerMesh:
                 # the same symmetry via its Bye broadcast)
                 self._send(peer_id, P.Bye())
                 stale.append(peer_id)
+                self._m_reap_idle.inc()
         for peer_id in stale:
             self.drop_peer(peer_id)
 
@@ -409,6 +423,7 @@ class PeerMesh:
         if self.holder_selection != "adaptive":
             return
         self._holder_penalty[peer_id] = self.clock.now() + HOLDER_PENALTY_MS
+        self._m_penalties.inc()
         if len(self._holder_penalty) > self.MAX_EDGE_ENTRIES:
             now = self.clock.now()
             for pid in [pid for pid, exp in self._holder_penalty.items()
@@ -705,6 +720,7 @@ class PeerMesh:
         poisoner at the cost of one wasted download per round."""
         self._fail_download(request_id, {"status": 0})
         self._banned[src_id] = self.clock.now() + self.ban_ms
+        self._m_bans.inc()
         self.drop_peer(src_id)
 
     def _is_banned(self, peer_id: str) -> bool:

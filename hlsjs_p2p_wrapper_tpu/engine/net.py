@@ -89,6 +89,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..core.clock import TimerHandle
+from .telemetry import MetricsRegistry
 
 log = logging.getLogger(__name__)
 
@@ -681,22 +682,25 @@ class TcpEndpoint:
         #: dashboards where a dropped increment under a GIL-release
         #: race skews a rate chart by one frame, which is noise —
         #: unlike the attack counters below, whose bursts are exactly
-        #: the moments contended increments get lost, so those take
-        #: ``_stats_lock`` (_count).  Don't "fix" the asymmetry by
-        #: locking these: they sit on the per-frame hot path.
+        #: the moments contended increments get lost, so those bump
+        #: locked registry Counters (_count).  Don't "fix" the
+        #: asymmetry by locking these: they sit on the per-frame hot
+        #: path.
         self.bytes_sent = 0
         self.bytes_received = 0
-        #: attack visibility (SECURITY.md): EVERY inbound handshake
-        #: turned away — failed TLS wrap, missing/oversized/non-UTF-8
-        #: preamble, host mismatch, protected-id claim, PSK failure,
-        #: and connect-flood shedding at the pending-handshake gate —
-        #: plus post-handshake frames dropped for MAC failure.  Locked
-        #: increments (_count): the counters exist precisely for
-        #: high-concurrency attack bursts, where unlocked += from 64
-        #: handshake threads would drop counts
-        self.handshake_rejects = 0
-        self.mac_drops = 0
-        self._stats_lock = threading.Lock()
+        # attack visibility (SECURITY.md): EVERY inbound handshake
+        # turned away — failed TLS wrap, missing/oversized/non-UTF-8
+        # preamble, host mismatch, protected-id claim, PSK failure,
+        # and connect-flood shedding at the pending-handshake gate —
+        # plus post-handshake frames dropped for MAC failure.  Since
+        # the telemetry round the ONE store is the network registry's
+        # labeled series (``net.handshake_rejects{reason=...}`` /
+        # ``net.mac_drops``; Counter.inc carries the same per-bump
+        # lock the old ``_stats_lock`` provided — these counters
+        # exist precisely for high-concurrency attack bursts, where
+        # unlocked += from 64 handshake threads would drop counts).
+        # The ``handshake_rejects`` / ``mac_drops`` totals alerting
+        # reads stay available as derived properties below.
         #: ids an inbound preamble may never claim (module docstring:
         #: trust model).  The agent adds its tracker id here.
         self.reject_inbound_ids: set = set()
@@ -710,15 +714,63 @@ class TcpEndpoint:
         self._listener.bind((host, 0))
         self._listener.listen(16)
         self.peer_id = f"{host}:{self._listener.getsockname()[1]}"
+        # registry handles pre-created (BEFORE the accept thread can
+        # fire a flood reject): these bump during exactly the
+        # high-concurrency attack bursts where a per-event registry
+        # lookup (label keying + the registry lock) on top of the
+        # bump lock would be avoidable contention — the same
+        # reasoning as Tracker's reject handles
+        registry = network.registry
+        self._m_counts = {
+            ("handshake_rejects", reason): registry.counter(
+                "net.handshake_rejects", endpoint=self.peer_id,
+                reason=reason)
+            for reason in ("flood", "tls", "preamble", "identity",
+                           "psk", "socket")}
+        self._m_counts[("mac_drops", None)] = registry.counter(
+            "net.mac_drops", endpoint=self.peer_id)
         threading.Thread(target=self._accept_loop, daemon=True,
                          name=f"p2p-accept-{self.peer_id}").start()
 
-    def _count(self, counter: str) -> None:
-        """Locked counter bump: these feed alerting during exactly the
+    def _count(self, counter: str, reason: Optional[str] = None) -> None:
+        """Locked counter bump into the registry series — ONE lock per
+        event (Counter.inc's): these feed alerting during exactly the
         high-concurrency bursts where unlocked ``+=`` from 64
-        handshake threads would drop increments."""
-        with self._stats_lock:
-            setattr(self, counter, getattr(self, counter) + 1)
+        handshake threads would drop increments.  The handle table is
+        built COMPLETE in ``__init__`` (keeping the registry lock off
+        the burst path) and never mutated after, so an unknown
+        ``(counter, reason)`` combo is a programming error that
+        raises ``KeyError`` loudly instead of silently minting a new
+        series — add new reasons to the ``__init__`` table."""
+        self._m_counts[(counter, reason)].inc()
+
+    @property
+    def handshake_rejects(self) -> int:
+        """Total inbound handshakes turned away (all reasons) —
+        derived from the registry series, so the total and the
+        :meth:`handshake_reject_reasons` breakdown cannot diverge.
+        (The handle table is immutable after ``__init__``, so the
+        bare iteration is thread-safe.)"""
+        return sum(handle.value
+                   for (counter, _r), handle in self._m_counts.items()
+                   if counter == "handshake_rejects")
+
+    @property
+    def mac_drops(self) -> int:
+        """Post-handshake frames dropped for MAC failure."""
+        return self._m_counts[("mac_drops", None)].value
+
+    def handshake_reject_reasons(self) -> Dict[str, int]:
+        """Labeled snapshot of this endpoint's handshake rejects by
+        reason (flood / tls / preamble / identity / psk / socket) —
+        the registry-backed replacement for growing one attribute per
+        reject class.  Read from the endpoint's own immutable handle
+        table (the same instruments the registry serves), not a full
+        registry scan: this may be polled while attack bursts bump
+        the same registry."""
+        return {reason: int(handle.value)
+                for (counter, reason), handle in self._m_counts.items()
+                if counter == "handshake_rejects"}
 
     def backlog_ms(self, dest_id: Optional[str] = None) -> float:
         """Uplink backlog estimate for the mesh's serve pacing
@@ -817,7 +869,7 @@ class TcpEndpoint:
                 if not self.closed:
                     # flood shedding — but the close()-time wake
                     # self-connect must not count as an attack
-                    self._count("handshake_rejects")
+                    self._count("handshake_rejects", reason="flood")
                 try:
                     sock.close()
                 except OSError:
@@ -869,19 +921,19 @@ class TcpEndpoint:
             # that follow — never on the accept loop
             tls = _tls_wrap(sock, ssl_ctx, deadline, server_side=True)
             if tls is None:
-                self._count("handshake_rejects")
+                self._count("handshake_rejects", reason="tls")
                 return  # _tls_wrap owns failure cleanup
             sock = tls
         preamble = _read_frame(sock, max_bytes=self.MAX_PREAMBLE_BYTES,
                                deadline=deadline)
         if preamble is None:
-            self._count("handshake_rejects")
+            self._count("handshake_rejects", reason="preamble")
             sock.close()
             return
         try:
             remote_id = preamble.decode("utf-8")
         except UnicodeDecodeError:
-            self._count("handshake_rejects")
+            self._count("handshake_rejects", reason="preamble")
             sock.close()
             return
         # identity binding (module docstring: trust model): the
@@ -892,7 +944,7 @@ class TcpEndpoint:
         try:
             observed_host = sock.getpeername()[0]
         except OSError:
-            self._count("handshake_rejects")
+            self._count("handshake_rejects", reason="socket")
             sock.close()
             return
         if remote_id in self.reject_inbound_ids or (
@@ -901,7 +953,7 @@ class TcpEndpoint:
                                                    observed_host)):
             log.warning("rejecting inbound connection claiming %r from %s",
                         remote_id, observed_host)
-            self._count("handshake_rejects")
+            self._count("handshake_rejects", reason="identity")
             sock.close()
             return
         psk = self.network.psk
@@ -919,7 +971,7 @@ class TcpEndpoint:
                 _send_with_deadline(
                     sock, _LEN.pack(len(a_nonce)) + a_nonce, deadline)
             except OSError:
-                self._count("handshake_rejects")
+                self._count("handshake_rejects", reason="socket")
                 sock.close()
                 return
             c_nonce = _read_frame(sock, max_bytes=MAX_AUTH_BYTES,
@@ -938,7 +990,7 @@ class TcpEndpoint:
                     mac, _psk_response(psk, a_nonce, c_nonce, preamble)):
                 log.warning("rejecting unauthenticated inbound claiming "
                             "%r from %s", remote_id, observed_host)
-                self._count("handshake_rejects")
+                self._count("handshake_rejects", reason="psk")
                 sock.close()
                 return
             frame_keys = _derive_frame_keys(psk, a_nonce, c_nonce, preamble)
@@ -948,7 +1000,7 @@ class TcpEndpoint:
             # the peer passed auth but the socket died under us before
             # registration — still a turned-away inbound handshake,
             # and alerting should see it
-            self._count("handshake_rejects")
+            self._count("handshake_rejects", reason="socket")
             sock.close()
             return
         conn = _Connection(self, remote_id, sock)
@@ -1102,10 +1154,16 @@ class TcpNetwork:
                  verify_inbound_host: bool = True,
                  psk: Optional[bytes] = None,
                  ssl_server_context=None,
-                 ssl_client_context=None):
+                 ssl_client_context=None,
+                 registry: Optional[MetricsRegistry] = None):
         self.host = host
         self._owns_loop = loop is None
         self.loop = loop or NetLoop()
+        #: unified telemetry (engine/telemetry.py): endpoints mirror
+        #: their attack counters here as labeled series; a private
+        #: registry keeps call sites unconditional when none is given
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
         #: per-swarm pre-shared key: when set, every connection must
         #: pass the HMAC challenge-response before its claimed id is
         #: believed, and every subsequent frame carries a sequence-

@@ -126,7 +126,11 @@ class P2PAgent:
                                             or HttpCdnTransport())
         self.policy = SchedulingPolicy.from_config(cfg)
 
-        self._stats = AgentStats()
+        # unified telemetry (engine/telemetry.py): a harness-shared
+        # registry makes this agent's stats + mesh lifecycle counters
+        # exportable labeled series; absent, the instruments are
+        # private and the public stats dict is unchanged
+        self.metrics_registry = cfg.get("metrics_registry")
         self.media_element = None
         self.disposed = False
         self.p2p_download_on = True
@@ -164,6 +168,12 @@ class P2PAgent:
             # real fabrics assign identity at bind time (TcpNetwork:
             # the listener address IS the peer id); adopt it
             self.peer_id = self.endpoint.peer_id
+            # stats are labeled by the adopted id, and MUST exist
+            # before on_receive / the tracker client go live below —
+            # on a real fabric a network-thread callback can complete
+            # a transfer (bumping _stats) the moment frames flow
+            self._stats = AgentStats(self.metrics_registry,
+                                     peer_id=self.peer_id)
             self.mesh = PeerMesh(
                 self.endpoint, self.swarm_id, self.clock, self.cache,
                 request_timeout_ms=cfg.get("request_timeout_ms",
@@ -181,7 +191,8 @@ class P2PAgent:
                 holder_selection=cfg.get("holder_selection", "spread"),
                 # serve admission control (mesh.MAX_TOTAL_SERVES)
                 max_total_serves=cfg.get("max_total_serves",
-                                         MAX_TOTAL_SERVES))
+                                         MAX_TOTAL_SERVES),
+                registry=self.metrics_registry)
             self.mesh.on_remote_have = lambda _peer: self._schedule_prefetch()
             self.tracker_client = TrackerClient(
                 self.endpoint, self.swarm_id, self.peer_id, self.clock,
@@ -203,6 +214,8 @@ class P2PAgent:
             self.endpoint = None
             self.mesh = None
             self.tracker_client = None
+            self._stats = AgentStats(self.metrics_registry,
+                                     peer_id=self.peer_id)
 
         # stable edge-fetch rank in [0, 1): who seeds fresh live
         # segments from the CDN, and who waits for the swarm.  Hashed
@@ -572,6 +585,11 @@ class P2PAgent:
             self.mesh.close()
         if self.endpoint is not None:
             self.endpoint.close()
+        # the peers gauge is point-in-time: a departed agent has zero
+        # live connections, and a shared-registry export must not
+        # keep reporting its pre-leave count forever (byte totals
+        # stay — they are cumulative by contract)
+        self._stats.peers = 0
 
     @property
     def stats(self) -> Dict:

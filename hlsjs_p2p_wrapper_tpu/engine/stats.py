@@ -3,19 +3,95 @@
 The public stats surface the reference exposes from its closed-source
 agent: ``{cdn, p2p, upload, peers}`` byte/peer counters
 (lib/hlsjs-p2p-wrapper.js:14-18, README.md:230-237).
+
+Since the telemetry round the counters live in the unified host
+registry (engine/telemetry.py): bound to a shared
+:class:`~.telemetry.MetricsRegistry` (the swarm harness passes one
+registry to every agent, labeled per peer) they become exportable
+labeled series; unbound they fall back to private instruments, so the
+attribute surface (``stats.cdn += n``) and the reference's dict shape
+are unchanged either way.
 """
 
 from __future__ import annotations
 
+import itertools
+from typing import Optional
+
+from .telemetry import Counter, Gauge, MetricsRegistry
+
+#: fallback labels for registry-bound stats built without a peer id:
+#: two anonymous agents sharing a registry must NOT resolve to the
+#: same memoized unlabeled series (their byte totals would silently
+#: merge and per-peer completeness checks would misattribute them)
+_ANON_IDS = itertools.count()
+
 
 class AgentStats:
-    """Cumulative transfer counters, read-only to consumers."""
+    """Cumulative transfer counters, read-only to consumers.
 
-    def __init__(self):
-        self.cdn = 0     # bytes fetched from origin
-        self.p2p = 0     # bytes fetched from peers
-        self.upload = 0  # bytes served to peers
-        self.peers = 0   # currently connected peers
+    ``cdn``/``p2p``/``upload`` are monotonic byte totals (registry
+    Counters); ``peers`` is a point-in-time connection count (a
+    Gauge).  Attribute assignment keeps working — a setter ASSIGNS
+    the counter's stored value under its lock (Counter.set_value) —
+    so the agent's existing call sites did not change when the
+    storage migrated.  Assignment preserves the replaced plain
+    attributes' semantics exactly: the idempotent mirror
+    (``stats.upload = mesh.upload_bytes``) converges to the source
+    total under any interleaving, ``stats.cdn += delta`` corrections
+    may be negative (progress over-reports reconciled at transfer
+    completion must adjust the total DOWN), and racing writers can
+    at worst lose one update — never double-apply one, which a
+    read-then-inc delta would."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 peer_id: Optional[str] = None):
+        if registry is not None and not peer_id:
+            peer_id = f"anon-{next(_ANON_IDS)}"
+        labels = {"peer": peer_id} if peer_id else {}
+        if registry is None:
+            self._cdn = Counter("agent.cdn_bytes", labels)
+            self._p2p = Counter("agent.p2p_bytes", labels)
+            self._upload = Counter("agent.upload_bytes", labels)
+            self._peers = Gauge("agent.peers", labels)
+        else:
+            self._cdn = registry.counter("agent.cdn_bytes", **labels)
+            self._p2p = registry.counter("agent.p2p_bytes", **labels)
+            self._upload = registry.counter("agent.upload_bytes",
+                                            **labels)
+            self._peers = registry.gauge("agent.peers", **labels)
+
+    @property
+    def cdn(self) -> int:
+        return self._cdn.value
+
+    @cdn.setter
+    def cdn(self, value) -> None:
+        self._cdn.set_value(value)
+
+    @property
+    def p2p(self) -> int:
+        return self._p2p.value
+
+    @p2p.setter
+    def p2p(self, value) -> None:
+        self._p2p.set_value(value)
+
+    @property
+    def upload(self) -> int:
+        return self._upload.value
+
+    @upload.setter
+    def upload(self, value) -> None:
+        self._upload.set_value(value)
+
+    @property
+    def peers(self) -> int:
+        return self._peers.value
+
+    @peers.setter
+    def peers(self, value) -> None:
+        self._peers.set(value)
 
     def as_dict(self) -> dict:
         return {"cdn": self.cdn, "p2p": self.p2p, "upload": self.upload,

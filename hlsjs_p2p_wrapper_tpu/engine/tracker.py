@@ -16,11 +16,15 @@ announce, so crashed peers age out without an orderly LEAVE.
 from __future__ import annotations
 
 import hashlib
+import logging
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..core.clock import Clock
 from .protocol import Announce, Leave, Peers, ProtocolError, decode, encode
+from .telemetry import MetricsRegistry
 from .transport import Endpoint
+
+log = logging.getLogger(__name__)
 
 #: a member-attribution key: (swarm id, peer id)
 _MemberKey = Tuple[str, str]
@@ -75,13 +79,36 @@ class Tracker:
     EXPIRE_SWEEP_MS = 1_000.0
 
     def __init__(self, clock: Clock, *, lease_ms: float = DEFAULT_LEASE_MS,
-                 max_peers_returned: int = 30):
+                 max_peers_returned: int = 30,
+                 registry: Optional[MetricsRegistry] = None):
         self.clock = clock
         self.lease_ms = lease_ms
         self.max_peers_returned = max_peers_returned
+        # unified telemetry (engine/telemetry.py): lease decisions are
+        # counted here — rejects as a reason-labeled series, plus a
+        # discovery-quality histogram of how many co-members each
+        # successful announce was answered with
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        self._m_announces = self.metrics.counter("tracker.announces")
+        self._m_reclaims = self.metrics.counter("tracker.lease_reclaims")
+        self._m_expiries = self.metrics.counter("tracker.lease_expiries")
+        # reject handles pre-created: _reject fires exactly during
+        # announce floods, where a per-event registry lookup (label
+        # keying + registry lock) on top of the bump lock would be
+        # avoidable per-reject overhead
+        self._m_rejects = {
+            reason: self.metrics.counter("tracker.announce_rejects",
+                                         reason=reason)
+            for reason in ("swarm_cap", "create_quota",
+                           "foreign_owner", "member_cap")}
+        self._m_leave_rejects = self.metrics.counter(
+            "tracker.leave_rejects")
+        self._m_peers_returned = self.metrics.histogram(
+            "tracker.peers_returned",
+            buckets=(0.0, 1.0, 2.0, 5.0, 10.0, 20.0, 30.0))
         # swarm id -> peer id -> lease expiry (ms)
         self._swarms: Dict[str, Dict[str, float]] = {}
-        self.announce_count = 0
         self._last_sweep_ms = -1e18
         # per-source quota state (see the quota class attributes):
         # who created each live swarm, per-source creation counts,
@@ -112,7 +139,7 @@ class Tracker:
         remember is not refusal to serve.  ``source`` is the
         transport-level sender identity (the adapter passes it; the
         un-sourced core API applies no per-source quotas)."""
-        self.announce_count += 1
+        self._m_announces.inc()
         now = self.clock.now()
         self._expire_swarms(now)
         swarm = self._swarms.get(swarm_id)
@@ -135,10 +162,13 @@ class Tracker:
                     self._last_sweep_ms = -1e18
                     self._expire_swarms(now)
                 if len(self._swarms) >= self.MAX_SWARMS:
+                    self._reject("swarm_cap", swarm_id, peer_id, source)
                     return []
             if key is not None and self._creates_by_source.get(key, 0) \
                     >= self.MAX_SWARM_CREATES_PER_SOURCE:
-                return []  # this source's creation quota is spent
+                # this source's creation quota is spent
+                self._reject("create_quota", swarm_id, peer_id, source)
+                return []
             swarm = self._swarms[swarm_id] = {}
             if key is not None:
                 self._swarm_creator[swarm_id] = key
@@ -159,19 +189,47 @@ class Tracker:
                 # listen address, so a squatter who announced it first
                 # must not lock the real peer out of its lease
                 # (SECURITY.md: claim-squatting).
+                self._reject("foreign_owner", swarm_id, peer_id, source)
                 others = [p for p in swarm if p != peer_id]
                 others.reverse()
                 return others[: self.max_peers_returned]
         known = swarm.pop(peer_id, None) is not None
-        if known or len(swarm) < self.MAX_MEMBERS_PER_SWARM:
+        registered = known or len(swarm) < self.MAX_MEMBERS_PER_SWARM
+        if registered:
             if key is not None:
                 self._attribute_member(swarm_id, peer_id, key,
                                        reclaim=(source == peer_id))
             # re-insert to refresh both lease and recency order
             swarm[peer_id] = now + self.lease_ms
+        else:
+            self._reject("member_cap", swarm_id, peer_id, source)
         others = [p for p in swarm if p != peer_id]
         others.reverse()
-        return others[: self.max_peers_returned]
+        answered = others[: self.max_peers_returned]
+        if registered:
+            # discovery quality is defined over SUCCESSFUL announces
+            # (__init__): reject answers (squat probes, cap floods)
+            # must not skew the distribution a dashboard reads
+            self._m_peers_returned.observe(len(answered))
+        return answered
+
+    @property
+    def announce_count(self) -> int:
+        """Total announces handled — derived from the registry
+        counter, so the attribute the pre-telemetry API exposed
+        cannot drift from the exported series."""
+        return self._m_announces.value
+
+    def _reject(self, reason: str, swarm_id: str, peer_id: str,
+                source: Optional[str]) -> None:
+        """Count + log an announce the tracker answered but refused to
+        register (refusal to remember is not refusal to serve).
+        DEBUG level: rejects spike exactly during announce floods, and
+        per-event WARNING lines would make logging itself the DoS —
+        the labeled counter is the alerting surface."""
+        self._m_rejects[reason].inc()
+        log.debug("announce rejected (%s): swarm=%s peer=%s source=%s",
+                  reason, swarm_id, peer_id, source)
 
     def _attribute_member(self, swarm_id: str, peer_id: str,
                           key: str, reclaim: bool = False) -> None:
@@ -196,7 +254,17 @@ class Tracker:
             # equals the claimed peer id — stronger evidence of
             # ownership than announce order, so the prior (squatted)
             # attribution is uncharged and the membership moves to
-            # its rightful bucket
+            # its rightful bucket.  WARNING, not debug: a reclaim
+            # firing means someone squatted a real peer's id
+            # (SECURITY.md claim-squatting) and the rightful owner
+            # just took it back — rare, security-relevant, and worth
+            # a human's attention
+            log.warning(
+                "lease reclaim: peer %s (swarm %s) took its "
+                "membership back from squatting source %s — "
+                "announcer's address-verified transport id equals "
+                "the claimed peer id", peer_id, swarm_id, prior)
+            self._m_reclaims.inc()
             self._remove_member_attribution(swarm_id, peer_id)
         bucket = self._members_by_source.setdefault(key, {})
         if mkey not in bucket and len(bucket) >= self.MAX_MEMBERS_PER_SOURCE:
@@ -256,7 +324,13 @@ class Tracker:
         if source is not None:
             owner = self._member_source.get((swarm_id, peer_id))
             if owner is not None and owner != self._source_key(source):
-                return  # not yours to remove
+                # not yours to remove — without ownership any sender
+                # could deny any member for free (see docstring)
+                self._m_leave_rejects.inc()
+                log.debug("leave rejected: source %s does not own "
+                          "membership (%s, %s)", source, swarm_id,
+                          peer_id)
+                return
         swarm.pop(peer_id, None)
         self._remove_member_attribution(swarm_id, peer_id)
         if not swarm:
@@ -276,9 +350,14 @@ class Tracker:
         member cap) — the swarm being touched must be current even
         between global sweeps, or a full swarm would refuse newcomers
         while holding dead leases."""
-        for peer_id in [p for p, exp in swarm.items() if exp <= now]:
+        expired = [p for p, exp in swarm.items() if exp <= now]
+        for peer_id in expired:
             del swarm[peer_id]
             self._remove_member_attribution(swarm_id, peer_id)
+        if expired:
+            self._m_expiries.inc(len(expired))
+            log.debug("swarm %s: %d lease(s) expired", swarm_id,
+                      len(expired))
         if not swarm:
             self._drop_swarm(swarm_id)
 
@@ -291,12 +370,7 @@ class Tracker:
             return
         self._last_sweep_ms = now
         for swarm_id in list(self._swarms):
-            swarm = self._swarms[swarm_id]
-            for peer_id in [p for p, exp in swarm.items() if exp <= now]:
-                del swarm[peer_id]
-                self._remove_member_attribution(swarm_id, peer_id)
-            if not swarm:
-                self._drop_swarm(swarm_id)
+            self._expire_members(swarm_id, self._swarms[swarm_id], now)
 
 
 class TrackerEndpoint:

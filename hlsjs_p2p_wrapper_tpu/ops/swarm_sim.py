@@ -83,6 +83,7 @@ cross-device ops under a sharded mesh.
 
 from __future__ import annotations
 
+import contextlib
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -1191,27 +1192,112 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         holder_penalty_ms=pen, dl_holder_off=stack("holder_off"))
 
 
+def timeline_columns(config: SwarmConfig) -> Tuple[str, ...]:
+    """Column names of one metrics-timeline row (the ``[M]`` axis of
+    the ``record_every`` output): sample clock, the cumulative
+    north-star pair, interval byte rates, the interval stall count,
+    and per-bitrate-level present-peer counts."""
+    return (("t_s", "offload", "rebuffer", "cdn_rate_bps",
+             "p2p_rate_bps", "stalled_peers")
+            + tuple(f"level_{i}_peers" for i in range(config.n_levels)))
+
+
+def _timeline_row(config: SwarmConfig, scenario: SwarmScenario,
+                  state: SwarmState, cdn_sum, p2p_sum, prev_cdn,
+                  prev_p2p, prev_rebuffer, record_every: int):
+    """One ``[M]`` metrics sample at the end of a record interval.
+
+    The cumulative columns mirror :func:`offload_ratio` /
+    :func:`rebuffer_ratio` op-for-op so the LAST sample of a run is
+    bit-identical to the final-state metrics the sweep tools publish
+    (pinned by tests/test_swarm_batch.py); the rate/stall columns are
+    interval deltas against the previous sample, whose snapshots ride
+    the outer scan carry."""
+    t = state.t_s
+    offload = p2p_sum / jnp.maximum(p2p_sum + cdn_sum, 1.0)
+    # rebuffer over per-peer WATCHED time at the sample clock — the
+    # same join/leave denominator contract as rebuffer_ratio (t_s
+    # accumulates dt_s exactly for power-of-two dt_ms, so the last
+    # sample's denominator equals the final elapsed_s one)
+    watched = jnp.sum(jnp.clip(
+        jnp.minimum(scenario.leave_s, t) - scenario.join_s, 0.0))
+    rebuffer = jnp.sum(state.rebuffer_s) / jnp.maximum(watched, 1e-9)
+    interval_s = record_every * config.dt_ms / 1000.0
+    cdn_rate = (cdn_sum - prev_cdn) * 8.0 / interval_s
+    p2p_rate = (p2p_sum - prev_p2p) * 8.0 / interval_s
+    # stalls: peers whose rebuffer clock moved during this interval
+    # (a peer that stalled then departed mid-interval still counts)
+    stalled = jnp.sum(
+        (state.rebuffer_s > prev_rebuffer).astype(jnp.float32))
+    present = (t >= scenario.join_s) & (t < scenario.leave_s)
+    lvl_iota = jnp.arange(config.n_levels, dtype=state.level.dtype)
+    level_counts = jnp.sum(
+        (present[:, None] & (state.level[:, None] == lvl_iota[None, :]))
+        .astype(jnp.float32), axis=0)
+    head = jnp.stack([t, offload, rebuffer, cdn_rate, p2p_rate,
+                      stalled])
+    return jnp.concatenate([head, level_counts])
+
+
 def _scan_swarm(config: SwarmConfig, scenario: SwarmScenario,
-                state: SwarmState, n_steps: int):
+                state: SwarmState, n_steps: int, record_every: int = 0):
     """The scanned step — shared body of the single-scenario and
-    scenario-batched entry points (each jits it separately)."""
+    scenario-batched entry points (each jits it separately).
+
+    ``record_every=0`` (the default) is the pre-timeline program:
+    ``(final state, offload-over-time [n_steps])``, bit-identical to
+    rounds 1-5 — the timeline machinery is compiled away entirely.
+    ``record_every=N`` nests the same step inside an outer scan over
+    record intervals and emits a third output, a downsampled
+    ``[n_steps // N, M]`` metrics timeline (:func:`timeline_columns`),
+    one row per N steps; trailing steps past the last full interval
+    still run (the final state covers all ``n_steps`` either way)."""
     def step(carry, _):
         new = swarm_step(config, scenario, carry)
         p2p = jnp.sum(new.p2p_bytes)
         total = p2p + jnp.sum(new.cdn_bytes)
         return new, p2p / jnp.maximum(total, 1.0)
 
-    return jax.lax.scan(step, state, None, length=n_steps)
+    if not record_every:
+        return jax.lax.scan(step, state, None, length=n_steps)
+    if record_every < 0:
+        raise ValueError(f"record_every must be >= 0, "
+                         f"got {record_every}")
+    n_samples, rem = divmod(n_steps, record_every)
+
+    def interval(carry, _):
+        st, prev_cdn, prev_p2p, prev_reb = carry
+        st, series = jax.lax.scan(step, st, None, length=record_every)
+        cdn_sum = jnp.sum(st.cdn_bytes)
+        p2p_sum = jnp.sum(st.p2p_bytes)
+        row = _timeline_row(config, scenario, st, cdn_sum, p2p_sum,
+                            prev_cdn, prev_p2p, prev_reb, record_every)
+        return (st, cdn_sum, p2p_sum, st.rebuffer_s), (series, row)
+
+    carry = (state, jnp.sum(state.cdn_bytes), jnp.sum(state.p2p_bytes),
+             state.rebuffer_s)
+    (state, _, _, _), (series, timeline) = jax.lax.scan(
+        interval, carry, None, length=n_samples)
+    series = series.reshape((n_samples * record_every,))
+    if rem:
+        state, tail = jax.lax.scan(step, state, None, length=rem)
+        series = jnp.concatenate([series, tail])
+    return state, series, timeline
 
 
-_run_swarm = jax.jit(_scan_swarm, static_argnames=("config", "n_steps"))
+_run_swarm = jax.jit(_scan_swarm,
+                     static_argnames=("config", "n_steps",
+                                      "record_every"))
 
 
 def _run_swarm_batch_impl(config: SwarmConfig, scenarios: SwarmScenario,
-                          states: SwarmState, n_steps: int):
+                          states: SwarmState, n_steps: int,
+                          record_every: int = 0):
     return jax.vmap(
-        lambda scenario, state: _scan_swarm(config, scenario, state,
-                                            n_steps))(scenarios, states)
+        lambda scenario, state: _scan_swarm(
+            scenario=scenario, state=state, config=config,
+            n_steps=n_steps, record_every=record_every))(scenarios,
+                                                         states)
 
 
 #: lazily-jitted batched runner: the donation decision needs the
@@ -1228,7 +1314,8 @@ def _batched_runner():
         # and would only warn, so donate on accelerators alone
         donate = (2,) if jax.default_backend() in ("tpu", "gpu") else ()
         _RUN_SWARM_BATCH = jax.jit(_run_swarm_batch_impl,
-                                   static_argnames=("config", "n_steps"),
+                                   static_argnames=("config", "n_steps",
+                                                    "record_every"),
                                    donate_argnums=donate)
     return _RUN_SWARM_BATCH
 
@@ -1244,17 +1331,23 @@ def stack_pytrees(items):
 
 
 def run_swarm_scenario(config: SwarmConfig, scenario: SwarmScenario,
-                       state: SwarmState, n_steps: int):
+                       state: SwarmState, n_steps: int,
+                       record_every: int = 0):
     """Scan one PRE-BUILT scenario (the :func:`make_scenario` output)
     — the sequential reference path the batched engine is
     parity-tested against; :func:`run_swarm` is this plus scenario
-    construction from keywords."""
+    construction from keywords.  ``record_every=N`` appends the
+    downsampled metrics timeline to the returned tuple (see
+    :func:`_scan_swarm`); 0 keeps the two-tuple contract and the
+    exact pre-timeline program."""
     state = ensure_penalty_width(config, scenario, state)
-    return _run_swarm(config, scenario, state, n_steps)
+    return _run_swarm(config, scenario, state, n_steps,
+                      record_every=record_every)
 
 
 def run_swarm_batch(config: SwarmConfig, scenarios: SwarmScenario,
-                    states: SwarmState, n_steps: int):
+                    states: SwarmState, n_steps: int,
+                    record_every: int = 0):
     """Scan a whole SCENARIO BATCH as one device program.
 
     ``scenarios``/``states`` are :func:`stack_pytrees`-stacked along a
@@ -1273,13 +1366,27 @@ def run_swarm_batch(config: SwarmConfig, scenarios: SwarmScenario,
 
     Returns ``(final states [B, …], offload-over-time [B, n_steps])``,
     bit-identical per lane to looping :func:`run_swarm_scenario`
-    (pinned by tests/test_swarm_batch.py)."""
+    (pinned by tests/test_swarm_batch.py); ``record_every=N`` appends
+    the per-lane ``[B, n_steps // N, M]`` metrics timeline (see
+    :func:`_scan_swarm`)."""
     states = ensure_penalty_width_batch(config, scenarios, states)
-    return _batched_runner()(config, scenarios, states, n_steps)
+    return _batched_runner()(config, scenarios, states, n_steps,
+                             record_every=record_every)
+
+
+def _span(tracer, name: str, **attrs):
+    """Span context for dispatch tracing — duck-typed (anything with
+    ``.span(name, **attrs)``, e.g. engine.telemetry.SpanRecorder) so
+    the device-side module never imports the host engine package."""
+    if tracer is None:
+        return contextlib.nullcontext()
+    return tracer.span(name, **attrs)
 
 
 def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
-                      *, watch_s: float, chunk: int):
+                      *, watch_s: float, chunk: int,
+                      record_every: int = 0, tracer=None,
+                      pipeline: bool = True):
     """Chunked, pipelined host front-end for :func:`run_swarm_batch` —
     the dispatch engine shared by ``tools/sweep.py`` and
     ``tools/policy_ab.py``.
@@ -1289,36 +1396,75 @@ def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
     padded by repeating its last scenario, so every dispatch reuses
     ONE compiled ``[B, P, …]`` program), and each chunk's host
     readback is pipelined one chunk behind the device: the ONLY
-    host-blocking step reads the two ``[B]`` metric vectors of the
-    chunk dispatched one iteration ago, while the device computes the
-    current one.  Returns per-item ``(offload, rebuffer)`` floats in
-    item order; padded lanes are dropped at readback."""
+    host-blocking step reads the chunk dispatched one iteration ago,
+    while the device computes the current one.  Returns per-item
+    ``(offload, rebuffer)`` floats in item order — plus a
+    ``[n_samples, M]`` numpy metrics timeline per item when
+    ``record_every > 0`` (:func:`timeline_columns`); padded lanes are
+    dropped at readback.
+
+    ``tracer`` (e.g. ``engine.telemetry.SpanRecorder``) collects
+    per-chunk ``build`` / ``dispatch`` / ``readback`` span records so
+    the pipelining's readback/compute overlap is measurable rather
+    than asserted (bench.py surfaces it as overlap efficiency);
+    ``pipeline=False`` drains each chunk immediately after its own
+    dispatch — the unpipelined reference the overlap is measured
+    against (that mode blocks on the device results INSIDE the
+    dispatch span, so its readback spans time the host transfer
+    alone, not the async-dispatch compute wait)."""
     items = list(items)
     if not items:
         return []
     batch = min(chunk, len(items))
     out = []
-    pending = None  # (n real lanes, offloads [B], rebuffers [B])
+    pending = None  # (chunk idx, n real lanes, offs, rebs, timelines)
 
     def drain(entry):
-        n, offs, rebs = entry
-        out.extend((float(o), float(r))
-                   for o, r in zip(offs[:n], rebs[:n]))
+        ci, n, offs, rebs, rows = entry
+        with _span(tracer, "readback", chunk=ci):
+            if rows is None:
+                out.extend((float(o), float(r))
+                           for o, r in zip(offs[:n], rebs[:n]))
+            else:
+                rows = np.asarray(rows)
+                out.extend(
+                    (float(o), float(r), rows[lane])
+                    for lane, (o, r) in enumerate(zip(offs[:n],
+                                                      rebs[:n])))
 
-    for i in range(0, len(items), batch):
+    for ci, i in enumerate(range(0, len(items), batch)):
         chunk_items = items[i:i + batch]
-        built = [build(item) for item in chunk_items]
-        built += [built[-1]] * (batch - len(built))
-        scenarios = stack_pytrees([sc for sc, _ in built])
-        joins = jnp.stack([j for _, j in built])
-        states = stack_pytrees([init_swarm(config)] * batch)
-        finals, _ = run_swarm_batch(config, scenarios, states, n_steps)
-        offs = offload_ratio_batch(finals)
-        rebs = rebuffer_ratio_batch(finals, watch_s, joins)
+        with _span(tracer, "build", chunk=ci):
+            built = [build(item) for item in chunk_items]
+            built += [built[-1]] * (batch - len(built))
+            scenarios = stack_pytrees([sc for sc, _ in built])
+            joins = jnp.stack([j for _, j in built])
+            states = stack_pytrees([init_swarm(config)] * batch)
+        with _span(tracer, "dispatch", chunk=ci):
+            res = run_swarm_batch(config, scenarios, states, n_steps,
+                                  record_every=record_every)
+            finals = res[0]
+            rows = res[2] if record_every else None
+            offs = offload_ratio_batch(finals)
+            rebs = rebuffer_ratio_batch(finals, watch_s, joins)
+            if not pipeline:
+                # the drain-per-chunk mode is the overlap-measurement
+                # BASELINE: dispatch is async, so without this wait
+                # the readback span would absorb the device-compute
+                # time and deflate the overlap metric's denominator
+                # contract ("blocking readback hidden under compute")
+                for arr in (offs, rebs) + (() if rows is None
+                                           else (rows,)):
+                    arr.block_until_ready()
+        entry = (ci, len(chunk_items), offs, rebs, rows)
+        if not pipeline:
+            drain(entry)
+            continue
         if pending is not None:
             drain(pending)
-        pending = (len(chunk_items), offs, rebs)
-    drain(pending)
+        pending = entry
+    if pending is not None:
+        drain(pending)
     return out
 
 
@@ -1376,13 +1522,15 @@ def run_swarm(config: SwarmConfig, bitrates: jax.Array,
               live_spread_s=None, request_timeout_ms=None,
               announce_delay_s=None, p2p_setup_ms=None,
               uplink_efficiency=None, retry_dead_ms=None,
-              holder_penalty_ms=None,
+              holder_penalty_ms=None, record_every: int = 0,
               ) -> Tuple[SwarmState, jax.Array]:
     """Scan ``n_steps`` ticks; returns (final state, offload-over-time
-    ``[n_steps]``).  One compiled program regardless of T — and of any
-    policy-knob keyword, all of which are dynamic scenario fields.
-    Optional arrays default to: everyone at t=0, forever, serving at
-    the downlink cap, rank 0 (see :func:`make_scenario`)."""
+    ``[n_steps]``) — plus the ``[n_steps // record_every, M]`` metrics
+    timeline when ``record_every > 0`` (see :func:`_scan_swarm`).  One
+    compiled program regardless of T — and of any policy-knob keyword,
+    all of which are dynamic scenario fields.  Optional arrays default
+    to: everyone at t=0, forever, serving at the downlink cap, rank 0
+    (see :func:`make_scenario`)."""
     scenario = make_scenario(
         config, bitrates, neighbors, cdn_bps, join_s,
         uplink_bps=uplink_bps, leave_s=leave_s, edge_rank=edge_rank,
@@ -1396,7 +1544,8 @@ def run_swarm(config: SwarmConfig, bitrates: jax.Array,
         uplink_efficiency=uplink_efficiency, retry_dead_ms=retry_dead_ms,
         holder_penalty_ms=holder_penalty_ms)
     state = ensure_penalty_width(config, scenario, state)
-    return _run_swarm(config, scenario, state, n_steps)
+    return _run_swarm(config, scenario, state, n_steps,
+                      record_every=record_every)
 
 
 def ensure_penalty_width(config: SwarmConfig, scenario: SwarmScenario,

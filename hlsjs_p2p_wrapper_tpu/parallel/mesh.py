@@ -257,13 +257,18 @@ def shard_swarm_batch(mesh: Mesh, scenarios: SwarmScenario,
 
 def sharded_run_batch(mesh: Mesh, config: SwarmConfig,
                       scenarios: SwarmScenario, states: SwarmState,
-                      n_steps: int):
+                      n_steps: int, record_every: int = 0):
     """Run :func:`run_swarm_batch` with the batch sharded over the
     mesh: scenario lanes split across chips (embarrassingly parallel —
     zero cross-device traffic on the scenario axis), and within each
     lane group the peer axis shards as usual when the mesh carries a
-    ``peers`` axis."""
+    ``peers`` axis.  ``record_every=N`` appends the per-lane metrics
+    timeline; its rows are per-lane reductions, so a scenarios-only
+    mesh still lowers zero collectives (on a hybrid mesh the timeline
+    sums ride the same peer-axis reductions the per-step offload
+    series already pays)."""
     from ..ops.swarm_sim import run_swarm_batch
     scenarios, states = shard_swarm_batch(mesh, scenarios, states)
     with mesh:
-        return run_swarm_batch(config, scenarios, states, n_steps)
+        return run_swarm_batch(config, scenarios, states, n_steps,
+                               record_every=record_every)

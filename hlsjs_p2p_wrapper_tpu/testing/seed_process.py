@@ -4,10 +4,17 @@ Run: ``python -m hlsjs_p2p_wrapper_tpu.testing.seed_process
 <tracker_host:port> <content_id> <sn> <size>``
 
 Joins the swarm over real TCP, fetches one segment from a synthetic
-instant CDN (caching + announcing it), prints ``READY`` on stdout, and
+instant CDN (caching + announcing it), emits ``READY`` on stdout, and
 serves peers until stdin closes — the minimal living proof that two
 OS processes exchange segments through this framework's real-socket
 transport.
+
+``READY`` / ``SEED-FAILED`` are a line PROTOCOL the parent process
+reads from the stdout pipe (tests/test_net.py), not human logging —
+they go through a message-only ``logging`` handler bound to stdout
+(configured in :func:`main`, where the process owns its output), so
+the package stays print-free (tools/lint.py enforces it) without
+changing a byte on the wire.
 
 On an authenticated fabric, pass the swarm secret via the
 ``P2P_SWARM_PSK`` environment variable (env, not argv: secrets must
@@ -17,9 +24,12 @@ challenge-response handshake as every other member.
 
 from __future__ import annotations
 
+import logging
 import os
 import sys
 import threading
+
+log = logging.getLogger(__name__)
 
 
 class _NullHandle:
@@ -60,7 +70,19 @@ class NullMediaMap:
         return []
 
 
+def _bind_protocol_handler() -> None:
+    """Route this module's log records, message-only and flushed, to
+    the stdout pipe the parent reads — StreamHandler flushes per
+    emit, preserving the old ``print(..., flush=True)`` timing."""
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(logging.Formatter("%(message)s"))
+    log.addHandler(handler)
+    log.setLevel(logging.INFO)
+    log.propagate = False
+
+
 def main() -> int:
+    _bind_protocol_handler()
     tracker_addr, content_id, sn_s, size_s = sys.argv[1:5]
     sn, size = int(sn_s), int(size_s)
 
@@ -74,7 +96,7 @@ def main() -> int:
         # an empty secret is a misconfiguration (templating rendered
         # an unset value), not a request for an open fabric — joining
         # unauthenticated would just die later as an opaque timeout
-        print("SEED-FAILED P2P_SWARM_PSK is set but empty", flush=True)
+        log.error("SEED-FAILED P2P_SWARM_PSK is set but empty")
         return 1
     network = TcpNetwork(psk=psk.encode() if psk else None)
     agent = P2PAgent(
@@ -100,10 +122,10 @@ def main() -> int:
                                 done.set()),
          "on_progress": lambda e: None}, segment_view)
     if not done.wait(10.0) or "error" in outcome:
-        print(f"SEED-FAILED {outcome.get('error', 'timeout')}", flush=True)
+        log.error("SEED-FAILED %s", outcome.get("error", "timeout"))
         return 1
 
-    print(f"READY {agent.peer_id}", flush=True)
+    log.info("READY %s", agent.peer_id)
     sys.stdin.read()  # serve until the parent closes our stdin
     agent.dispose()
     network.close()

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 from ..core.clock import VirtualClock
 from ..core.wrapper import P2PWrapper
 from ..engine.p2p_agent import P2PAgent
+from ..engine.telemetry import JsonlExporter, MetricsRegistry
 from ..engine.tracker import Tracker, TrackerEndpoint
 from ..engine.transport import LoopbackNetwork
 from ..player.manifest import LiveFeeder, make_live_manifest, make_vod_manifest
@@ -65,6 +66,14 @@ class SwarmPeer:
     def rebuffer_ms(self) -> float:
         return self.player.rebuffer_ms
 
+    def refresh_stats(self) -> Dict:
+        """Read the stats surface FOR its side effect: the agent's
+        stats property pushes the live mesh totals (upload bytes,
+        peer count) into the registry-backed instruments, which is
+        what the telemetry export reads — an exporter that skipped
+        this would serialize stale series."""
+        return self.stats
+
     def leave(self) -> None:
         """Orderly departure: the player teardown disposes the agent
         (DESTROYING → dispose, player-interface.js:22-24)."""
@@ -84,8 +93,16 @@ class SwarmHarness:
                  cdn_latency_ms: float = 15.0,
                  p2p_latency_ms: float = 8.0,
                  loss_rate: float = 0.0, seed: int = 0,
-                 live: bool = False, redundant: bool = False):
+                 live: bool = False, redundant: bool = False,
+                 registry: Optional[MetricsRegistry] = None):
         self.clock = VirtualClock()
+        #: ONE registry for the whole swarm (engine/telemetry.py):
+        #: every agent's stats land here as per-peer labeled series,
+        #: the tracker and every mesh count into it, and
+        #: :meth:`open_exporter` serializes it VirtualClock-stamped
+        #: (after a :meth:`record_metrics` refresh)
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
         if live:
             self.manifest = make_live_manifest(level_bitrates=level_bitrates,
                                                window_count=frag_count,
@@ -104,7 +121,7 @@ class SwarmHarness:
         self.network = LoopbackNetwork(self.clock,
                                        default_latency_ms=p2p_latency_ms,
                                        loss_rate=loss_rate, seed=seed)
-        self.tracker = Tracker(self.clock)
+        self.tracker = Tracker(self.clock, registry=self.metrics)
         TrackerEndpoint(self.tracker, self.network.register("tracker"))
         self.peers: List[SwarmPeer] = []
         self._counter = 0
@@ -131,6 +148,7 @@ class SwarmHarness:
                "network": self.network, "peer_id": peer_id,
                "uplink_bps": uplink_bps, "content_id": "swarm-content",
                "announce_interval_ms": 2_000.0,
+               "metrics_registry": self.metrics,
                **(p2p_config or {})}
         player = wrapper.create_player(
             {"clock": self.clock, "manifest": self.manifest,
@@ -204,6 +222,36 @@ class SwarmHarness:
         watched = sum((p.left_at_ms if p.left_at_ms is not None else now)
                       - p.joined_at_ms for p in self.peers)
         return stalled / watched if watched > 0 else 0.0
+
+    # -- telemetry export (engine/telemetry.py) ------------------------
+    def record_metrics(self) -> None:
+        """Refresh the harness-level gauges from the live swarm so a
+        following exporter line (:meth:`open_exporter` →
+        :meth:`JsonlExporter.export`) is self-contained: the
+        north-star pair plus each peer's stall/watch clocks — enough
+        to RE-DERIVE offload and rebuffer from the artifact alone,
+        which is how tools/soak.py proves the export is complete."""
+        now = self.clock.now()
+        for peer in self.peers:
+            peer.refresh_stats()
+            self.metrics.gauge("peer.rebuffer_ms",
+                               peer=peer.peer_id).set(peer.rebuffer_ms)
+            end = peer.left_at_ms if peer.left_at_ms is not None else now
+            self.metrics.gauge("peer.watched_ms", peer=peer.peer_id) \
+                .set(end - peer.joined_at_ms)
+        self.metrics.gauge("swarm.peers_total").set(len(self.peers))
+        self.metrics.gauge("swarm.peers_live").set(
+            sum(1 for p in self.peers if not p.left))
+        self.metrics.gauge("swarm.offload_ratio").set(self.offload_ratio)
+        self.metrics.gauge("swarm.rebuffer_ratio").set(
+            self.rebuffer_ratio)
+        self.metrics.gauge("swarm.upload_waste_ratio").set(
+            self.upload_waste_ratio)
+
+    def open_exporter(self, path: str) -> JsonlExporter:
+        """JSON-lines exporter over this swarm's registry, stamped by
+        the swarm's VirtualClock (deterministic simulated time)."""
+        return JsonlExporter(self.metrics, self.clock, path)
 
     @property
     def upload_waste_ratio(self) -> float:

@@ -11,10 +11,12 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import SpanRecorder
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (
     SwarmConfig, init_swarm, make_scenario, offload_ratio,
     offload_ratio_batch, rebuffer_ratio, rebuffer_ratio_batch,
-    ring_offsets, run_swarm_batch, run_swarm_scenario, stack_pytrees)
+    ring_offsets, run_batch_chunked, run_swarm_batch,
+    run_swarm_scenario, stack_pytrees, timeline_columns)
 from hlsjs_p2p_wrapper_tpu.parallel import (make_scenario_mesh,
                                             sharded_run_batch)
 
@@ -143,6 +145,177 @@ def test_hybrid_scenario_peer_mesh_matches_unsharded():
         states=stack_pytrees([init_swarm(config)] * 4), n_steps=n_steps)
     assert jnp.allclose(offload_ratio_batch(sharded),
                         offload_ratio_batch(unsharded), atol=1e-4)
+
+
+# -- on-device metrics timelines (record_every) ------------------------
+
+RECORD_EVERY = 20  # divides the 120-step fixture: 6 samples
+
+
+def test_timeline_off_leaves_final_state_bit_identical():
+    """``record_every=N`` restructures the scan (nested intervals) but
+    must not perturb the simulation: the final state is bit-identical
+    to the ``record_every=0`` program — which is itself the exact
+    pre-timeline program (the default changes nothing for existing
+    callers)."""
+    config, scenarios, _join, n_steps = batch_fixture(n_lanes=1)
+    plain, plain_series = run_swarm_scenario(
+        config, scenarios[0], init_swarm(config), n_steps)
+    final, series, timeline = run_swarm_scenario(
+        config, scenarios[0], init_swarm(config), n_steps,
+        record_every=RECORD_EVERY)
+    for a, b in zip(jax.tree_util.tree_leaves(final),
+                    jax.tree_util.tree_leaves(plain), strict=True):
+        assert jnp.array_equal(a, b), \
+            "recording the timeline changed the simulation"
+    assert jnp.array_equal(series, plain_series)
+    assert timeline.shape == (n_steps // RECORD_EVERY,
+                              len(timeline_columns(config)))
+
+
+def test_timeline_last_sample_matches_final_metrics_bit_exact():
+    """The acceptance contract: the LAST timeline sample's offload and
+    rebuffer columns equal the final-state ``offload_ratio`` /
+    ``rebuffer_ratio`` (the numbers the sweep tools publish)
+    bit-exactly, and its clock column is the full watch window."""
+    config, scenarios, join, n_steps = batch_fixture(n_lanes=2)
+    cols = timeline_columns(config)
+    for sc in scenarios:
+        final, _series, timeline = run_swarm_scenario(
+            config, sc, init_swarm(config), n_steps,
+            record_every=RECORD_EVERY)
+        last = timeline[-1]
+        assert float(last[cols.index("t_s")]) == WATCH_S
+        assert float(last[cols.index("offload")]) == \
+            float(offload_ratio(final))
+        assert float(last[cols.index("rebuffer")]) == \
+            float(rebuffer_ratio(final, WATCH_S, join))
+
+
+def test_timeline_level_counts_account_every_present_peer():
+    config, scenarios, join, n_steps = batch_fixture(n_lanes=1)
+    cols = timeline_columns(config)
+    _final, _series, timeline = run_swarm_scenario(
+        config, scenarios[0], init_swarm(config), n_steps,
+        record_every=RECORD_EVERY)
+    level_cols = [i for i, c in enumerate(cols)
+                  if c.startswith("level_")]
+    t_col = cols.index("t_s")
+    for sample in timeline:
+        present = float(jnp.sum(
+            (sample[t_col] >= join).astype(jnp.float32)))
+        assert float(sum(sample[i] for i in level_cols)) == present, \
+            "per-level peer counts must partition the present peers"
+
+
+def test_timeline_batched_equals_sequential_per_lane():
+    config, scenarios, _join, n_steps = batch_fixture(n_lanes=3)
+    _finals, _series, timelines = run_swarm_batch(
+        config, stack_pytrees(scenarios),
+        stack_pytrees([init_swarm(config)] * 3), n_steps,
+        record_every=RECORD_EVERY)
+    for lane, sc in enumerate(scenarios):
+        _f, _s, single = run_swarm_scenario(
+            config, sc, init_swarm(config), n_steps,
+            record_every=RECORD_EVERY)
+        assert jnp.array_equal(timelines[lane], single), \
+            f"lane {lane} timeline diverged from the sequential path"
+
+
+def test_timeline_trailing_remainder_steps_still_run():
+    """47 % 20 != 0: the timeline stops at the last full interval but
+    the final state (and the offload series) still covers all
+    n_steps."""
+    config, scenarios, _join, _ = batch_fixture(n_lanes=1)
+    n_steps = 47
+    plain, plain_series = run_swarm_scenario(
+        config, scenarios[0], init_swarm(config), n_steps)
+    final, series, timeline = run_swarm_scenario(
+        config, scenarios[0], init_swarm(config), n_steps,
+        record_every=RECORD_EVERY)
+    assert timeline.shape[0] == 2
+    assert series.shape == (n_steps,)
+    assert jnp.array_equal(series, plain_series)
+    for a, b in zip(jax.tree_util.tree_leaves(final),
+                    jax.tree_util.tree_leaves(plain), strict=True):
+        assert jnp.array_equal(a, b)
+
+
+def test_negative_record_every_rejected():
+    config, scenarios, _join, n_steps = batch_fixture(n_lanes=1)
+    with pytest.raises(ValueError, match="record_every"):
+        run_swarm_scenario(config, scenarios[0], init_swarm(config),
+                           n_steps, record_every=-1)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_timeline_sharded_matches_unsharded():
+    """Timeline rows are per-lane reductions, so sharding the scenario
+    axis must reproduce them exactly (zero cross-lane interaction)."""
+    config, scenarios, _join, n_steps = batch_fixture(n_lanes=8)
+    stacked = stack_pytrees(scenarios)
+    _f, _s, unsharded = run_swarm_batch(
+        config, stacked, stack_pytrees([init_swarm(config)] * 8),
+        n_steps, record_every=RECORD_EVERY)
+    mesh = make_scenario_mesh(jax.devices()[:8])
+    _f, _s, sharded = sharded_run_batch(
+        config=config, mesh=mesh, scenarios=stacked,
+        states=stack_pytrees([init_swarm(config)] * 8),
+        n_steps=n_steps, record_every=RECORD_EVERY)
+    assert jnp.array_equal(sharded, unsharded), \
+        "scenario-sharded timeline diverged from unsharded"
+
+
+# -- chunked dispatch: timelines + span tracing ------------------------
+
+def chunked_fixture():
+    config, scenarios, join, n_steps = batch_fixture(n_lanes=5)
+    items = list(range(len(scenarios)))
+    build = lambda i: (scenarios[i], join)  # noqa: E731
+    return config, items, build, join, n_steps
+
+
+def test_chunked_timelines_match_direct_batch():
+    """``run_batch_chunked(record_every=N)`` returns per-item
+    ``(offload, rebuffer, timeline)`` triples whose timeline equals
+    the direct ``run_swarm_batch`` lane — through the pad/drain
+    bookkeeping (5 items, chunk 2 forces padding)."""
+    config, items, build, _join, n_steps = chunked_fixture()
+    out = run_batch_chunked(config, items, build, n_steps,
+                            watch_s=WATCH_S, chunk=2,
+                            record_every=RECORD_EVERY)
+    assert len(out) == len(items)
+    for i, (off, reb, tl) in enumerate(out):
+        _f, _s, single = run_swarm_scenario(
+            config, build(i)[0], init_swarm(config), n_steps,
+            record_every=RECORD_EVERY)
+        assert jnp.array_equal(jnp.asarray(tl), single), \
+            f"item {i} chunked timeline diverged"
+
+
+def test_chunked_pipeline_off_is_pure_reordering():
+    """``pipeline=False`` (the overlap baseline bench.py measures
+    against) must return identical results — it only changes WHEN the
+    host blocks, never what it reads."""
+    config, items, build, _join, n_steps = chunked_fixture()
+    piped = run_batch_chunked(config, items, build, n_steps,
+                              watch_s=WATCH_S, chunk=2)
+    drained = run_batch_chunked(config, items, build, n_steps,
+                                watch_s=WATCH_S, chunk=2,
+                                pipeline=False)
+    assert piped == drained
+
+
+def test_chunked_tracer_records_phase_spans_per_chunk():
+    config, items, build, _join, n_steps = chunked_fixture()
+    tracer = SpanRecorder()
+    run_batch_chunked(config, items, build, n_steps, watch_s=WATCH_S,
+                      chunk=2, tracer=tracer)
+    by_name = tracer.by_name()
+    n_chunks = 3  # ceil(5 / 2)
+    for phase in ("build", "dispatch", "readback"):
+        assert [s["chunk"] for s in by_name[phase]] == \
+            list(range(n_chunks)), f"missing {phase} spans"
 
 
 # -- the sweep tool's engines agree ------------------------------------

@@ -1,0 +1,255 @@
+"""Unified host telemetry (engine/telemetry.py): the registry's
+instrument contracts (locked bumps, memoized labeled series, snapshot
+and delta reads, Prometheus-style cumulative histogram buckets), the
+VirtualClock-stamped JSON-lines exporter, and the dispatch span
+recorder bench.py's overlap metric is built on."""
+
+import json
+import threading
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import (
+    Histogram, JsonlExporter, MetricsRegistry, SpanRecorder,
+    overlap_efficiency)
+
+
+# -- instruments -------------------------------------------------------
+
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    g = reg.gauge("g")
+    g.set(7)
+    g.inc(2)
+    g.dec()
+    assert g.value == 8
+
+
+def test_registry_memoizes_by_name_and_labels():
+    reg = MetricsRegistry()
+    assert reg.counter("net.rejects", reason="psk") is \
+        reg.counter("net.rejects", reason="psk")
+    assert reg.counter("net.rejects", reason="psk") is not \
+        reg.counter("net.rejects", reason="tls")
+
+
+def test_registry_rejects_kind_conflict():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError, match="registered as counter"):
+        reg.gauge("x")
+
+
+def test_counter_set_value_assignment_semantics():
+    """The AgentStats setter primitive: plain last-write-wins
+    assignment under the instrument lock.  Downward corrections must
+    take effect (a transport's progress over-report reconciled at
+    completion adjusts the total DOWN), and concurrent assigners of
+    the same monotone sequence converge to its maximum — an update
+    can be lost, never double-applied."""
+    reg = MetricsRegistry()
+    c = reg.counter("bytes")
+    c.set_value(1000)
+    c.set_value(900)  # negative reconciliation: NOT a clamp
+    assert c.value == 900
+
+    def assign(total):
+        for v in range(0, total, 7):
+            c.set_value(v)
+    threads = [threading.Thread(target=assign, args=(10_000,))
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # every thread's LAST write is 9996, so the globally-last write is
+    # 9996 regardless of interleaving
+    assert c.value == 9996
+
+
+def test_counter_locked_bumps_survive_contention():
+    """The ``_count`` contract the registry inherits (engine/net.py):
+    concurrent bumps must not drop increments."""
+    reg = MetricsRegistry()
+    c = reg.counter("burst")
+
+    def bump():
+        for _ in range(1000):
+            c.inc()
+    threads = [threading.Thread(target=bump) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+
+
+def test_histogram_cumulative_le_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 0.7, 5.0, 50.0, 5000.0):
+        h.observe(v)
+    read = h.read()
+    # cumulative (Prometheus le semantics): each bound counts
+    # everything at or below it
+    assert read["buckets"] == {"le_1": 2, "le_10": 3, "le_100": 4,
+                               "le_inf": 5}
+    assert read["count"] == 5
+    assert read["sum"] == pytest.approx(5056.2)
+
+
+def test_histogram_boundary_value_lands_in_its_bucket():
+    h = Histogram("h", buckets=(10.0,))
+    h.observe(10.0)  # le = "less than or equal"
+    assert h.read()["buckets"]["le_10"] == 1
+
+
+def test_histogram_requires_buckets():
+    with pytest.raises(ValueError, match="bucket"):
+        Histogram("h", buckets=())
+
+
+def test_histogram_rejects_conflicting_buckets_on_memoized_hit():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=(1.0, 10.0))
+    assert reg.histogram("lat", buckets=(10.0, 1.0)) is h  # same set
+    # the default means "whatever the instrument already has": a
+    # second call site re-requesting the handle must not need to
+    # restate (or collide with) the custom layout
+    assert reg.histogram("lat") is h
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("lat", buckets=(0.1, 0.5))
+
+
+def test_prune_drops_a_departed_peers_series():
+    reg = MetricsRegistry()
+    reg.counter("agent.cdn_bytes", peer="p1").inc(5)
+    reg.gauge("agent.peers", peer="p1").set(3)
+    reg.counter("agent.cdn_bytes", peer="p2").inc(7)
+    reg.counter("tracker.announces").inc()
+    assert reg.prune(peer="p1") == 2
+    snap = reg.snapshot()
+    assert "agent.cdn_bytes{peer=p1}" not in snap
+    assert snap["agent.cdn_bytes{peer=p2}"] == 7
+    assert snap["tracker.announces"] == 1
+    with pytest.raises(ValueError, match="label"):
+        reg.prune()
+
+
+# -- snapshot / delta / series -----------------------------------------
+
+def test_snapshot_formats_labeled_keys():
+    reg = MetricsRegistry()
+    reg.counter("plain").inc()
+    reg.counter("fam", b="2", a="1").inc(3)
+    snap = reg.snapshot()
+    assert snap["plain"] == 1
+    # labels serialize sorted, so the key is stable
+    assert snap["fam{a=1,b=2}"] == 3
+
+
+def test_series_reads_one_label_family():
+    reg = MetricsRegistry()
+    reg.counter("net.rejects", reason="psk").inc(2)
+    reg.counter("net.rejects", reason="tls").inc()
+    reg.counter("other").inc(9)
+    fam = dict((labels["reason"], value)
+               for labels, value in reg.series("net.rejects"))
+    assert fam == {"psk": 2, "tls": 1}
+
+
+def test_delta_subtracts_counters_and_histograms_not_gauges():
+    reg = MetricsRegistry()
+    c = reg.counter("c")
+    g = reg.gauge("g")
+    h = reg.histogram("h", buckets=(10.0,))
+    c.inc(5)
+    g.set(100)
+    h.observe(3.0)
+    before = reg.snapshot()
+    c.inc(2)
+    g.set(42)
+    h.observe(4.0)
+    h.observe(40.0)
+    d = reg.delta(before)
+    assert d["c"] == 2
+    assert d["g"] == 42  # point-in-time: passes through
+    assert d["h"] == {"buckets": {"le_10": 1, "le_inf": 2},
+                      "count": 2, "sum": pytest.approx(44.0)}
+
+
+def test_delta_against_empty_snapshot_is_full_value():
+    reg = MetricsRegistry()
+    reg.counter("new").inc(3)
+    assert reg.delta({})["new"] == 3
+
+
+# -- JSON-lines export -------------------------------------------------
+
+def test_jsonl_exporter_stamps_virtual_clock(tmp_path):
+    reg = MetricsRegistry()
+    clock = VirtualClock()
+    path = tmp_path / "metrics.jsonl"
+    reg.counter("c").inc()
+    with JsonlExporter(reg, clock, str(path)) as exporter:
+        exporter.export(round=0)
+        clock.advance(1234.0)
+        reg.counter("c").inc()
+        exporter.export(round=1, final=True)
+    lines = [json.loads(line)
+             for line in path.read_text().splitlines()]
+    assert [ln["t_ms"] for ln in lines] == [0.0, 1234.0]
+    assert lines[0]["metrics"]["c"] == 1
+    assert lines[1]["metrics"]["c"] == 2
+    assert lines[1]["round"] == 1 and lines[1]["final"] is True
+
+
+def test_jsonl_exporter_close_idempotent(tmp_path):
+    exporter = JsonlExporter(MetricsRegistry(), VirtualClock(),
+                             str(tmp_path / "m.jsonl"))
+    exporter.close()
+    exporter.close()
+
+
+# -- span tracing ------------------------------------------------------
+
+def test_span_recorder_records_attrs_and_totals():
+    tracer = SpanRecorder()
+    with tracer.span("dispatch", chunk=0):
+        pass
+    with tracer.span("dispatch", chunk=1):
+        pass
+    with tracer.span("readback", chunk=0):
+        pass
+    by_name = tracer.by_name()
+    assert sorted(by_name) == ["dispatch", "readback"]
+    assert [s["chunk"] for s in by_name["dispatch"]] == [0, 1]
+    for span in tracer.spans:
+        assert span["end_s"] >= span["start_s"]
+        assert span["duration_s"] == pytest.approx(
+            span["end_s"] - span["start_s"])
+    assert tracer.total("dispatch") == pytest.approx(
+        sum(s["duration_s"] for s in by_name["dispatch"]))
+    assert tracer.total("absent") == 0.0
+
+
+def test_span_records_even_when_body_raises():
+    tracer = SpanRecorder()
+    with pytest.raises(RuntimeError):
+        with tracer.span("dispatch", chunk=0):
+            raise RuntimeError("device fell over")
+    assert len(tracer.spans) == 1
+
+
+def test_overlap_efficiency_clamps():
+    assert overlap_efficiency(1.0, 2.0, 1.0) == 1.0
+    assert overlap_efficiency(1.0, 3.0, 1.0) == 1.0  # clamped high
+    assert overlap_efficiency(2.0, 2.0, 1.0) == 0.0
+    assert overlap_efficiency(3.0, 2.0, 1.0) == 0.0  # clamped low
+    assert overlap_efficiency(1.0, 2.0, 0.0) == 0.0  # no readback
+    assert overlap_efficiency(1.5, 2.0, 1.0) == pytest.approx(0.5)

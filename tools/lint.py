@@ -1,7 +1,11 @@
 """Dependency-free linter (the reference's eslint tier; this image
 ships no Python linter and installs are off-limits, so the checks
 live in-tree): syntax, unused/duplicate imports, bare excepts,
-mutable default arguments, tabs, trailing whitespace, long lines.
+mutable default arguments, tabs, trailing whitespace, long lines —
+and no ``print(`` inside the package (``hlsjs_p2p_wrapper_tpu/``):
+library code logs through ``logging`` or counts into the telemetry
+registry (engine/telemetry.py); tools/tests/examples, which OWN their
+stdout, are exempt.
 
 Run: ``python tools/lint.py`` (exit code 1 on findings).
 """
@@ -88,9 +92,16 @@ def check_file(path):
         if name not in used and not name.startswith("_"):
             findings.append(f"{path}:{lineno}: unused import '{name}'")
 
+    in_package = (os.sep + "hlsjs_p2p_wrapper_tpu" + os.sep) in path
     for node in ast.walk(tree):
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             findings.append(f"{path}:{node.lineno}: bare except")
+        if (in_package and isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            findings.append(
+                f"{path}:{node.lineno}: print() in package code — "
+                f"use logging or the telemetry registry")
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for default in node.args.defaults + node.args.kw_defaults:
                 if isinstance(default, (ast.List, ast.Dict, ast.Set)):

@@ -50,7 +50,8 @@ import jax.numpy as jnp  # noqa: E402
 
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
     SwarmConfig, make_scenario, random_neighbors, ring_offsets,
-    run_batch_chunked, stable_ranks, staggered_joins)
+    run_batch_chunked, stable_ranks, staggered_joins,
+    timeline_columns)
 
 BITRATE = 800_000.0
 UPLINK_GRID_MBPS = (1.2, 1.6, 2.4, 4.0, 10.0)
@@ -116,18 +117,24 @@ def build_cell_scenario(config, neighbors, audience, *, uplink_bps,
 
 
 def run_cells_batched(config, neighbors, audience, cells, *, watch_s,
-                      chunk):
+                      chunk, record_every=0):
     """All regime cells of one (topology, policy) compile group
     through the shared chunked/pipelined dispatch engine
     (``run_batch_chunked``); returns per-cell ``(offload, rebuffer)``
-    floats in cell order."""
+    floats in cell order — ``(offload, rebuffer, timeline)`` triples
+    when ``record_every > 0`` (the on-device metrics timeline,
+    ops/swarm_sim.py ``timeline_columns``)."""
     n_steps = int(watch_s * 1000.0 / config.dt_ms)
     metrics = run_batch_chunked(
         config, cells,
         lambda cell: build_cell_scenario(
             config, neighbors, audience, uplink_bps=cell[2] * 1e6,
             pattern=cell[0], wave=cell[1], watch_s=watch_s),
-        n_steps, watch_s=watch_s, chunk=chunk)
+        n_steps, watch_s=watch_s, chunk=chunk,
+        record_every=record_every)
+    if record_every:
+        return [(round(off, 4), round(reb, 5), tl)
+                for off, reb, tl in metrics]
     return [(round(off, 4), round(reb, 5)) for off, reb in metrics]
 
 
@@ -146,13 +153,27 @@ def main():
                          "the [B, P, ...] batch state on device)")
     ap.add_argument("--out", metavar="FILE",
                     help="write the A/B table as JSON")
+    ap.add_argument("--record-every", type=int, default=0, metavar="N",
+                    help="emit an on-device metrics timeline sample "
+                         "every N steps per regime cell (0 = off)")
+    ap.add_argument("--timelines-out", metavar="FILE",
+                    help="write per-(topology, policy, cell) "
+                         "timelines as JSON lines; implies "
+                         "--record-every 20 when that is unset")
     args = ap.parse_args()
+    if args.timelines_out and not args.record_every:
+        args.record_every = 20
+    if args.record_every and not args.timelines_out:
+        ap.error("--record-every without --timelines-out would "
+                 "compute every timeline and then discard it — "
+                 "name an output file")
 
     cells = [(pattern, wave, up) for pattern in PATTERNS
              for wave in WAVES for up in UPLINK_GRID_MBPS]
 
     t0 = time.perf_counter()
     tables = {}
+    timeline_records = []
     worst = {"cell": None, "margin": 1.0}
     best = {"cell": None, "margin": -1.0}
     rebuffer_spread_max = 0.0
@@ -179,7 +200,30 @@ def main():
                                      holder_selection=policy)
             per_policy[policy] = run_cells_batched(
                 config, neighbors, audience, cells,
-                watch_s=args.watch_s, chunk=args.chunk)
+                watch_s=args.watch_s, chunk=args.chunk,
+                record_every=args.record_every)
+            if args.record_every:
+                # strip the timeline blocks back off the metric pairs
+                # (the A/B table stays pairs-only) and keep them as
+                # labeled trajectory records
+                columns = list(timeline_columns(config))
+                for (pattern, wave, up), (off, reb, tl) in zip(
+                        cells, per_policy[policy]):
+                    timeline_records.append({
+                        "topology": topology, "policy": policy,
+                        "pattern": pattern, "wave": wave,
+                        "uplink_mbps": up, "offload": off,
+                        "rebuffer": reb,
+                        "record_every": args.record_every,
+                        "columns": columns,
+                        # full precision — the last sample is the
+                        # exact final-state metric pair (see
+                        # tools/sweep.py)
+                        "samples": [[float(v) for v in sample]
+                                    for sample in tl]})
+                per_policy[policy] = [(off, reb)
+                                      for off, reb, _
+                                      in per_policy[policy]]
         rows = []
         for i, (pattern, wave, uplink_mbps) in enumerate(cells):
             row = {"uplink_mbps": uplink_mbps,
@@ -219,6 +263,13 @@ def main():
             rows.append(row)
         tables[topology] = {"peers": peers, "rows": rows}
     elapsed = time.perf_counter() - t0
+
+    if args.timelines_out:
+        with open(args.timelines_out, "w", encoding="utf-8") as f:
+            for record in timeline_records:
+                f.write(json.dumps(record) + "\n")
+        print(f"# wrote {len(timeline_records)} timelines to "
+              f"{args.timelines_out}", file=sys.stderr)
 
     for topology, table in tables.items():
         print(f"\n{topology} topology ({table['peers']} peers):")

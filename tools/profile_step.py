@@ -2,7 +2,12 @@
 device: times isolated variants of the step's suspicious ops (neighbor
 gather, holder-load scatter-add, cache-map gather/scatter) to find
 what dominates, plus the scenario-batched dispatch vs the per-point
-Python loop (the sweep engine's amortization, run_swarm_batch).
+Python loop (the sweep engine's amortization, run_swarm_batch) — and
+a SPAN-TRACED pass of the chunked dispatch engine itself
+(run_batch_chunked with an engine.telemetry.SpanRecorder attached):
+per-chunk build / dispatch / readback wall-clock, pipelined vs
+drain-per-chunk, so the readback/compute overlap the pipelining
+claims is a printed number on THIS host, not an HLO inference.
 Usage: python tools/profile_step.py [--peers N] [--batch B]"""
 
 import argparse
@@ -142,6 +147,43 @@ def main():
 
     timeit(f"batched {B}-scenario scan x{T} ({Pb} peers)", batched)
     timeit(f"looped {B}x sequential scan x{T} ({Pb} peers)", looped)
+
+    # 8. the chunked dispatch pipeline, span-traced: where does the
+    # wall-clock of a 2-chunk sweep actually go?  The pipelined pass
+    # should hide (most of) its readback under the next chunk's
+    # compute; the drain-per-chunk pass pays it serially.
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import (  # noqa: E402
+        SpanRecorder, overlap_efficiency)
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
+        run_batch_chunked)
+    watch_s = T * bconfig.dt_ms / 1000.0
+    chunk = max(1, B // 2)
+
+    def chunked(pipeline):
+        tracer = SpanRecorder()
+        t0 = time.perf_counter()
+        run_batch_chunked(
+            bconfig, list(range(B)),
+            lambda i: (bscens[i], jnp.zeros((Pb,))), T,
+            watch_s=watch_s, chunk=chunk, tracer=tracer,
+            pipeline=pipeline)
+        return time.perf_counter() - t0, tracer
+
+    chunked(True)  # warm (compile) outside the traced passes
+    piped_s, piped = chunked(True)
+    serial_s, serial = chunked(False)
+    print(f"\nchunked dispatch spans ({B} scenarios, chunk {chunk}, "
+          f"{Pb} peers):")
+    for mode, wall, tracer in (("pipelined", piped_s, piped),
+                               ("drain-per-chunk", serial_s, serial)):
+        phases = "  ".join(
+            f"{name}={tracer.total(name) * 1e3:.1f}ms"
+            for name in ("build", "dispatch", "readback"))
+        print(f"  {mode:<16} wall={wall * 1e3:9.2f} ms  {phases}")
+    eff = overlap_efficiency(piped_s, serial_s,
+                             serial.total("readback"))
+    print(f"  overlap efficiency (readback hidden under compute): "
+          f"{eff:.2f}")
 
 
 if __name__ == "__main__":

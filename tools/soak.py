@@ -9,13 +9,27 @@ uploads, downloads, or bans — the round-4 reap/bound work), playback
 must stay healthy (rebuffer < 5%), and the swarm must genuinely
 offload (> 0.3).
 
-Deterministic (seeded RNG + VirtualClock).  ~35 s of wall clock for
-~5 simulated minutes with ~36 churned viewers.
+Since the telemetry round the soak is ALSO the export proof: every
+churn round the swarm's shared :class:`MetricsRegistry` is
+serialized to a JSON-lines artifact (``SOAK_local.jsonl`` by
+default — uncommitted, like ``SCALING_local.json``), and the final
+invariants are checked FROM THE PARSED ARTIFACT, not from the live
+objects — offload is re-derived by summing the per-peer
+``agent.cdn_bytes{peer=…}`` / ``agent.p2p_bytes{peer=…}`` series,
+rebuffer from the ``peer.rebuffer_ms`` / ``peer.watched_ms`` gauges.
+A metric the exporter dropped would fail the run, which is exactly
+the point: the export path is complete or the soak is red.
 
-Usage: ``python tools/soak.py [--rounds N] [--seed S]``
+Deterministic (seeded RNG + VirtualClock; exported timestamps are
+simulated ms).  ~35 s of wall clock for ~5 simulated minutes with
+~36 churned viewers.
+
+Usage: ``python tools/soak.py [--rounds N] [--seed S]
+[--metrics-out SOAK_local.jsonl]``
 """
 
 import argparse
+import json
 import os
 import random
 import sys
@@ -26,11 +40,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from hlsjs_p2p_wrapper_tpu.testing import SwarmHarness  # noqa: E402
 
 
+def series_sum(metrics: dict, name: str) -> float:
+    """Sum one labeled family (``name{...}`` keys AND a bare ``name``
+    key) out of an exported snapshot dict."""
+    return sum(v for k, v in metrics.items()
+               if (k == name or k.startswith(name + "{"))
+               and isinstance(v, (int, float)))
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=40,
                         help="churn rounds of 7 simulated seconds each")
     parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--metrics-out", default="SOAK_local.jsonl",
+                        metavar="FILE",
+                        help="JSON-lines metrics artifact (one line "
+                             "per churn round; overwritten per run)")
     args = parser.parse_args()
 
     t0 = time.time()
@@ -43,12 +69,17 @@ def main() -> int:
     # penalties-reference-departed-peers invariant below vacuous —
     # adaptive exercises the richer state surface the soak audits
     soak_cfg = {"holder_selection": "adaptive"}
+    # fresh artifact per run: the exporter appends (a long-running
+    # service keeps one file), but each soak is its own evidence
+    if os.path.exists(args.metrics_out):
+        os.remove(args.metrics_out)
+    exporter = swarm.open_exporter(args.metrics_out)
     swarm.add_peer("seed", uplink_bps=20_000_000.0,
                    p2p_config=dict(soak_cfg))
     swarm.run(15_000.0)
     alive = []
     counter = 0
-    for _ in range(args.rounds):
+    for round_no in range(args.rounds):
         if rng.random() < 0.75 or not alive:
             counter += 1
             alive.append(swarm.add_peer(
@@ -58,11 +89,31 @@ def main() -> int:
         else:
             alive.pop(rng.randrange(len(alive))).leave()
         swarm.run(7_000.0)
+        swarm.record_metrics()
+        exporter.export(round=round_no)
     swarm.run(30_000.0)  # quiesce past the announce-cadence reaps
 
     seed = next(p for p in swarm.peers if p.peer_id == "seed")
     mesh = seed.agent.mesh
     live_ids = {p.peer_id for p in swarm.peers if not p.left} - {"seed"}
+    # the mesh-state invariants are SET-valued (which ids leaked), so
+    # the live objects compute them — but they export as counts, and
+    # the checks below read the counts back from the artifact
+    leaked = set(mesh.peers) - live_ids
+    stale_uploads = [k for k in mesh._uploads if k[0] not in live_ids]
+    stale_downloads = [d for d in mesh._downloads.values()
+                       if d.peer_id not in live_ids]
+    stale_penalties = set(mesh._holder_penalty) - (live_ids | {"seed"})
+    m = swarm.metrics
+    m.gauge("soak.seed_mesh_leaked_peers").set(len(leaked))
+    m.gauge("soak.seed_stale_upload_slots").set(len(stale_uploads))
+    m.gauge("soak.seed_stale_downloads").set(len(stale_downloads))
+    m.gauge("soak.seed_banned").set(len(mesh._banned))
+    m.gauge("soak.seed_stale_penalties").set(len(stale_penalties))
+    swarm.record_metrics()
+    exporter.export(round=args.rounds, final=True)
+    exporter.close()
+
     print(f"wall={time.time() - t0:.1f}s  peers_created={counter}  "
           f"live={len(live_ids)}  offload={swarm.offload_ratio:.2f}  "
           f"rebuffer={swarm.rebuffer_ratio:.3%}  "
@@ -72,6 +123,13 @@ def main() -> int:
           f"downloads={len(mesh._downloads)} banned={len(mesh._banned)} "
           f"penalties={len(mesh._holder_penalty)}")
 
+    # ---- invariants, checked from the EXPORTED artifact ------------
+    with open(args.metrics_out, encoding="utf-8") as fh:
+        records = [json.loads(line) for line in fh]
+    print(f"metrics artifact: {args.metrics_out} "
+          f"({len(records)} lines, "
+          f"{len(records[-1]['metrics'])} series in the final line)")
+
     failures = []
 
     def check(ok: bool, what: str) -> None:
@@ -80,24 +138,57 @@ def main() -> int:
         if not ok:
             failures.append(what)
 
-    leaked = set(mesh.peers) - live_ids
-    check(not leaked, f"mesh kept state for departed peers: {leaked}")
-    check(all(k[0] in live_ids for k in mesh._uploads),
+    check(len(records) == args.rounds + 1,
+          f"expected {args.rounds + 1} export lines, "
+          f"got {len(records)}")
+    final = records[-1]["metrics"]
+    check(records[-1]["t_ms"] == swarm.clock.now(),
+          "final export is not stamped with the VirtualClock")
+
+    # north-star pair, RE-DERIVED from per-peer series (a dropped
+    # peer label would shift these, so this doubles as completeness)
+    cdn = series_sum(final, "agent.cdn_bytes")
+    p2p = series_sum(final, "agent.p2p_bytes")
+    offload = p2p / (cdn + p2p) if cdn + p2p else 0.0
+    stalled = series_sum(final, "peer.rebuffer_ms")
+    watched = series_sum(final, "peer.watched_ms")
+    rebuffer = stalled / watched if watched else 0.0
+    check(abs(offload - final["swarm.offload_ratio"]) < 1e-9,
+          "per-peer byte series disagree with the swarm offload gauge "
+          "— the export dropped a peer")
+    check(abs(rebuffer - final["swarm.rebuffer_ratio"]) < 1e-9,
+          "per-peer stall/watch series disagree with the swarm "
+          "rebuffer gauge — the export dropped a peer")
+    check(final["swarm.peers_total"] == counter + 1,
+          "exported peer total diverged from peers created")
+
+    check(final["soak.seed_mesh_leaked_peers"] == 0,
+          f"mesh kept state for departed peers: {leaked}")
+    check(final["soak.seed_stale_upload_slots"] == 0,
           "upload slots reference departed peers")
-    check(all(d.peer_id in live_ids for d in mesh._downloads.values()),
+    check(final["soak.seed_stale_downloads"] == 0,
           "in-flight downloads reference departed peers")
-    check(mesh._banned == {}, f"bans outlived clean churn: {mesh._banned}")
-    check(set(mesh._holder_penalty) <= live_ids | {"seed"},
+    check(final["soak.seed_banned"] == 0,
+          f"bans outlived clean churn: {mesh._banned}")
+    check(final["soak.seed_stale_penalties"] == 0,
           "holder penalties reference departed peers")
-    check(swarm.rebuffer_ratio < 0.05,
-          f"rebuffer {swarm.rebuffer_ratio:.3%}")
-    check(swarm.offload_ratio > 0.3,
-          f"offload {swarm.offload_ratio:.2f}")
+    check(rebuffer < 0.05, f"rebuffer {rebuffer:.3%}")
+    check(offload > 0.3, f"offload {offload:.2f}")
+    # the engine-side registry series must be in the file too: a
+    # tracker that answered this much churn cannot have zero
+    # announces, and the mesh lifecycle family must at least be
+    # PRESENT (orderly BYE departures legitimately reap nothing,
+    # so zero is a valid value — absence is not)
+    check(series_sum(final, "tracker.announces") > 0,
+          "tracker.announces missing from the export")
+    check(any(k.startswith("mesh.reaps") for k in final),
+          "mesh reap counters missing from the export")
     if failures:
         for what in failures:
             print(f"SOAK FAILURE: {what}", file=sys.stderr)
         return 1
-    print("soak: all long-uptime invariants hold")
+    print("soak: all long-uptime invariants hold (checked from the "
+          "exported artifact)")
     return 0
 
 

@@ -58,6 +58,15 @@ Output: one row per grid point with the north-star pair
 knob values, sorted best-offload-first; ``--json`` emits one JSON
 line per row for downstream tooling, ``--out FILE`` writes the whole
 sweep (meta + rows) as a JSON artifact.
+
+``--record-every N`` additionally pulls each grid point's on-device
+METRICS TIMELINE off the dispatch (one ``[n_steps // N, M]`` row
+block per point — offload/rebuffer trajectory, byte rates, stalls,
+per-level peer counts; ops/swarm_sim.py ``timeline_columns``), and
+``--timelines-out FILE`` dumps them as JSON lines (one object per
+grid point: knobs + columns + samples) so a debug session can see
+WHEN offload ramps or the ladder oscillates, not just where it
+ended.
 """
 
 import argparse
@@ -75,7 +84,8 @@ import jax.numpy as jnp  # noqa: E402
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
     UNREACHABLE_BITRATE, SwarmConfig, init_swarm, make_scenario,
     offload_ratio, rebuffer_ratio, ring_offsets, run_batch_chunked,
-    run_swarm_scenario, stable_ranks, staggered_joins)
+    run_swarm_scenario, stable_ranks, staggered_joins,
+    timeline_columns)
 
 LADDERS = {
     "sd": (300_000.0, 800_000.0),
@@ -205,11 +215,16 @@ def _static_key(knobs, live):
 
 
 def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
-                     chunk=DEFAULT_CHUNK, stagger_s=60.0):
+                     chunk=DEFAULT_CHUNK, stagger_s=60.0,
+                     record_every=0, tracer=None, pipeline=True):
     """The batched engine: one ``run_swarm_batch`` dispatch per
     padded chunk, host readback pipelined one chunk behind the
     device (``run_batch_chunked``).  Returns ``(rows, n_compiles)``
-    with rows in grid order."""
+    with rows in grid order; ``record_every=N`` attaches each row's
+    on-device metrics timeline under the ``"_timeline"`` key (a
+    ``[n_steps // N, M]`` numpy array the caller pops before
+    serializing the frontier table).  ``tracer``/``pipeline`` pass
+    through to the dispatch engine (bench.py's overlap metric)."""
     groups = {}
     for knobs in grid:
         groups.setdefault(_static_key(knobs, live), []).append(knobs)
@@ -224,11 +239,19 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
             config, points,
             lambda k: build_scenario(config, k, watch_s=watch_s,
                                      stagger_s=stagger_s, seed=seed),
-            n_steps, watch_s=watch_s, chunk=chunk)
+            n_steps, watch_s=watch_s, chunk=chunk,
+            record_every=record_every, tracer=tracer,
+            pipeline=pipeline)
         compiles.add((degree, sync, min(chunk, len(points))))
-        rows.extend({**knobs, "offload": round(off, 4),
-                     "rebuffer": round(reb, 5)}
-                    for knobs, (off, reb) in zip(points, metrics))
+        if record_every:
+            rows.extend({**knobs, "offload": round(off, 4),
+                         "rebuffer": round(reb, 5), "_timeline": tl}
+                        for knobs, (off, reb, tl)
+                        in zip(points, metrics))
+        else:
+            rows.extend({**knobs, "offload": round(off, 4),
+                         "rebuffer": round(reb, 5)}
+                        for knobs, (off, reb) in zip(points, metrics))
     return rows, len(compiles)
 
 
@@ -272,11 +295,29 @@ def main():
     ap.add_argument("--sequential", action="store_true",
                     help="per-point dispatch (the pre-batching "
                          "reference path)")
+    ap.add_argument("--record-every", type=int, default=0, metavar="N",
+                    help="emit an on-device metrics timeline sample "
+                         "every N steps per grid point (0 = off; "
+                         "batched engine only)")
+    ap.add_argument("--timelines-out", metavar="FILE",
+                    help="write per-point timelines as JSON lines "
+                         "(knobs + columns + samples); implies "
+                         "--record-every 20 when that is unset")
     ap.add_argument("--json", action="store_true",
                     help="one JSON line per grid point")
     ap.add_argument("--out", metavar="FILE",
                     help="write the full sweep (meta + rows) as JSON")
     args = ap.parse_args()
+
+    if args.timelines_out and not args.record_every:
+        args.record_every = 20
+    if args.record_every and args.sequential:
+        ap.error("--record-every needs the batched engine "
+                 "(drop --sequential)")
+    if args.record_every and not args.timelines_out:
+        ap.error("--record-every without --timelines-out would "
+                 "compute every timeline and then discard it — "
+                 "name an output file")
 
     grid = live_grid() if args.live else vod_grid()
     engine = run_grid_sequential if args.sequential else run_grid_batched
@@ -284,8 +325,40 @@ def main():
     rows, n_compiles = engine(
         grid, peers=args.peers, segments=args.segments,
         watch_s=args.watch_s, live=args.live, seed=args.seed,
-        chunk=args.chunk)
+        chunk=args.chunk, record_every=args.record_every)
     elapsed = time.perf_counter() - t0
+
+    # the timeline blocks ride the rows out of the engine but never
+    # enter the frontier table / sweep artifact — pop them first
+    timelines = [row.pop("_timeline", None) for row in rows]
+    if args.timelines_out:
+        # derive columns from the same config constructor the engine
+        # uses (today they only depend on the padded N_LEVELS, but a
+        # hard-coded degree would silently mislabel a future
+        # degree-dependent column)
+        columns = timeline_columns(
+            build_config(args.peers, args.segments, args.live,
+                         grid[0]["degree"]))
+        with open(args.timelines_out, "w", encoding="utf-8") as f:
+            for row, tl in zip(rows, timelines):
+                f.write(json.dumps({
+                    **{k: v for k, v in row.items()
+                       if k not in ("offload", "rebuffer")},
+                    "offload": row["offload"],
+                    "rebuffer": row["rebuffer"],
+                    "record_every": args.record_every,
+                    "columns": list(columns),
+                    # FULL precision: the artifact's last sample IS
+                    # the final-state metric pair (the row's
+                    # offload/rebuffer are the table-rounded view of
+                    # the same numbers), so completeness checks hold
+                    # on the file, not just in-process
+                    "samples": [[float(v) for v in sample]
+                                for sample in tl],
+                }) + "\n")
+        print(f"# wrote {len(rows)} timelines "
+              f"({len(columns)} columns) to {args.timelines_out}",
+              file=sys.stderr)
 
     rows.sort(key=lambda r: (-r["offload"], r["rebuffer"]))
     if args.json:
@@ -318,6 +391,7 @@ def main():
                     "points_per_sec": round(len(rows) / elapsed, 3),
                     "engine": mode,
                     "chunk": None if args.sequential else args.chunk,
+                    "record_every": args.record_every or None,
                     "platform": device.platform,
                     "device_kind": getattr(device, "device_kind", "?"),
                 },
