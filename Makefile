@@ -30,8 +30,14 @@ bench:
 sweep:
 	$(PY) tools/sweep.py
 
+# the one-compile-group live grid end to end: sweep with per-point
+# on-device timelines dumped to an UNCOMMITTED JSONL (the
+# SCALING_local.json pattern), then triage the trajectories for
+# ABR-ladder oscillation and offload-ramp stalls — the sweep's
+# output becomes a work list, not 144 plots
 sweep-live:
-	$(PY) tools/sweep.py --live
+	$(PY) tools/sweep.py --live --timelines-out SWEEP_LIVE_TIMELINES_local.jsonl
+	$(PY) tools/triage_timelines.py SWEEP_LIVE_TIMELINES_local.jsonl
 
 # dryrun_multichip self-provisions the virtual 8-CPU mesh (subprocess
 # with JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count);

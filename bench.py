@@ -310,33 +310,69 @@ def sweep_grid_benchmark(reps=3):
     pass per program for compiles, then best-of-``reps`` timed full
     passes — min, like the step bench, because host noise only ever
     ADDS time).  Single-device CPU sizes keep the comparison honest
-    on hosts without an accelerator.
+    on hosts without an accelerator.  The batched engine runs at its
+    AUTOTUNED chunk (ops/swarm_sim.py ``autotune_chunk``), which the
+    metric records alongside the compile-group map and the
+    AOT-measured per-group compile seconds.
 
     Two more programs ride the same interleave (module docstring):
     the drain-per-chunk batched engine under a span tracer (for
     ``overlap_efficiency``) and the batched engine with the
     ``record_every=20`` on-device metrics timeline compiled in (for
-    ``timeline_overhead``)."""
+    ``timeline_overhead``).
+
+    A second comparison covers the LIVE grid's compile-group
+    collapse (``detail.sweep_grid.live_grid``): the merged one-group
+    grid (dynamic ``live_sync_s``) vs the legacy
+    group-per-cushion sequential drain
+    (``static_live_sync=True, interleave=False``) — warm walls plus
+    honest per-mode compile cost via fresh AOT compiles
+    (``compile_batch_seconds``; timing first dispatches instead
+    would credit whichever mode ran second with the other's warm jit
+    cache, since the programs only differ in their config hash)."""
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "tools"))
     import sweep as sweep_tool
     from hlsjs_p2p_wrapper_tpu.engine.telemetry import (
         SpanRecorder, overlap_efficiency)
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (
+        autotune_chunk, compile_batch_seconds, init_swarm,
+        stack_pytrees)
 
-    if jax.devices()[0].platform in ("tpu", "gpu"):
+    on_accelerator = jax.devices()[0].platform in ("tpu", "gpu")
+    if on_accelerator:
         # the round-4 artifact grid (SWEEP_r04/r05.json)
         sizes = dict(peers=1024, segments=128, watch_s=240.0)
     else:
         sizes = dict(peers=512, segments=48, watch_s=30.0)
     grid = sweep_tool.vod_grid()
     common = dict(live=False, seed=0, **sizes)
-    chunk = sweep_tool.DEFAULT_CHUNK
 
-    def run_batched():
-        return sweep_tool.run_grid_batched(grid, chunk=chunk, **common)
+    def compile_seconds_for(config, knobs, batch):
+        """Fresh AOT compile of the batched program for this
+        (config, chunk) — build one scenario, stack the chunk shape."""
+        scenario, _join = sweep_tool.build_scenario(
+            config, knobs, watch_s=sizes["watch_s"], stagger_s=60.0,
+            seed=0)
+        scenarios = stack_pytrees([scenario] * batch)
+        states = stack_pytrees([init_swarm(config)] * batch)
+        n_steps = int(sizes["watch_s"] * 1000.0 / config.dt_ms)
+        return compile_batch_seconds(config, scenarios, states,
+                                     n_steps)
 
     def run_sequential():
         return sweep_tool.run_grid_sequential(grid, **common)
+
+    # the warm pass resolves the autotuned chunk; every LATER pass —
+    # timed, tracer, timeline — is PINNED to that chunk, because
+    # autotune reads live memory_stats and a mid-benchmark re-fit
+    # would change the [B, P, …] program shape and sneak a compile
+    # into a "warm" timed pass
+    rows, batched_info = sweep_tool.run_grid_batched(grid, **common)
+    chunk = batched_info["chunk"]
+
+    def run_batched():
+        return sweep_tool.run_grid_batched(grid, chunk=chunk, **common)
 
     def run_unpipelined(tracer):
         # same compiled program as run_batched — pipeline/tracer only
@@ -352,7 +388,6 @@ def sweep_grid_benchmark(reps=3):
     # warm every program (compiles excluded), then INTERLEAVE the
     # timed passes — a noisy-neighbor burst on a shared host then
     # lands on each program with equal odds instead of biasing one min
-    rows, _ = run_batched()
     seq_rows, _ = run_sequential()
     run_timeline()
     batched_times, sequential_times = [], []
@@ -383,10 +418,130 @@ def sweep_grid_benchmark(reps=3):
     # the engines must be measuring the SAME grid — a silent metric
     # divergence would make the speedup meaningless
     assert len(rows) == len(seq_rows) == len(grid)
+
+    # per-compile-group cost (one group for the whole VOD grid).
+    # The probe config carries an OFF-GRID cushion value: the cushion
+    # never enters a VOD program (identical HLO), but it keys the
+    # in-process compile caches, so probing the exact config the
+    # benchmark already compiled could read ~0 s instead of a real
+    # compile (compile_batch_seconds' documented caveat)
+    vod_probe_config = sweep_tool.build_config(
+        sizes["peers"], sizes["segments"], False, grid[0]["degree"],
+        live_sync_s=5.5)
+    vod_compile_s = compile_seconds_for(vod_probe_config, grid[0],
+                                        chunk)
+
+    # -- the live grid's compile-group collapse ------------------------
+    # a SLICE spanning both cushion values (head sync=6 block, tail
+    # sync=12 block): the comparison needs ≥ 2 legacy groups, not the
+    # artifact grid — at TPU artifact sizes the full 144 points cost
+    # ~90 s per pass (SWEEP_LIVE_r05.json) and this section runs
+    # 2·(reps+1) passes; `tools/sweep.py --live` remains the
+    # full-grid artifact surface
+    half = 24 if on_accelerator else 12
+    live_points = (sweep_tool.live_grid()[:half]
+                   + sweep_tool.live_grid()[-half:])
+    live_common = dict(live=True, seed=0, **sizes)
+
+    # BOTH modes run at the SAME per-dispatch batch shape (the
+    # legacy mode's autotuned per-group chunk), for three reasons:
+    # timed passes must not re-autotune (a mid-benchmark re-fit from
+    # live memory stats would change the program shape and sneak a
+    # compile into a "warm" pass), the warm walls must not confound
+    # batch-size cache effects with the dispatch structure under
+    # test, and the parity assert below must compare rows computed
+    # by identically-shaped programs (cross-shape float divergence
+    # past the rounded decimals would flake it on an accelerator).
+    # The one-group mode's own autotuned chunk is recorded via a
+    # direct autotune_chunk call instead.
+    gs_rows, gs_info = sweep_tool.run_grid_batched(
+        live_points, static_live_sync=True, interleave=False,
+        **live_common)
+    cmp_chunk = gs_info["chunk"]
+
+    def run_live_one_group():
+        return sweep_tool.run_grid_batched(
+            live_points, chunk=cmp_chunk, **live_common)
+
+    def run_live_group_sequential():
+        return sweep_tool.run_grid_batched(
+            live_points, chunk=cmp_chunk, static_live_sync=True,
+            interleave=False, **live_common)
+
+    live_rows, live_info = run_live_one_group()          # warm
+    # the merged grid must be a pure performance transform
+    assert live_rows == gs_rows, \
+        "one-group live grid diverged from the group-sequential rows"
+    live_config = sweep_tool.build_config(
+        sizes["peers"], sizes["segments"], True,
+        live_points[0]["degree"])
+    one_group_autotuned = autotune_chunk(
+        live_config, len(live_points),
+        int(sizes["watch_s"] * 1000.0 / live_config.dt_ms))
+    one_times, gs_times = [], []
+    for _ in range(reps):
+        start = time.perf_counter()
+        run_live_one_group()
+        one_times.append(time.perf_counter() - start)
+        start = time.perf_counter()
+        run_live_group_sequential()
+        gs_times.append(time.perf_counter() - start)
+    one_s, gs_s = min(one_times), min(gs_times)
+
+    # every compile group compiles the SAME program structure (the
+    # cushion is scenario data, not a program constant), so
+    # per-group compile cost is ONE measured fresh compile times the
+    # group count.  Measuring each group's own config would collide
+    # with JAX's in-process compile caches — identical config values
+    # share an entry, so whichever mode measured second would read
+    # ~0 s and the comparison would flip with measurement order; the
+    # probe config uses an OFF-GRID cushion value so its signature
+    # is fresh by construction.
+    probe_config = sweep_tool.build_config(
+        sizes["peers"], sizes["segments"], True,
+        live_points[0]["degree"], live_sync_s=5.5)
+    program_compile_s = compile_seconds_for(probe_config,
+                                            live_points[0], cmp_chunk)
+    one_compile_s = program_compile_s
+    gs_compile_s = program_compile_s * len(gs_info["groups"])
+
+    live_grid_metric = {
+        "what": f"{len(live_points)}-point live grid: one compile "
+                "group (dynamic live_sync_s) vs the legacy "
+                "group-per-cushion sequential drain",
+        "grid_points": len(live_points),
+        "compile_groups": live_info["compile_groups"],
+        "group_sequential_groups": len(gs_info["groups"]),
+        "autotuned_chunk": one_group_autotuned,
+        "comparison_chunk": cmp_chunk,
+        "one_group_wall_s": round(one_s, 3),
+        "group_sequential_wall_s": round(gs_s, 3),
+        "program_compile_s": round(program_compile_s, 3),
+        "one_group_compile_s": round(one_compile_s, 3),
+        "group_sequential_compile_s": round(gs_compile_s, 3),
+        # cold = what a fresh `tools/sweep.py --live` process pays:
+        # every compile group is one more XLA compile on the critical
+        # path, which is the cost the one-group collapse removes —
+        # the HEADLINE speedup.  The warm walls run identical compute
+        # through identical program shapes, so speedup_warm measures
+        # only dispatch scheduling and hovers near 1.0 on CPU (real
+        # dispatch/readback tax is an accelerator quantity; ROADMAP
+        # accelerator item)
+        "one_group_cold_s": round(one_s + one_compile_s, 3),
+        "group_sequential_cold_s": round(gs_s + gs_compile_s, 3),
+        "speedup": round(
+            (gs_s + gs_compile_s) / (one_s + one_compile_s), 2),
+        "speedup_warm": round(gs_s / one_s, 2),
+    }
+
     return {
         "what": "48-point VOD grid, whole-grid wall-clock "
                 f"(warm, best of {reps})",
-        "grid_points": len(grid), "chunk": chunk, **sizes,
+        "grid_points": len(grid), "chunk": chunk,
+        "chunk_autotuned": batched_info["chunk_autotuned"],
+        "compile_groups": batched_info["compile_groups"],
+        "group_compile_s": [round(vod_compile_s, 3)],
+        **sizes,
         "batched_wall_s": round(batched_s, 3),
         "sequential_wall_s": round(sequential_s, 3),
         "points_per_sec": round(len(grid) / batched_s, 2),
@@ -402,6 +557,7 @@ def sweep_grid_benchmark(reps=3):
         "timeline_record_every": TIMELINE_RECORD_EVERY,
         "timeline_wall_s": round(timeline_s, 3),
         "timeline_overhead": round(timeline_s / batched_s - 1.0, 4),
+        "live_grid": live_grid_metric,
     }
 
 

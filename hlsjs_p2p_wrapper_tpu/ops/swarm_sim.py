@@ -84,6 +84,7 @@ cross-device ops under a sharded mesh.
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -290,6 +291,13 @@ class SwarmScenario(NamedTuple):
     uplink_efficiency: jax.Array    # [] payload fraction of the uplink
     retry_dead_ms: jax.Array        # [] prefetch retry cooldown
     holder_penalty_ms: jax.Array    # [] adaptive's feedback window
+    #: [] live join/playback cushion (seconds behind the edge).  A
+    #: DYNAMIC scenario field since this round: it only feeds jnp
+    #: arithmetic (publish-edge join floor + playback-start gate), so
+    #: a live grid sweeping the cushion collapses into ONE compile
+    #: group instead of one per cushion value (``SwarmConfig.
+    #: live_sync_s`` survives as the copied-in default).
+    live_sync_s: jax.Array
 
 
 def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
@@ -301,7 +309,8 @@ def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
                   announce_delay_s=None, p2p_setup_ms=None,
                   uplink_efficiency=None,
                   retry_dead_ms=None,
-                  holder_penalty_ms=None) -> SwarmScenario:
+                  holder_penalty_ms=None,
+                  live_sync_s=None) -> SwarmScenario:
     """Normalize optional arrays to their defaults (everyone joins at
     t=0, never leaves, serves at the downlink cap, rank 0) and policy
     scalars to the config's values.  Also precomputes the inbound
@@ -365,7 +374,8 @@ def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
                                  config.uplink_efficiency),
         retry_dead_ms=scalar(retry_dead_ms, config.retry_dead_ms),
         holder_penalty_ms=scalar(holder_penalty_ms,
-                                 config.holder_penalty_ms))
+                                 config.holder_penalty_ms),
+        live_sync_s=scalar(live_sync_s, config.live_sync_s))
 
 
 class SwarmState(NamedTuple):
@@ -517,8 +527,10 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     playhead = state.playhead_s
     if config.live:
         # joiners start live_sync_s behind the edge (their join time):
-        # a static per-peer floor the playhead crosses once, at join
-        live_start = jnp.maximum(scenario.join_s - config.live_sync_s, 0.0)
+        # a per-peer floor the playhead crosses once, at join (the
+        # cushion is dynamic scenario data — see SwarmScenario)
+        live_start = jnp.maximum(scenario.join_s - scenario.live_sync_s,
+                                 0.0)
         playhead = jnp.maximum(playhead,
                                jnp.where(t >= scenario.join_s,
                                          live_start, 0.0))
@@ -1172,7 +1184,8 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         # target and edge segments keep a non-urgent margin — without
         # this, viewers pin to the edge with zero slack and the
         # urgency rule sends every fetch to the CDN
-        can_play = can_play & (t >= scenario.join_s + config.live_sync_s)
+        can_play = can_play & (t >= scenario.join_s
+                               + scenario.live_sync_s)
     advance = jnp.minimum(buffer_s, dt_s) * can_play
     playhead = playhead + advance
     rebuffer = state.rebuffer_s + jnp.where(can_play, dt_s - advance, 0.0)
@@ -1300,24 +1313,37 @@ def _run_swarm_batch_impl(config: SwarmConfig, scenarios: SwarmScenario,
                                                          states)
 
 
-#: lazily-jitted batched runner: the donation decision needs the
-#: backend, which must not be initialized at import time
-_RUN_SWARM_BATCH = None
+#: lazily-jitted batched runners, keyed by their donation argnums:
+#: the donation decision needs the backend, which must not be
+#: initialized at import time
+_RUN_SWARM_BATCH = {}
 
 
-def _batched_runner():
-    global _RUN_SWARM_BATCH
-    if _RUN_SWARM_BATCH is None:
-        # donate the [B, P, …] state carry so the batched swarm state
-        # never double-buffers in HBM (at 1M peers × a 16-scenario
-        # chunk the state is multi-GB); CPU has no donation support
-        # and would only warn, so donate on accelerators alone
-        donate = (2,) if jax.default_backend() in ("tpu", "gpu") else ()
-        _RUN_SWARM_BATCH = jax.jit(_run_swarm_batch_impl,
-                                   static_argnames=("config", "n_steps",
-                                                    "record_every"),
-                                   donate_argnums=donate)
-    return _RUN_SWARM_BATCH
+def _donate_argnums(backend: str, donate_scenarios: bool) -> tuple:
+    """Which ``_run_swarm_batch_impl`` positional args to donate.
+
+    The ``[B, P, …]`` state carry (argnum 2) is donated on
+    accelerators so the batched swarm state never double-buffers in
+    HBM (at 1M peers × a 16-scenario chunk the state is multi-GB);
+    ``donate_scenarios`` adds the stacked scenario pytree (argnum 1)
+    — safe only when the caller builds a FRESH stack per dispatch and
+    never reads it back (``run_groups_chunked`` does; the chunks
+    stopped aliasing scenario buffers once every dispatch stacks its
+    own).  CPU has no donation support and would only warn, so both
+    donations are skipped there."""
+    if backend not in ("tpu", "gpu"):
+        return ()
+    return (1, 2) if donate_scenarios else (2,)
+
+
+def _batched_runner(donate_scenarios: bool = False):
+    donate = _donate_argnums(jax.default_backend(), donate_scenarios)
+    if donate not in _RUN_SWARM_BATCH:
+        _RUN_SWARM_BATCH[donate] = jax.jit(
+            _run_swarm_batch_impl,
+            static_argnames=("config", "n_steps", "record_every"),
+            donate_argnums=donate)
+    return _RUN_SWARM_BATCH[donate]
 
 
 def stack_pytrees(items):
@@ -1347,7 +1373,8 @@ def run_swarm_scenario(config: SwarmConfig, scenario: SwarmScenario,
 
 def run_swarm_batch(config: SwarmConfig, scenarios: SwarmScenario,
                     states: SwarmState, n_steps: int,
-                    record_every: int = 0):
+                    record_every: int = 0,
+                    donate_scenarios: bool = False):
     """Scan a whole SCENARIO BATCH as one device program.
 
     ``scenarios``/``states`` are :func:`stack_pytrees`-stacked along a
@@ -1368,10 +1395,13 @@ def run_swarm_batch(config: SwarmConfig, scenarios: SwarmScenario,
     bit-identical per lane to looping :func:`run_swarm_scenario`
     (pinned by tests/test_swarm_batch.py); ``record_every=N`` appends
     the per-lane ``[B, n_steps // N, M]`` metrics timeline (see
-    :func:`_scan_swarm`)."""
+    :func:`_scan_swarm`).  ``donate_scenarios=True`` additionally
+    donates the stacked SCENARIO buffers on accelerators — pass it
+    only when the stack is freshly built for this call and never
+    reused (see :func:`_donate_argnums`)."""
     states = ensure_penalty_width_batch(config, scenarios, states)
-    return _batched_runner()(config, scenarios, states, n_steps,
-                             record_every=record_every)
+    return _batched_runner(donate_scenarios)(
+        config, scenarios, states, n_steps, record_every=record_every)
 
 
 def _span(tracer, name: str, **attrs):
@@ -1383,66 +1413,211 @@ def _span(tracer, name: str, **attrs):
     return tracer.span(name, **attrs)
 
 
-def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
-                      *, watch_s: float, chunk: int,
-                      record_every: int = 0, tracer=None,
-                      pipeline: bool = True):
-    """Chunked, pipelined host front-end for :func:`run_swarm_batch` —
-    the dispatch engine shared by ``tools/sweep.py`` and
-    ``tools/policy_ab.py``.
+#: fraction of the device's free memory the chunk autotuner commits
+#: to one dispatch's ``[B, P, …]`` batch state — the rest is headroom
+#: for XLA fusion-boundary transients the analytic footprint model
+#: does not see
+AUTOTUNE_MEMORY_FRACTION = 0.5
+#: budget when the backend exposes no memory stats (CPU reports
+#: None): a conservative host-RAM allowance
+AUTOTUNE_FALLBACK_BYTES = 4 << 30
+#: autotuner ceiling: lanes beyond this stop amortizing per-dispatch
+#: overhead (one readback per chunk either way) but keep growing the
+#: padded-tail waste and the time-to-first-row, so memory alone does
+#: not get to pick an unbounded batch
+MAX_AUTOTUNE_CHUNK = 64
 
+
+def batch_lane_bytes(config: SwarmConfig, n_steps: int, *,
+                     record_every: int = 0, n_neighbors: int = 0,
+                     scenario: Optional[SwarmScenario] = None) -> int:
+    """Device bytes ONE scenario lane of a batched dispatch pins:
+    the scan carry (counted twice — carry + in-flight update; with
+    the carry donated that is the steady working set, without it the
+    double-buffer), the per-peer scenario arrays, the ``[n_steps]``
+    offload series, and the metrics timeline when recording.  Shapes
+    come from ``jax.eval_shape`` over :func:`init_swarm`, so new
+    state fields are counted automatically instead of drifting from
+    a hand-kept census.
+
+    Pass a built ``scenario`` (one lane) to size the scenario term
+    from its ACTUAL leaves — on the general ``[P, K]`` topology path
+    that counts the neighbor/inverse-edge matrices at their real
+    widths and sizes the adaptive penalty carry; without it, supply
+    ``n_neighbors`` or the general path's per-edge arrays go
+    uncounted."""
+    if scenario is not None and config.neighbor_offsets is None:
+        n_neighbors = int(scenario.neighbors.shape[-1])
+    state = jax.eval_shape(lambda: init_swarm(
+        config, n_neighbors if n_neighbors else None))
+    state_bytes = sum(
+        int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(state))
+    P = config.n_peers
+    if scenario is not None:
+        scenario_bytes = sum(
+            int(np.prod(jnp.shape(leaf)))
+            * np.dtype(jnp.result_type(leaf)).itemsize
+            for leaf in jax.tree_util.tree_leaves(scenario))
+    else:
+        # per-peer scenario reads: cdn/uplink/join/leave/edge_rank f32
+        scenario_bytes = 5 * 4 * P
+        if config.neighbor_offsets is None and n_neighbors:
+            scenario_bytes += 2 * 4 * P * n_neighbors  # nbrs+in_edges
+    out_bytes = 4 * n_steps  # per-lane offload-over-time series
+    if record_every:
+        out_bytes += 4 * (n_steps // record_every) * (
+            6 + config.n_levels)
+    return 2 * state_bytes + scenario_bytes + out_bytes
+
+
+def autotune_chunk(config: SwarmConfig, n_items: int, n_steps: int, *,
+                   record_every: int = 0, n_neighbors: int = 0,
+                   scenario: Optional[SwarmScenario] = None,
+                   device=None) -> int:
+    """Memory-derived scenarios-per-dispatch: how many ``[P, …]``
+    lanes fit in :data:`AUTOTUNE_MEMORY_FRACTION` of the device's
+    free memory (``device.memory_stats()``; the
+    :data:`AUTOTUNE_FALLBACK_BYTES` allowance where the backend
+    reports none, e.g. CPU).  Clamps: floor 1 (a lane that does not
+    fit still has to run), cap at the grid size (padding past the
+    tail buys nothing), ceiling :data:`MAX_AUTOTUNE_CHUNK`.  An
+    explicit ``--chunk`` in the tools bypasses this entirely.
+    ``scenario``/``n_neighbors`` refine the per-lane footprint on
+    the general topology path (see :func:`batch_lane_bytes`)."""
+    if n_items <= 0:
+        return 1
+    if device is None:
+        device = jax.devices()[0]
+    stats = None
+    getter = getattr(device, "memory_stats", None)
+    if getter is not None:
+        try:
+            stats = getter()
+        except (NotImplementedError, RuntimeError):
+            stats = None
+    stats = stats or {}
+    limit = stats.get("bytes_limit") or stats.get(
+        "bytes_reservable_limit")
+    if limit:
+        free = max(int(limit) - int(stats.get("bytes_in_use", 0)), 0)
+    else:
+        free = AUTOTUNE_FALLBACK_BYTES
+    lane = batch_lane_bytes(config, n_steps, record_every=record_every,
+                            n_neighbors=n_neighbors, scenario=scenario)
+    fit = int(free * AUTOTUNE_MEMORY_FRACTION // max(lane, 1))
+    return max(1, min(fit, n_items, MAX_AUTOTUNE_CHUNK))
+
+
+def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
+                       chunk: Optional[int] = None,
+                       record_every: int = 0, tracer=None,
+                       pipeline: bool = True, interleave: bool = True):
+    """Chunked, pipelined dispatch over MULTIPLE compile groups — the
+    engine under :func:`run_batch_chunked` (one group) and
+    ``tools/sweep.py`` (one group per remaining static knob value).
+
+    ``groups`` is a sequence of ``(config, items, build)`` triples;
     ``build(item)`` returns one item's ``(scenario, join_s [P])``
-    pair; items are dispatched in fixed-size chunks (the tail chunk
-    padded by repeating its last scenario, so every dispatch reuses
-    ONE compiled ``[B, P, …]`` program), and each chunk's host
-    readback is pipelined one chunk behind the device: the ONLY
-    host-blocking step reads the chunk dispatched one iteration ago,
-    while the device computes the current one.  Returns per-item
-    ``(offload, rebuffer)`` floats in item order — plus a
-    ``[n_samples, M]`` numpy metrics timeline per item when
-    ``record_every > 0`` (:func:`timeline_columns`); padded lanes are
-    dropped at readback.
+    pair.  Each group's items are dispatched in fixed-size chunks
+    (the tail chunk padded by repeating its last scenario, so every
+    dispatch reuses that group's ONE compiled ``[B, P, …]`` program),
+    with the stacked scenario buffers AND the state carry donated on
+    accelerators (each dispatch stacks fresh buffers, so nothing
+    aliases them).  ``chunk=None`` autotunes the per-group chunk from
+    device memory and the group's per-lane footprint
+    (:func:`autotune_chunk`); an int pins it.
+
+    Dispatch order is ROUND-ROBIN across groups (``interleave=True``):
+    chunk ``i`` of every group is queued before chunk ``i+1`` of any,
+    and readback stays pipelined one chunk behind the device — so
+    with several compile groups one group's host readback overlaps
+    ANOTHER group's device compute instead of each group draining
+    sequentially (the pre-round behavior, kept as
+    ``interleave=False`` for the benchmark reference).  Chunks are
+    independent dispatches, so the schedule is bit-exact against the
+    sequential drain (pinned by tests/test_swarm_batch.py).
+
+    Returns ``(results, stats)``: ``results[g]`` lists group ``g``'s
+    per-item ``(offload, rebuffer)`` floats in item order — triples
+    with a ``[n_samples, M]`` numpy metrics timeline appended when
+    ``record_every > 0`` — and ``stats[g]`` records the group's
+    resolved ``chunk``, chunk count, and ``first_dispatch_s`` (wall
+    seconds of its first dispatch call, which is trace+compile time
+    plus the async enqueue: bench.py's per-group compile signal).
 
     ``tracer`` (e.g. ``engine.telemetry.SpanRecorder``) collects
-    per-chunk ``build`` / ``dispatch`` / ``readback`` span records so
-    the pipelining's readback/compute overlap is measurable rather
-    than asserted (bench.py surfaces it as overlap efficiency);
+    per-chunk ``build`` / ``dispatch`` / ``readback`` spans (tagged
+    with ``group`` and the group-local ``chunk`` index);
     ``pipeline=False`` drains each chunk immediately after its own
-    dispatch — the unpipelined reference the overlap is measured
-    against (that mode blocks on the device results INSIDE the
-    dispatch span, so its readback spans time the host transfer
-    alone, not the async-dispatch compute wait)."""
-    items = list(items)
-    if not items:
-        return []
-    batch = min(chunk, len(items))
-    out = []
-    pending = None  # (chunk idx, n real lanes, offs, rebs, timelines)
+    dispatch — the overlap-measurement baseline (it blocks on the
+    device results INSIDE the dispatch span, so its readback spans
+    time the host transfer alone)."""
+    prepared = []
+    for config, items, build in groups:
+        items = list(items)
+        if chunk is None:
+            # probe-build one lane so the autotuner sizes the REAL
+            # scenario footprint (the general [P, K] path's
+            # neighbor/inverse-edge matrices and the adaptive
+            # penalty width are invisible to the analytic fallback);
+            # costs one duplicate build per group, amortized over
+            # every chunk
+            probe = build(items[0])[0] if items else None
+            batch = autotune_chunk(config, len(items), n_steps,
+                                   record_every=record_every,
+                                   scenario=probe)
+        else:
+            batch = max(min(chunk, len(items)), 1)
+        prepared.append((config, items, build, batch))
+    results = [[None] * len(items) for _, items, _, _ in prepared]
+    stats = [{"items": len(items), "chunk": batch, "chunks": 0,
+              "first_dispatch_s": None}
+             for _, items, _, batch in prepared]
+
+    starts = [list(range(0, len(items), batch))
+              for _, items, _, batch in prepared]
+    schedule = []  # (group idx, group-local chunk idx, item offset)
+    if interleave:
+        ci = 0
+        while any(ci < len(s) for s in starts):
+            schedule.extend((gi, ci, s[ci])
+                            for gi, s in enumerate(starts)
+                            if ci < len(s))
+            ci += 1
+    else:
+        for gi, s in enumerate(starts):
+            schedule.extend((gi, ci, off) for ci, off in enumerate(s))
+
+    pending = None  # (gi, ci, offset, n real lanes, offs, rebs, rows)
 
     def drain(entry):
-        ci, n, offs, rebs, rows = entry
-        with _span(tracer, "readback", chunk=ci):
+        gi, ci, off, n, offs, rebs, rows = entry
+        with _span(tracer, "readback", group=gi, chunk=ci):
             if rows is None:
-                out.extend((float(o), float(r))
-                           for o, r in zip(offs[:n], rebs[:n]))
+                out = [(float(o), float(r))
+                       for o, r in zip(offs[:n], rebs[:n])]
             else:
                 rows = np.asarray(rows)
-                out.extend(
-                    (float(o), float(r), rows[lane])
-                    for lane, (o, r) in enumerate(zip(offs[:n],
-                                                      rebs[:n])))
+                out = [(float(o), float(r), rows[lane])
+                       for lane, (o, r) in enumerate(zip(offs[:n],
+                                                         rebs[:n]))]
+            results[gi][off:off + n] = out
 
-    for ci, i in enumerate(range(0, len(items), batch)):
-        chunk_items = items[i:i + batch]
-        with _span(tracer, "build", chunk=ci):
+    for gi, ci, off in schedule:
+        config, items, build, batch = prepared[gi]
+        chunk_items = items[off:off + batch]
+        with _span(tracer, "build", group=gi, chunk=ci):
             built = [build(item) for item in chunk_items]
             built += [built[-1]] * (batch - len(built))
             scenarios = stack_pytrees([sc for sc, _ in built])
             joins = jnp.stack([j for _, j in built])
             states = stack_pytrees([init_swarm(config)] * batch)
-        with _span(tracer, "dispatch", chunk=ci):
+        t0 = time.perf_counter()
+        with _span(tracer, "dispatch", group=gi, chunk=ci):
             res = run_swarm_batch(config, scenarios, states, n_steps,
-                                  record_every=record_every)
+                                  record_every=record_every,
+                                  donate_scenarios=True)
             finals = res[0]
             rows = res[2] if record_every else None
             offs = offload_ratio_batch(finals)
@@ -1456,7 +1631,10 @@ def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
                 for arr in (offs, rebs) + (() if rows is None
                                            else (rows,)):
                     arr.block_until_ready()
-        entry = (ci, len(chunk_items), offs, rebs, rows)
+        if stats[gi]["first_dispatch_s"] is None:
+            stats[gi]["first_dispatch_s"] = time.perf_counter() - t0
+        stats[gi]["chunks"] += 1
+        entry = (gi, ci, off, len(chunk_items), offs, rebs, rows)
         if not pipeline:
             drain(entry)
             continue
@@ -1465,7 +1643,49 @@ def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
         pending = entry
     if pending is not None:
         drain(pending)
-    return out
+    return results, stats
+
+
+def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
+                      *, watch_s: float, chunk: Optional[int] = None,
+                      record_every: int = 0, tracer=None,
+                      pipeline: bool = True):
+    """Single-group front-end for :func:`run_groups_chunked` — the
+    dispatch engine shared by ``tools/sweep.py`` and
+    ``tools/policy_ab.py``.  Returns per-item ``(offload, rebuffer)``
+    floats in item order (a ``[n_samples, M]`` numpy metrics timeline
+    appended per item when ``record_every > 0``); ``chunk=None``
+    autotunes the scenarios-per-dispatch from device memory
+    (:func:`autotune_chunk`).  See :func:`run_groups_chunked` for the
+    chunking/padding/pipelining contract."""
+    items = list(items)
+    if not items:
+        return []
+    results, _stats = run_groups_chunked(
+        [(config, items, build)], n_steps, watch_s=watch_s,
+        chunk=chunk, record_every=record_every, tracer=tracer,
+        pipeline=pipeline)
+    return results[0]
+
+
+def compile_batch_seconds(config: SwarmConfig,
+                          scenarios: SwarmScenario,
+                          states: SwarmState, n_steps: int,
+                          record_every: int = 0) -> float:
+    """Wall seconds to AOT-compile the batched program for this
+    (config, batch shape).  bench.py uses this for honest
+    per-compile-group cost: timing first dispatches instead would
+    credit whichever mode ran second with the other's warm cache.
+    CAVEAT: a repeated call with an identical (config, shapes)
+    signature can still hit JAX's in-process lowering/compile caches
+    and read ~0 s — probe with a config value the process has not
+    compiled before (bench.py uses an off-grid cushion value)."""
+    start = time.perf_counter()
+    jax.jit(_run_swarm_batch_impl,
+            static_argnames=("config", "n_steps", "record_every")
+            ).lower(config, scenarios, states, n_steps,
+                    record_every=record_every).compile()
+    return time.perf_counter() - start
 
 
 def ensure_penalty_width_batch(config: SwarmConfig,
@@ -1522,7 +1742,8 @@ def run_swarm(config: SwarmConfig, bitrates: jax.Array,
               live_spread_s=None, request_timeout_ms=None,
               announce_delay_s=None, p2p_setup_ms=None,
               uplink_efficiency=None, retry_dead_ms=None,
-              holder_penalty_ms=None, record_every: int = 0,
+              holder_penalty_ms=None, live_sync_s=None,
+              record_every: int = 0,
               ) -> Tuple[SwarmState, jax.Array]:
     """Scan ``n_steps`` ticks; returns (final state, offload-over-time
     ``[n_steps]``) — plus the ``[n_steps // record_every, M]`` metrics
@@ -1542,7 +1763,7 @@ def run_swarm(config: SwarmConfig, bitrates: jax.Array,
         request_timeout_ms=request_timeout_ms,
         announce_delay_s=announce_delay_s, p2p_setup_ms=p2p_setup_ms,
         uplink_efficiency=uplink_efficiency, retry_dead_ms=retry_dead_ms,
-        holder_penalty_ms=holder_penalty_ms)
+        holder_penalty_ms=holder_penalty_ms, live_sync_s=live_sync_s)
     state = ensure_penalty_width(config, scenario, state)
     return _run_swarm(config, scenario, state, n_steps,
                       record_every=record_every)

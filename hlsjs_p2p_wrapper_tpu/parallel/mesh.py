@@ -191,7 +191,7 @@ def scenario_shardings(mesh: Mesh) -> SwarmScenario:
         live_spread_s=rep, request_timeout_ms=rep,
         announce_delay_s=rep, p2p_setup_ms=rep,
         uplink_efficiency=rep, retry_dead_ms=rep,
-        holder_penalty_ms=rep)
+        holder_penalty_ms=rep, live_sync_s=rep)
 
 
 def shard_swarm(mesh: Mesh, scenario: SwarmScenario, state: SwarmState):

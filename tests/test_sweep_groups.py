@@ -1,0 +1,359 @@
+"""The one-group live grid and its dispatch machinery (this round's
+perf work): dynamic ``live_sync_s`` must be a pure performance
+transform (bit-exact against the old static-config program),
+round-robin cross-group dispatch must be pure reordering, the chunk
+autotuner must respect its clamps, and the compile-group map the
+sweep builds must actually collapse to one group per shipped grid."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (
+    MAX_AUTOTUNE_CHUNK, SwarmConfig, autotune_chunk, batch_lane_bytes,
+    init_swarm, make_scenario, ring_offsets, run_batch_chunked,
+    run_groups_chunked, run_swarm_batch, run_swarm_scenario,
+    stack_pytrees, _donate_argnums)
+from hlsjs_p2p_wrapper_tpu.parallel import (make_scenario_mesh,
+                                            sharded_run_batch)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+import sweep as sweep_tool  # noqa: E402
+
+BITRATES = jnp.array([300_000.0, 800_000.0])
+PEERS = 32
+WATCH_S = 20.0
+
+
+def live_fixture(live_sync_default=12.0):
+    config = SwarmConfig(n_peers=PEERS, n_segments=16, n_levels=2,
+                         live=True, live_sync_s=live_sync_default,
+                         neighbor_offsets=ring_offsets(4))
+    cdn = jnp.full((PEERS,), 8_000_000.0)
+    join = jnp.linspace(0.0, 10.0, PEERS)
+    n_steps = int(WATCH_S * 1000.0 / config.dt_ms)
+    return config, cdn, join, n_steps
+
+
+# -- dynamic live_sync_s is a pure performance transform ---------------
+
+def test_dynamic_live_sync_bit_exact_vs_static_config():
+    """The promotion contract: a scenario carrying ``live_sync_s=X``
+    under a default config reports a final state bit-identical to the
+    old formulation — config with ``live_sync_s=X`` baked in and the
+    scenario copying the config default — point by point."""
+    config, cdn, join, n_steps = live_fixture()
+    for sync in (4.0, 9.0, 16.0):
+        static_config = config._replace(live_sync_s=sync)
+        static_scenario = make_scenario(static_config, BITRATES, None,
+                                        cdn, join)
+        static_final, static_series = run_swarm_scenario(
+            static_config, static_scenario, init_swarm(static_config),
+            n_steps)
+        dyn_scenario = make_scenario(config, BITRATES, None, cdn, join,
+                                     live_sync_s=sync)
+        dyn_final, dyn_series = run_swarm_scenario(
+            config, dyn_scenario, init_swarm(config), n_steps)
+        for a, b in zip(jax.tree_util.tree_leaves(dyn_final),
+                        jax.tree_util.tree_leaves(static_final),
+                        strict=True):
+            assert jnp.array_equal(a, b), \
+                f"dynamic live_sync_s={sync} diverged from static"
+        assert jnp.array_equal(dyn_series, static_series)
+
+
+def test_dynamic_live_sync_batch_bit_exact_per_lane():
+    """A batch whose lanes differ ONLY in ``live_sync_s`` (the
+    one-group live grid's shape) matches per-lane static-config runs
+    bit-exactly — the old N-compile formulation is reproduced by one
+    program."""
+    config, cdn, join, n_steps = live_fixture()
+    syncs = (4.0, 8.0, 12.0)
+    scenarios = [make_scenario(config, BITRATES, None, cdn, join,
+                               live_sync_s=sync) for sync in syncs]
+    finals, _ = run_swarm_batch(
+        config, stack_pytrees(scenarios),
+        stack_pytrees([init_swarm(config)] * len(syncs)), n_steps)
+    for lane, sync in enumerate(syncs):
+        static_config = config._replace(live_sync_s=sync)
+        single, _ = run_swarm_scenario(
+            static_config,
+            make_scenario(static_config, BITRATES, None, cdn, join),
+            init_swarm(static_config), n_steps)
+        for batched_leaf, single_leaf in zip(
+                jax.tree_util.tree_leaves(finals),
+                jax.tree_util.tree_leaves(single), strict=True):
+            assert jnp.array_equal(batched_leaf[lane], single_leaf), \
+                f"lane {lane} (sync {sync}) diverged"
+
+
+def test_live_sync_actually_changes_the_simulation():
+    """Guard against the promotion silently disconnecting the knob:
+    two cushions must produce different playback trajectories (the
+    playback-start gate reads the scenario value)."""
+    config, cdn, join, n_steps = live_fixture()
+    finals = []
+    for sync in (2.0, 14.0):
+        scenario = make_scenario(config, BITRATES, None, cdn, join,
+                                 live_sync_s=sync)
+        final, _ = run_swarm_scenario(config, scenario,
+                                      init_swarm(config), n_steps)
+        finals.append(final)
+    assert not jnp.array_equal(finals[0].playhead_s,
+                               finals[1].playhead_s), \
+        "live_sync_s no longer affects the simulation"
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_dynamic_live_sync_sharded_matches_unsharded():
+    """The merged live batch over the (scenarios,) mesh: per-lane
+    cushions must not change results when the batch shards across
+    devices (the zero-collective property __graft_entry__ asserts on
+    the HLO, checked here on the numbers)."""
+    config, cdn, join, n_steps = live_fixture()
+    scenarios = [make_scenario(config, BITRATES, None, cdn, join,
+                               live_sync_s=2.0 + lane)
+                 for lane in range(8)]
+    stacked = stack_pytrees(scenarios)
+    unsharded, _ = run_swarm_batch(
+        config, stacked, stack_pytrees([init_swarm(config)] * 8),
+        n_steps)
+    mesh = make_scenario_mesh(jax.devices()[:8])
+    sharded, _ = sharded_run_batch(
+        mesh, config, stacked,
+        stack_pytrees([init_swarm(config)] * 8), n_steps)
+    for a, b in zip(jax.tree_util.tree_leaves(sharded),
+                    jax.tree_util.tree_leaves(unsharded), strict=True):
+        assert jnp.array_equal(a, b), \
+            "sharded dynamic-live_sync batch diverged"
+
+
+# -- round-robin cross-group dispatch ----------------------------------
+
+def groups_fixture():
+    cdn = jnp.full((PEERS,), 8_000_000.0)
+    join = jnp.linspace(0.0, 10.0, PEERS)
+
+    def make_group(degree, n_items):
+        config = SwarmConfig(n_peers=PEERS, n_segments=16, n_levels=2,
+                             neighbor_offsets=ring_offsets(degree))
+
+        def build(i, cfg=config):
+            return make_scenario(cfg, BITRATES, None, cdn, join,
+                                 urgent_margin_s=0.5 + i), join
+        return config, list(range(n_items)), build
+    return [make_group(4, 5), make_group(8, 3)]
+
+
+def test_round_robin_bit_exact_vs_group_sequential():
+    """The cross-group schedule is pure reordering: round-robin,
+    sequential drain, and per-group ``run_batch_chunked`` all report
+    identical metrics (chunks are independent dispatches)."""
+    groups = groups_fixture()
+    rr, rr_stats = run_groups_chunked(groups, 60, watch_s=15.0,
+                                      chunk=2)
+    seq, _ = run_groups_chunked(groups, 60, watch_s=15.0, chunk=2,
+                                interleave=False)
+    direct = [run_batch_chunked(config, items, build, 60,
+                                watch_s=15.0, chunk=2)
+              for config, items, build in groups]
+    assert rr == seq == direct
+    # 5 items / chunk 2 -> 3 chunks; 3 items -> 2 chunks
+    assert [s["chunks"] for s in rr_stats] == [3, 2]
+    assert all(s["first_dispatch_s"] is not None for s in rr_stats)
+
+
+def test_round_robin_unpipelined_matches_pipelined():
+    groups = groups_fixture()
+    piped, _ = run_groups_chunked(groups, 60, watch_s=15.0, chunk=2)
+    drained, _ = run_groups_chunked(groups, 60, watch_s=15.0, chunk=2,
+                                    pipeline=False)
+    assert piped == drained
+
+
+# -- chunk autotuner ---------------------------------------------------
+
+class _FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def autotune_config():
+    return SwarmConfig(n_peers=64, n_segments=32, n_levels=2,
+                       neighbor_offsets=ring_offsets(4))
+
+
+def test_autotune_chunk_caps_at_grid_size():
+    device = _FakeDevice({"bytes_limit": 1 << 40})
+    assert autotune_chunk(autotune_config(), 4, 100,
+                          device=device) == 4
+
+
+def test_autotune_chunk_respects_ceiling():
+    device = _FakeDevice({"bytes_limit": 1 << 40})
+    assert autotune_chunk(autotune_config(), 10 ** 6, 100,
+                          device=device) == MAX_AUTOTUNE_CHUNK
+
+
+def test_autotune_chunk_floors_at_one():
+    device = _FakeDevice({"bytes_limit": 1})
+    assert autotune_chunk(autotune_config(), 100, 100,
+                          device=device) == 1
+    # fully-committed memory also floors instead of going to zero
+    device = _FakeDevice({"bytes_limit": 1 << 30,
+                          "bytes_in_use": 1 << 30})
+    assert autotune_chunk(autotune_config(), 100, 100,
+                          device=device) == 1
+
+
+def test_autotune_chunk_without_memory_stats_uses_fallback():
+    """CPU reports no memory stats (``memory_stats() -> None``): the
+    autotuner falls back to a fixed allowance instead of crashing —
+    and the REAL default device on this test host is exactly that
+    case."""
+    device = _FakeDevice(None)
+    assert 1 <= autotune_chunk(autotune_config(), 8, 100,
+                               device=device) <= 8
+    assert 1 <= autotune_chunk(autotune_config(), 8, 100) <= 8
+
+
+def test_autotune_chunk_shrinks_with_lane_footprint():
+    """A lane with the timeline compiled in (record_every) weighs
+    more, so a tight budget fits fewer of them."""
+    config = autotune_config()
+    lane_plain = batch_lane_bytes(config, 10_000)
+    lane_tl = batch_lane_bytes(config, 10_000, record_every=2)
+    assert lane_tl > lane_plain
+    budget = _FakeDevice({"bytes_limit": 8 * lane_plain})
+    assert autotune_chunk(config, 1000, 10_000, device=budget) >= \
+        autotune_chunk(config, 1000, 10_000, record_every=2,
+                       device=budget)
+
+
+def test_lane_bytes_scenario_probe_counts_general_topology():
+    """On the general [P, K] path the neighbor/inverse-edge matrices
+    and the adaptive penalty carry are invisible to the analytic
+    fallback — a built-scenario probe must weigh more (what
+    run_groups_chunked's autotune probe exists for)."""
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import random_neighbors
+    config = SwarmConfig(n_peers=128, n_segments=32, n_levels=1,
+                         holder_selection="adaptive",
+                         max_concurrency=2)
+    scenario = make_scenario(config, jnp.array([800_000.0]),
+                             random_neighbors(128, 8, 0),
+                             jnp.full((128,), 8e6))
+    assert batch_lane_bytes(config, 500, scenario=scenario) > \
+        batch_lane_bytes(config, 500)
+
+
+def test_explicit_chunk_overrides_autotuner():
+    grid = sweep_tool.vod_grid()[:5]
+    rows, info = sweep_tool.run_grid_batched(
+        grid, peers=16, segments=8, watch_s=5.0, live=False, seed=0,
+        chunk=3)
+    assert info["chunk"] == 3
+    assert info["chunk_autotuned"] is False
+    assert all(group["chunk"] == 3 for group in info["groups"])
+
+
+# -- donation policy ---------------------------------------------------
+
+def test_donation_skipped_on_cpu():
+    assert _donate_argnums("cpu", False) == ()
+    assert _donate_argnums("cpu", True) == ()
+
+
+def test_donation_adds_scenarios_on_accelerators():
+    assert _donate_argnums("tpu", False) == (2,)
+    assert _donate_argnums("tpu", True) == (1, 2)
+    assert _donate_argnums("gpu", True) == (1, 2)
+
+
+# -- the sweep's compile-group map -------------------------------------
+
+def test_shipped_grids_are_one_compile_group():
+    """The acceptance bar: BOTH shipped grids collapse to a single
+    compile group in the map ``tools/sweep.py`` builds (live_sync_s
+    is scenario data; degree is the only static knob and both grids
+    hold it constant)."""
+    assert len(sweep_tool.group_grid(sweep_tool.vod_grid())) == 1
+    assert len(sweep_tool.group_grid(sweep_tool.live_grid())) == 1
+
+
+def test_static_live_sync_reference_grouping_splits_the_live_grid():
+    groups = sweep_tool.group_grid(sweep_tool.live_grid(),
+                                   static_live_sync=True)
+    assert len(groups) == 2  # one per cushion value, the old shape
+
+
+def test_live_grid_batched_equals_sequential_rows():
+    """The merged one-group live grid end to end: batched rows equal
+    the per-point ``--sequential`` reference bit-exactly on a slice
+    spanning BOTH cushion values (the satellite contract: the
+    sequential path takes per-scenario live_sync_s)."""
+    live = sweep_tool.live_grid()
+    grid = live[:3] + live[-3:]
+    assert {k["live_sync_s"] for k in grid} == {6.0, 12.0}
+    common = dict(peers=32, segments=16, watch_s=20.0, live=True,
+                  seed=0)
+    batched, info = sweep_tool.run_grid_batched(grid, chunk=4,
+                                                **common)
+    sequential, _ = sweep_tool.run_grid_sequential(grid, **common)
+    assert batched == sequential
+    assert info["compile_groups"] == 1
+
+
+def test_live_grid_group_sequential_reference_matches_one_group():
+    """The benchmark baseline (legacy group-per-cushion grouping with
+    sequential drain) must report the same rows as the merged grid —
+    it differs only in compile-group structure."""
+    live = sweep_tool.live_grid()
+    grid = live[:3] + live[-3:]
+    common = dict(peers=32, segments=16, watch_s=20.0, live=True,
+                  seed=0)
+    merged, _ = sweep_tool.run_grid_batched(grid, chunk=4, **common)
+    legacy, info = sweep_tool.run_grid_batched(
+        grid, chunk=4, static_live_sync=True, interleave=False,
+        **common)
+    assert merged == legacy
+    assert info["compile_groups"] == 2
+
+
+# -- the STATIC_KNOBS lint rule ----------------------------------------
+
+def test_static_knobs_lint_rule(tmp_path):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import lint as lint_tool
+
+    repo_sweep = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "sweep.py")
+    assert lint_tool.check_static_knobs(repo_sweep) == [], \
+        "the shipped STATIC_KNOBS tuple must be fully justified"
+
+    unjustified = tmp_path / "sweep.py"
+    unjustified.write_text(
+        'STATIC_KNOBS = (\n    "degree",\n    "sneaky",\n)\n')
+    findings = lint_tool.check_static_knobs(str(unjustified))
+    assert len(findings) == 2
+    assert all("# static:" in f for f in findings)
+
+    missing = tmp_path / "sweep_missing.py"
+    missing.write_text("x = 1\n")
+    assert any("missing" in f
+               for f in lint_tool.check_static_knobs(str(missing)))
+
+    justified = tmp_path / "sweep_ok.py"
+    justified.write_text(
+        'STATIC_KNOBS = (\n    "degree",  # static: roll constants\n)\n')
+    assert lint_tool.check_static_knobs(str(justified)) == []

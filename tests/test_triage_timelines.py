@@ -1,0 +1,145 @@
+"""tools/triage_timelines.py: the timeline-driven scenario debugger
+must flag ladder oscillation and offload-ramp stalls, pass healthy
+trajectories, and gate via --strict — on synthetic records whose
+pathologies are known by construction, plus one end-to-end pass over
+a real (tiny) sweep dump."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+import triage_timelines as triage  # noqa: E402
+
+COLUMNS = ["t_s", "offload", "rebuffer", "cdn_rate_bps",
+           "p2p_rate_bps", "stalled_peers", "level_0_peers",
+           "level_1_peers"]
+
+
+def sample(t, offload, l0, l1):
+    return [t, offload, 0.0, 1e6, 1e6, 0.0, l0, l1]
+
+
+def oscillating_record():
+    """Dominant level flips every sample while offload ramps fine."""
+    samples = [sample(t, min(0.05 * t, 0.6),
+                      10.0 if t % 2 else 2.0,
+                      2.0 if t % 2 else 10.0)
+               for t in range(12)]
+    return {"urgent_margin_s": 0.5, "columns": COLUMNS,
+            "samples": samples}
+
+
+def stalled_record():
+    """Offload flat-lines at 0.05 with a stable ladder."""
+    samples = [sample(t, 0.05, 10.0, 0.0) for t in range(12)]
+    return {"urgent_margin_s": 4.0, "columns": COLUMNS,
+            "samples": samples}
+
+
+def healthy_record():
+    """Monotone offload ramp to 0.6, dominant level settles once."""
+    samples = [sample(t, min(0.06 * t, 0.6),
+                      10.0 if t < 2 else 2.0,
+                      2.0 if t < 2 else 10.0)
+               for t in range(12)]
+    return {"urgent_margin_s": 8.0, "columns": COLUMNS,
+            "samples": samples}
+
+
+def test_detects_ladder_oscillation_only():
+    triaged = triage.triage_records([oscillating_record()])
+    assert len(triaged) == 1
+    reasons = [f["reason"] for f in triaged[0]["findings"]]
+    assert reasons == ["ladder_oscillation"]
+    assert triaged[0]["findings"][0]["flips"] >= 4
+
+
+def test_detects_offload_stall_only():
+    triaged = triage.triage_records([stalled_record()])
+    assert len(triaged) == 1
+    reasons = [f["reason"] for f in triaged[0]["findings"]]
+    assert reasons == ["offload_stall"]
+
+
+def test_healthy_record_passes():
+    assert triage.triage_records([healthy_record()]) == []
+
+
+def test_single_ramp_step_is_not_oscillation():
+    """One dominant-level change (the ABR settling) must not count:
+    the flip-fraction floor exists exactly for this."""
+    rec = healthy_record()
+    assert triage.detect_oscillation(rec["columns"],
+                                     rec["samples"]) is None
+
+
+def test_pre_join_empty_samples_are_skipped():
+    rec = oscillating_record()
+    empty = [sample(0, 0.0, 0.0, 0.0)] * 3  # nobody present yet
+    rec["samples"] = empty + rec["samples"]
+    triaged = triage.triage_records([rec])
+    assert [f["reason"] for f in triaged[0]["findings"]] == \
+        ["ladder_oscillation"]
+
+
+def test_knob_label_skips_structure_keys():
+    label = triage.knob_label({"urgent_margin_s": 0.5, "columns": [],
+                               "samples": [], "offload": 0.5,
+                               "rebuffer": 0.0, "record_every": 20})
+    assert label == "urgent_margin_s=0.5"
+
+
+def test_main_strict_gates_on_findings(tmp_path, capsys):
+    path = tmp_path / "timelines.jsonl"
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in (oscillating_record(), healthy_record(),
+                    stalled_record()):
+            f.write(json.dumps(rec) + "\n")
+    assert triage.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "ladder_oscillation" in out and "offload_stall" in out
+    assert triage.main([str(path), "--strict"]) == 1
+    # a clean file is clean even under --strict
+    clean = tmp_path / "clean.jsonl"
+    clean.write_text(json.dumps(healthy_record()) + "\n")
+    assert triage.main([str(clean), "--strict"]) == 0
+
+
+def test_json_output_round_trips(tmp_path, capsys):
+    path = tmp_path / "timelines.jsonl"
+    path.write_text(json.dumps(stalled_record()) + "\n")
+    triage.main([str(path), "--json"])
+    out = capsys.readouterr().out.strip()
+    entry = json.loads(out)
+    assert entry["point"] == 0
+    assert entry["findings"][0]["reason"] == "offload_stall"
+
+
+def test_end_to_end_on_a_real_sweep_dump(tmp_path):
+    """The real pipeline at test scale: sweep a live slice with
+    --timelines-out, then triage the file (schema compatibility —
+    the detectors read the columns the sweep actually writes)."""
+    import sweep as sweep_tool
+
+    live = sweep_tool.live_grid()
+    grid = [live[0], live[-1]]
+    rows, _ = sweep_tool.run_grid_batched(
+        grid, peers=16, segments=8, watch_s=10.0, live=True, seed=0,
+        chunk=2, record_every=5)
+    path = tmp_path / "sweep_tl.jsonl"
+    columns = sweep_tool.timeline_columns(
+        sweep_tool.build_config(16, 8, True, grid[0]["degree"]))
+    with open(path, "w", encoding="utf-8") as f:
+        for row in rows:
+            tl = row.pop("_timeline")
+            f.write(json.dumps({
+                **row, "columns": list(columns),
+                "samples": [[float(v) for v in s] for s in tl],
+            }) + "\n")
+    # just must parse and triage deterministically — whether these
+    # tiny trajectories are flagged is threshold behavior, not schema
+    triage.triage_records(
+        [json.loads(line) for line in open(path, encoding="utf-8")])

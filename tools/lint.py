@@ -7,6 +7,13 @@ library code logs through ``logging`` or counts into the telemetry
 registry (engine/telemetry.py); tools/tests/examples, which OWN their
 stdout, are exempt.
 
+One repo-specific rule: every entry of ``STATIC_KNOBS`` in
+``tools/sweep.py`` (the sweep's compile-group key) must carry an
+inline ``# static:`` justification comment — each static knob costs
+one XLA compile group per distinct grid value, so a knob that could
+be dynamic ``SwarmScenario`` data must not sneak back in silently
+(the live-sync cushion was exactly such a knob for two rounds).
+
 Run: ``python tools/lint.py`` (exit code 1 on findings).
 """
 
@@ -111,6 +118,44 @@ def check_file(path):
     return findings
 
 
+def check_static_knobs(sweep_path):
+    """Compile-group discipline for ``tools/sweep.py``: the
+    ``STATIC_KNOBS`` tuple must exist, and every element's source
+    line must carry a ``# static:`` comment justifying why the knob
+    cannot be dynamic scenario data (each entry costs one compile
+    group per distinct grid value)."""
+    findings = []
+    with open(sweep_path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=sweep_path)
+    except SyntaxError:
+        return []  # check_file already reports the syntax error
+    lines = source.splitlines()
+    assigns = [node for node in tree.body
+               if isinstance(node, ast.Assign)
+               and any(isinstance(t, ast.Name) and t.id == "STATIC_KNOBS"
+                       for t in node.targets)]
+    if not assigns:
+        return [f"{sweep_path}:1: STATIC_KNOBS tuple is missing — the "
+                f"sweep's compile-group key must be declared (and "
+                f"justified) in one place"]
+    for node in assigns:
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            findings.append(f"{sweep_path}:{node.lineno}: STATIC_KNOBS "
+                            f"must be a literal tuple of knob names")
+            continue
+        for elt in node.value.elts:
+            if "# static:" not in lines[elt.lineno - 1]:
+                name = getattr(elt, "value", "?")
+                findings.append(
+                    f"{sweep_path}:{elt.lineno}: STATIC_KNOBS entry "
+                    f"{name!r} lacks an inline '# static:' "
+                    f"justification — could it be dynamic "
+                    f"SwarmScenario data instead?")
+    return findings
+
+
 def main():
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     all_findings = []
@@ -118,6 +163,8 @@ def main():
     for path in iter_py_files(repo_root):
         count += 1
         all_findings.extend(check_file(path))
+    all_findings.extend(check_static_knobs(
+        os.path.join(repo_root, "tools", "sweep.py")))
     for finding in sorted(all_findings):
         print(finding)
     print(f"lint: {count} files, {len(all_findings)} findings",

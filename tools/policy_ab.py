@@ -33,8 +33,9 @@ path.  Six compiles (2 topologies × 3 static policies); since this
 round each compile's 20 regime cells (pattern × wave × uplink — all
 dynamic scenario data) run as chunked ``run_swarm_batch`` dispatches
 over a stacked scenario axis instead of 20 sequential
-dispatch+readback round-trips (``--chunk`` bounds the ``[B, P, …]``
-batch state; readback is pipelined one chunk behind the device).
+dispatch+readback round-trips (the chunk size is autotuned from
+device memory and the per-lane state footprint — ``--chunk`` pins it
+— and readback is pipelined one chunk behind the device).
 """
 
 import argparse
@@ -50,7 +51,7 @@ import jax.numpy as jnp  # noqa: E402
 
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
     SwarmConfig, make_scenario, random_neighbors, ring_offsets,
-    run_batch_chunked, stable_ranks, staggered_joins,
+    run_groups_chunked, stable_ranks, staggered_joins,
     timeline_columns)
 
 BITRATE = 800_000.0
@@ -120,22 +121,29 @@ def run_cells_batched(config, neighbors, audience, cells, *, watch_s,
                       chunk, record_every=0):
     """All regime cells of one (topology, policy) compile group
     through the shared chunked/pipelined dispatch engine
-    (``run_batch_chunked``); returns per-cell ``(offload, rebuffer)``
-    floats in cell order — ``(offload, rebuffer, timeline)`` triples
-    when ``record_every > 0`` (the on-device metrics timeline,
-    ops/swarm_sim.py ``timeline_columns``)."""
+    (``run_groups_chunked``); returns ``(metrics, resolved_chunk)``
+    — per-cell ``(offload, rebuffer)`` floats in cell order
+    (``(offload, rebuffer, timeline)`` triples when
+    ``record_every > 0``, the on-device metrics timeline,
+    ops/swarm_sim.py ``timeline_columns``) plus the chunk the engine
+    actually used (autotuned when ``chunk`` is None), so the
+    artifact records the real scenarios-per-dispatch."""
     n_steps = int(watch_s * 1000.0 / config.dt_ms)
-    metrics = run_batch_chunked(
-        config, cells,
-        lambda cell: build_cell_scenario(
-            config, neighbors, audience, uplink_bps=cell[2] * 1e6,
-            pattern=cell[0], wave=cell[1], watch_s=watch_s),
+    results, stats = run_groups_chunked(
+        [(config, cells,
+          lambda cell: build_cell_scenario(
+              config, neighbors, audience, uplink_bps=cell[2] * 1e6,
+              pattern=cell[0], wave=cell[1], watch_s=watch_s))],
         n_steps, watch_s=watch_s, chunk=chunk,
         record_every=record_every)
+    metrics = results[0]
     if record_every:
-        return [(round(off, 4), round(reb, 5), tl)
-                for off, reb, tl in metrics]
-    return [(round(off, 4), round(reb, 5)) for off, reb in metrics]
+        rounded = [(round(off, 4), round(reb, 5), tl)
+                   for off, reb, tl in metrics]
+    else:
+        rounded = [(round(off, 4), round(reb, 5))
+                   for off, reb in metrics]
+    return rounded, stats[0]["chunk"]
 
 
 def main():
@@ -148,9 +156,11 @@ def main():
     ap.add_argument("--segments", type=int, default=128)
     ap.add_argument("--watch-s", type=float, default=240.0)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--chunk", type=int, default=10,
+    ap.add_argument("--chunk", type=int, default=None,
                     help="regime cells per batched dispatch (bounds "
-                         "the [B, P, ...] batch state on device)")
+                         "the [B, P, ...] batch state on device; "
+                         "default: autotuned from device memory, "
+                         "ops/swarm_sim.py autotune_chunk)")
     ap.add_argument("--out", metavar="FILE",
                     help="write the A/B table as JSON")
     ap.add_argument("--record-every", type=int, default=0, metavar="N",
@@ -173,6 +183,7 @@ def main():
 
     t0 = time.perf_counter()
     tables = {}
+    resolved_chunks = {}
     timeline_records = []
     worst = {"cell": None, "margin": 1.0}
     best = {"cell": None, "margin": -1.0}
@@ -198,10 +209,11 @@ def main():
                                      n_segments=args.segments,
                                      n_levels=1, max_concurrency=3,
                                      holder_selection=policy)
-            per_policy[policy] = run_cells_batched(
+            per_policy[policy], resolved = run_cells_batched(
                 config, neighbors, audience, cells,
                 watch_s=args.watch_s, chunk=args.chunk,
                 record_every=args.record_every)
+            resolved_chunks[f"{topology}/{policy}"] = resolved
             if args.record_every:
                 # strip the timeline blocks back off the metric pairs
                 # (the A/B table stays pairs-only) and keep them as
@@ -300,10 +312,12 @@ def main():
           f"{best['cell']} (default demotion holds while no cell "
           f"shows >= +0.03 in BOTH sim and harness); max rebuffer "
           f"spread across policies: {rebuffer_spread_max}")
+    chunk_label = ("autotuned" if args.chunk is None
+                   else str(args.chunk))
     print(f"# 2 topologies x {len(PATTERNS)}x{len(WAVES)} regimes x "
           f"{len(UPLINK_GRID_MBPS)} uplink points x "
           f"{len(POLICIES)} policies in {elapsed:.1f}s "
-          f"(batched engine, chunk {args.chunk})", file=sys.stderr)
+          f"(batched engine, chunk {chunk_label})", file=sys.stderr)
     if args.out:
         device = jax.devices()[0]
         with open(args.out, "w") as f:
@@ -313,7 +327,10 @@ def main():
                     "watch_s": args.watch_s, "bitrate": BITRATE,
                     "degree": 8, "seed": args.seed,
                     "elapsed_s": round(elapsed, 1),
-                    "engine": "batched", "chunk": args.chunk,
+                    "engine": "batched",
+                    "chunk": args.chunk,
+                    "chunk_autotuned": args.chunk is None,
+                    "resolved_chunks": resolved_chunks,
                     "platform": device.platform,
                     "device_kind": getattr(device, "device_kind", "?"),
                     "worst_default_margin": worst["margin"],

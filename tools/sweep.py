@@ -10,21 +10,28 @@ device dispatch, not a Python loop over grid points.
 
 Execution model (the batched engine, ``run_swarm_batch``):
 
-1. Grid points are grouped by their STATIC knobs — topology degree
-   and the live-sync cushion, the only fields that live in
-   ``SwarmConfig`` — into compile groups; everything else (urgency
+1. Grid points are grouped by their STATIC knobs — TOPOLOGY DEGREE
+   only, since this round (``STATIC_KNOBS``): the live-sync cushion
+   moved into dynamic ``SwarmScenario`` data alongside urgency
    margin, budget cap, supply rates, stagger window, announce lag,
-   join wave) is dynamic ``SwarmScenario`` data, so each group is
-   one XLA compile regardless of its point count.
+   and join wave — so BOTH shipped grids (VOD and live) are ONE
+   compile group, one XLA compile regardless of point count.
 2. Each group's points are stacked along a SCENARIO AXIS
-   (``stack_pytrees``) and dispatched in fixed-size chunks (padded,
-   so every chunk reuses one compiled ``[B, P, …]`` program).  The
-   scanned step is ``vmap``-ed over the batch and the state carry is
-   donated — one program steps the whole chunk, no per-point Python
-   round-trips, no double-buffered grid state in HBM.
+   (``stack_pytrees``) and dispatched in chunks (padded, so every
+   chunk reuses one compiled ``[B, P, …]`` program).  The chunk size
+   is AUTOTUNED from device memory and the per-lane state footprint
+   (``autotune_chunk``; ``--chunk`` pins it).  The scanned step is
+   ``vmap``-ed over the batch and the state carry AND the stacked
+   scenario buffers are donated — one program steps the whole chunk,
+   no per-point Python round-trips, no double-buffered grid state in
+   HBM.
 3. Dispatch is PIPELINED: chunk N's host readback (two ``[B]`` metric
    vectors) happens while chunk N+1 is already queued on the device,
    so scenario construction and readback hide under device compute.
+   Were a future grid to span several compile groups (e.g. a degree
+   sweep), chunks ROUND-ROBIN across groups
+   (``run_groups_chunked``), so one group's readback overlaps
+   another group's compute instead of groups draining sequentially.
    ``bench.py`` tracks the resulting grid points/sec and whole-grid
    wall-clock against the old sequential per-point dispatch
    (``--sequential`` keeps that path alive as the parity reference).
@@ -83,7 +90,7 @@ import jax.numpy as jnp  # noqa: E402
 
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
     UNREACHABLE_BITRATE, SwarmConfig, init_swarm, make_scenario,
-    offload_ratio, rebuffer_ratio, ring_offsets, run_batch_chunked,
+    offload_ratio, rebuffer_ratio, ring_offsets, run_groups_chunked,
     run_swarm_scenario, stable_ranks, staggered_joins,
     timeline_columns)
 
@@ -96,10 +103,18 @@ LADDERS = {
 #: this many levels with UNREACHABLE_BITRATE (never chosen)
 N_LEVELS = max(len(v) for v in LADDERS.values())
 
-#: scenarios per batched dispatch: bounds the [B, P, …] grid state in
-#: device memory and is the pipelining quantum (readback of one chunk
-#: overlaps compute of the next)
-DEFAULT_CHUNK = 16
+#: compile-group knobs: grid fields that MUST stay static (baked into
+#: ``SwarmConfig``) because the compiled program's structure depends
+#: on them.  Everything else is dynamic ``SwarmScenario`` data — ONE
+#: compile group sweeps it recompile-free — so every entry here costs
+#: a compile group per distinct value and needs an inline
+#: ``# static:`` justification saying why it cannot be scenario data
+#: (tools/lint.py enforces the comment; the live-sync cushion was
+#: evicted from this tuple when it turned out to be pure jnp
+#: arithmetic).
+STATIC_KNOBS = (
+    "degree",  # static: circulant neighbor_offsets are compile-time roll constants
+)
 
 
 def padded_ladder(name):
@@ -147,9 +162,10 @@ def live_grid():
     # at/below the ladder top, a constrained CDN, HAVE-propagation
     # lag up to a segment duration, stagger windows up to two
     # segment durations, and a flash-crowd join wave — crossed
-    # with the ample points for continuity.  One compile group per
-    # static (degree, live_sync) combination — two here
-    # (everything else is scenario data).
+    # with the ample points for continuity.  ONE compile group for
+    # all 144 points: degree is the only static knob, the live
+    # cushion is scenario data since this round (everything else
+    # already was).
     spreads = (0.0, 2.0, 8.0)
     supply = ((1.2, 1.2), (2.4, 2.4), (10.0, 8.0))
     announces = (0.0, 4.0)
@@ -166,13 +182,17 @@ def live_grid():
                               announces, waves)]
 
 
-def build_config(peers, segments, live, degree, live_sync_s=16.0):
-    """The static scenario description: topology degree and the
-    live-sync cushion are the only compile-time knobs."""
+def build_config(peers, segments, live, degree, live_sync_s=None):
+    """The static scenario description: topology degree is the only
+    compile-time knob (the live-sync cushion is dynamic scenario data
+    since this round).  ``live_sync_s`` re-pins the cushion as a
+    static config field — only the legacy group-per-cushion reference
+    path uses it (``run_grid_batched(static_live_sync=True)``, the
+    benchmark baseline the one-group live grid is measured against)."""
+    kwargs = {} if live_sync_s is None else {"live_sync_s": live_sync_s}
     return SwarmConfig(n_peers=peers, n_segments=segments,
                       n_levels=N_LEVELS, live=live,
-                      live_sync_s=live_sync_s,
-                      neighbor_offsets=ring_offsets(degree))
+                      neighbor_offsets=ring_offsets(degree), **kwargs)
 
 
 def build_scenario(config, knobs, *, watch_s, stagger_s, seed):
@@ -205,54 +225,96 @@ def build_scenario(config, knobs, *, watch_s, stagger_s, seed):
         urgent_margin_s=knobs["urgent_margin_s"],
         p2p_budget_cap_ms=knobs["budget_cap_ms"],
         live_spread_s=knobs["spread_s"],
-        announce_delay_s=knobs.get("announce_delay_s", 0.0))
+        announce_delay_s=knobs.get("announce_delay_s", 0.0),
+        live_sync_s=knobs.get("live_sync_s"))
     return scenario, join
 
 
-def _static_key(knobs, live):
-    return (knobs["degree"],
-            knobs.get("live_sync_s", 16.0) if live else None)
+def _static_key(knobs, static_live_sync=False):
+    """One compile group per distinct value of this tuple.
+    ``static_live_sync=True`` re-adds the live cushion to the key —
+    the legacy one-group-per-cushion grouping, kept ONLY as the
+    benchmark reference the merged live grid is measured against."""
+    key = tuple(knobs[k] for k in STATIC_KNOBS)
+    if static_live_sync:
+        key += (knobs.get("live_sync_s"),)
+    return key
+
+
+def group_grid(grid, static_live_sync=False):
+    """The compile-group map: ``_static_key`` → grid indices.  The
+    shipped grids collapse to ONE group (asserted by
+    tests/test_sweep_groups.py) — every extra group is a compile and
+    a dispatch stream of its own."""
+    groups = {}
+    for idx, knobs in enumerate(grid):
+        groups.setdefault(_static_key(knobs, static_live_sync),
+                          []).append(idx)
+    return groups
 
 
 def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
-                     chunk=DEFAULT_CHUNK, stagger_s=60.0,
-                     record_every=0, tracer=None, pipeline=True):
+                     chunk=None, stagger_s=60.0,
+                     record_every=0, tracer=None, pipeline=True,
+                     static_live_sync=False, interleave=True):
     """The batched engine: one ``run_swarm_batch`` dispatch per
-    padded chunk, host readback pipelined one chunk behind the
-    device (``run_batch_chunked``).  Returns ``(rows, n_compiles)``
-    with rows in grid order; ``record_every=N`` attaches each row's
-    on-device metrics timeline under the ``"_timeline"`` key (a
-    ``[n_steps // N, M]`` numpy array the caller pops before
-    serializing the frontier table).  ``tracer``/``pipeline`` pass
-    through to the dispatch engine (bench.py's overlap metric)."""
-    groups = {}
-    for knobs in grid:
-        groups.setdefault(_static_key(knobs, live), []).append(knobs)
+    padded chunk per compile group, host readback pipelined one chunk
+    behind the device, chunks round-robined across groups when more
+    than one remains (``run_groups_chunked``).  ``chunk=None``
+    autotunes the chunk size from device memory.  Returns
+    ``(rows, info)`` with rows in grid order and ``info`` the
+    compile-group map (``compile_groups``, per-group ``chunk`` /
+    ``first_dispatch_s``, resolved ``chunk``); ``record_every=N``
+    attaches each row's on-device metrics timeline under the
+    ``"_timeline"`` key (a ``[n_steps // N, M]`` numpy array the
+    caller pops before serializing the frontier table).
+    ``tracer``/``pipeline`` pass through to the dispatch engine
+    (bench.py's overlap metric); ``static_live_sync=True`` +
+    ``interleave=False`` reproduce the legacy group-per-cushion
+    sequential-drain behavior as the benchmark reference."""
+    if not grid:
+        return [], {"compile_groups": 0, "chunk": None,
+                    "chunk_autotuned": chunk is None, "groups": []}
+    groups_map = group_grid(grid, static_live_sync=static_live_sync)
+    group_list = []
+    group_keys = []
+    for key, idxs in groups_map.items():
+        sync = key[-1] if (static_live_sync and live) else None
+        config = build_config(peers, segments, live, key[0],
+                              live_sync_s=sync)
+        build = (lambda k, cfg=config:
+                 build_scenario(cfg, k, watch_s=watch_s,
+                                stagger_s=stagger_s, seed=seed))
+        group_list.append((config, [grid[i] for i in idxs], build))
+        group_keys.append((key, idxs))
+    n_steps = int(watch_s * 1000.0 / group_list[0][0].dt_ms)
+    results, stats = run_groups_chunked(
+        group_list, n_steps, watch_s=watch_s, chunk=chunk,
+        record_every=record_every, tracer=tracer, pipeline=pipeline,
+        interleave=interleave)
 
-    rows = []
-    compiles = set()
-    for (degree, sync), points in groups.items():
-        config = build_config(peers, segments, live, degree,
-                              live_sync_s=sync if live else 16.0)
-        n_steps = int(watch_s * 1000.0 / config.dt_ms)
-        metrics = run_batch_chunked(
-            config, points,
-            lambda k: build_scenario(config, k, watch_s=watch_s,
-                                     stagger_s=stagger_s, seed=seed),
-            n_steps, watch_s=watch_s, chunk=chunk,
-            record_every=record_every, tracer=tracer,
-            pipeline=pipeline)
-        compiles.add((degree, sync, min(chunk, len(points))))
-        if record_every:
-            rows.extend({**knobs, "offload": round(off, 4),
-                         "rebuffer": round(reb, 5), "_timeline": tl}
-                        for knobs, (off, reb, tl)
-                        in zip(points, metrics))
-        else:
-            rows.extend({**knobs, "offload": round(off, 4),
-                         "rebuffer": round(reb, 5)}
-                        for knobs, (off, reb) in zip(points, metrics))
-    return rows, len(compiles)
+    rows = [None] * len(grid)
+    for (key, idxs), metrics in zip(group_keys, results):
+        for i, metric in zip(idxs, metrics):
+            if record_every:
+                off, reb, tl = metric
+                rows[i] = {**grid[i], "offload": round(off, 4),
+                           "rebuffer": round(reb, 5), "_timeline": tl}
+            else:
+                off, reb = metric
+                rows[i] = {**grid[i], "offload": round(off, 4),
+                           "rebuffer": round(reb, 5)}
+    info = {
+        "compile_groups": len(group_list),
+        "chunk": max(st["chunk"] for st in stats),
+        "chunk_autotuned": chunk is None,
+        "groups": [{"key": list(key), "points": len(idxs),
+                    "chunk": st["chunk"], "chunks": st["chunks"],
+                    "first_dispatch_s": round(st["first_dispatch_s"],
+                                              3)}
+                   for (key, idxs), st in zip(group_keys, stats)],
+    }
+    return rows, info
 
 
 def run_grid_sequential(grid, *, peers, segments, watch_s, live, seed,
@@ -260,26 +322,28 @@ def run_grid_sequential(grid, *, peers, segments, watch_s, live, seed,
     """The pre-batching reference path: one ``run_swarm`` dispatch
     plus one blocking host readback PER grid point.  Kept as the
     parity/benchmark baseline the batched engine is measured against
-    (bench.py ``sweep_grid``) and as ``--sequential``."""
+    (bench.py ``sweep_grid``) and as ``--sequential``.  Scenario
+    construction is IDENTICAL to the batched path — per-scenario
+    ``live_sync_s`` included — so it stays a bit-exact reference for
+    the merged one-group live grid."""
     rows = []
     compiles = set()
     for knobs in grid:
-        key = _static_key(knobs, live)
-        config = build_config(peers, segments, live, knobs["degree"],
-                              live_sync_s=key[1] if live else 16.0)
+        config = build_config(peers, segments, live, knobs["degree"])
         n_steps = int(watch_s * 1000.0 / config.dt_ms)
         scenario, join = build_scenario(config, knobs, watch_s=watch_s,
                                         stagger_s=stagger_s, seed=seed)
         final, _ = run_swarm_scenario(config, scenario,
                                       init_swarm(config), n_steps)
-        compiles.add(key)
+        compiles.add(_static_key(knobs))
         rows.append({
             **knobs,
             "offload": round(float(offload_ratio(final)), 4),
             "rebuffer": round(float(rebuffer_ratio(final, watch_s,
                                                    join)), 5),
         })
-    return rows, len(compiles)
+    return rows, {"compile_groups": len(compiles), "chunk": None,
+                  "chunk_autotuned": False, "groups": []}
 
 
 def main():
@@ -290,8 +354,10 @@ def main():
     ap.add_argument("--live", action="store_true",
                     help="sweep the live-edge stagger grid instead of VOD")
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--chunk", type=int, default=DEFAULT_CHUNK,
-                    help="scenarios per batched dispatch")
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="scenarios per batched dispatch (default: "
+                         "autotuned from device memory and the "
+                         "per-lane state footprint)")
     ap.add_argument("--sequential", action="store_true",
                     help="per-point dispatch (the pre-batching "
                          "reference path)")
@@ -322,11 +388,12 @@ def main():
     grid = live_grid() if args.live else vod_grid()
     engine = run_grid_sequential if args.sequential else run_grid_batched
     t0 = time.perf_counter()
-    rows, n_compiles = engine(
+    rows, info = engine(
         grid, peers=args.peers, segments=args.segments,
         watch_s=args.watch_s, live=args.live, seed=args.seed,
         chunk=args.chunk, record_every=args.record_every)
     elapsed = time.perf_counter() - t0
+    n_compiles = info["compile_groups"]
 
     # the timeline blocks ride the rows out of the engine but never
     # enter the frontier table / sweep artifact — pop them first
@@ -374,10 +441,14 @@ def main():
             print(" | ".join(f"{row[k]!s:>15}" for k in knob_names
                              + ["offload", "rebuffer"]))
     mode = "sequential" if args.sequential else "batched"
+    chunk_note = ("" if args.sequential else
+                  f", chunk {info['chunk']}"
+                  f"{' (autotuned)' if info['chunk_autotuned'] else ''}")
     summary = (f"{len(rows)} grid points x {args.peers} peers x "
                f"{args.watch_s:.0f}s in {elapsed:.1f}s "
                f"({len(rows) / elapsed:.2f} points/s, {mode} engine, "
-               f"{n_compiles} XLA compile{'s' if n_compiles != 1 else ''})")
+               f"{n_compiles} XLA compile{'s' if n_compiles != 1 else ''}"
+               f"{chunk_note})")
     print(f"# {summary}", file=sys.stderr)
     if args.out:
         device = jax.devices()[0]
@@ -390,7 +461,9 @@ def main():
                     "grid_points": len(rows),
                     "points_per_sec": round(len(rows) / elapsed, 3),
                     "engine": mode,
-                    "chunk": None if args.sequential else args.chunk,
+                    "chunk": info.get("chunk"),
+                    "chunk_autotuned": info.get("chunk_autotuned"),
+                    "compile_groups": n_compiles,
                     "record_every": args.record_every or None,
                     "platform": device.platform,
                     "device_kind": getattr(device, "device_kind", "?"),

@@ -1,0 +1,168 @@
+"""Timeline triage: turn a sweep's trajectory dump into a work list.
+
+Scans the JSON-lines file ``tools/sweep.py --timelines-out`` (or
+``tools/policy_ab.py --timelines-out``) writes — one object per grid
+point: knobs + ``columns`` + ``samples`` — and flags the two
+pathologies the on-device metrics timelines were built to expose
+(ROADMAP "timeline-driven scenario debugging"):
+
+- **ABR-ladder oscillation**: the present-peer mass's dominant
+  bitrate level keeps flipping between adjacent rungs sample over
+  sample — the estimator/ladder interaction is hunting instead of
+  settling.  Detected as ≥ ``--min-flips`` dominant-level changes
+  that are also ≥ ``--osc-frac`` of all sample transitions (so a
+  single early ramp-up step never counts as oscillation).
+- **Offload-ramp stall**: cumulative offload flat-lines low — the
+  P2P ramp either never started or died.  Detected when the final
+  offload is below ``--stall-offload`` AND the gain over the last
+  half of the window is below ``--stall-gain`` (a point that ends
+  low but is still climbing is a short window, not a stall).
+
+Prints one triaged line per flagged grid point (knobs + reasons +
+the numbers behind them) and a summary; ``--strict`` exits nonzero
+when anything was flagged, so ``make sweep-live`` can gate on a
+clean grid.  ``--json`` emits findings as JSON lines for downstream
+tooling.  Pure stdlib + host arithmetic — no jax import, so triage
+runs anywhere the artifact does.
+
+Usage::
+
+    python tools/sweep.py --live --timelines-out TL.jsonl
+    python tools/triage_timelines.py TL.jsonl [--strict] [--json]
+"""
+
+import argparse
+import json
+import sys
+
+#: record keys that are structure, not scenario knobs
+_RESERVED = ("columns", "samples", "record_every", "offload",
+             "rebuffer")
+
+
+def _dominant_levels(columns, samples):
+    """Per-sample dominant ABR level (index of the ``level_i_peers``
+    column with the most present peers; lowest level wins ties),
+    skipping samples with no present peers at all (pre-join)."""
+    level_cols = [i for i, c in enumerate(columns)
+                  if c.startswith("level_") and c.endswith("_peers")]
+    doms = []
+    for sample in samples:
+        masses = [sample[i] for i in level_cols]
+        if sum(masses) <= 0:
+            continue
+        doms.append(masses.index(max(masses)))
+    return doms
+
+
+def detect_oscillation(columns, samples, *, min_flips=4,
+                       osc_frac=0.25):
+    """Ladder-oscillation finding dict, or None."""
+    doms = _dominant_levels(columns, samples)
+    if len(doms) < 3:
+        return None
+    flips = sum(1 for a, b in zip(doms, doms[1:]) if a != b)
+    transitions = len(doms) - 1
+    if flips >= min_flips and flips / transitions >= osc_frac:
+        return {"reason": "ladder_oscillation", "flips": flips,
+                "transitions": transitions}
+    return None
+
+
+def detect_offload_stall(columns, samples, *, stall_offload=0.2,
+                         stall_gain=0.02):
+    """Offload-ramp-stall finding dict, or None."""
+    off_col = columns.index("offload")
+    offloads = [sample[off_col] for sample in samples]
+    if len(offloads) < 4:
+        return None
+    half_gain = offloads[-1] - offloads[len(offloads) // 2]
+    if offloads[-1] < stall_offload and half_gain < stall_gain:
+        return {"reason": "offload_stall",
+                "final_offload": round(offloads[-1], 4),
+                "last_half_gain": round(half_gain, 4)}
+    return None
+
+
+def knob_label(record):
+    """Compact ``k=v`` knob summary for one record's triage line."""
+    return " ".join(f"{k}={v}" for k, v in record.items()
+                    if k not in _RESERVED)
+
+
+def triage_records(records, *, min_flips=4, osc_frac=0.25,
+                   stall_offload=0.2, stall_gain=0.02):
+    """Findings list: ``{"point", "knobs", "findings": [...]}`` per
+    flagged record, in file order."""
+    triaged = []
+    for idx, record in enumerate(records):
+        columns = record["columns"]
+        samples = record["samples"]
+        findings = [f for f in (
+            detect_oscillation(columns, samples, min_flips=min_flips,
+                               osc_frac=osc_frac),
+            detect_offload_stall(columns, samples,
+                                 stall_offload=stall_offload,
+                                 stall_gain=stall_gain),
+        ) if f is not None]
+        if findings:
+            triaged.append({"point": idx, "knobs": knob_label(record),
+                            "findings": findings})
+    return triaged
+
+
+def _describe(finding):
+    if finding["reason"] == "ladder_oscillation":
+        return (f"ladder_oscillation ({finding['flips']} flips / "
+                f"{finding['transitions']} transitions)")
+    return (f"offload_stall (final {finding['final_offload']}, "
+            f"last-half gain {finding['last_half_gain']})")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("timelines", metavar="FILE",
+                    help="JSON-lines timeline dump "
+                         "(sweep/policy_ab --timelines-out)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero when any point is flagged")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON lines")
+    ap.add_argument("--min-flips", type=int, default=4,
+                    help="dominant-level changes before a point "
+                         "counts as oscillating (default 4)")
+    ap.add_argument("--osc-frac", type=float, default=0.25,
+                    help="minimum flips / transitions ratio "
+                         "(default 0.25)")
+    ap.add_argument("--stall-offload", type=float, default=0.2,
+                    help="final offload below this is stall-eligible "
+                         "(default 0.2)")
+    ap.add_argument("--stall-gain", type=float, default=0.02,
+                    help="last-half offload gain below this means "
+                         "the ramp stopped (default 0.02)")
+    args = ap.parse_args(argv)
+
+    with open(args.timelines, encoding="utf-8") as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    triaged = triage_records(
+        records, min_flips=args.min_flips, osc_frac=args.osc_frac,
+        stall_offload=args.stall_offload, stall_gain=args.stall_gain)
+
+    if args.json:
+        for entry in triaged:
+            print(json.dumps(entry))
+    else:
+        for entry in triaged:
+            reasons = "; ".join(_describe(f) for f in entry["findings"])
+            print(f"point {entry['point']:>3} [{entry['knobs']}]: "
+                  f"{reasons}")
+    reasons = [f["reason"] for e in triaged for f in e["findings"]]
+    print(f"# triaged {len(records)} timelines: {len(triaged)} "
+          f"flagged ({reasons.count('ladder_oscillation')} "
+          f"oscillating, {reasons.count('offload_stall')} stalled)",
+          file=sys.stderr)
+    return 1 if (args.strict and triaged) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
