@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
-	coverage soak scaling-artifact
+	coverage soak scaling-artifact warmstart-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -55,6 +55,16 @@ dryrun:
 scaling-artifact:
 	$(PY) tools/scaling_curve.py --out SCALING_r05.json
 
+# process-level warm-start proof (engine/artifact_cache.py): both
+# shipped grids run three times in SEPARATE child processes against
+# a throwaway cache dir — the second run must perform 0 XLA compiles
+# (serialized executables + persistent compilation cache) and
+# reproduce the first run's rows bit-exactly; the third must reuse
+# every row.  Gate-sized swarms by default; WARMSTART_GATE_PEERS
+# etc. scale it up on accelerator hosts.
+warmstart-gate:
+	$(PY) tools/warmstart_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -63,6 +73,6 @@ examples:
 	$(PY) examples/swarm_demo.py --live
 	$(PY) examples/production_demo.py
 
-check: lint test dryrun
+check: lint test dryrun warmstart-gate
 
 all: check bench

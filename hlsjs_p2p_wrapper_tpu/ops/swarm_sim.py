@@ -1512,7 +1512,8 @@ def autotune_chunk(config: SwarmConfig, n_items: int, n_steps: int, *,
 def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
                        chunk: Optional[int] = None,
                        record_every: int = 0, tracer=None,
-                       pipeline: bool = True, interleave: bool = True):
+                       pipeline: bool = True, interleave: bool = True,
+                       warm_start=None):
     """Chunked, pipelined dispatch over MULTIPLE compile groups — the
     engine under :func:`run_batch_chunked` (one group) and
     ``tools/sweep.py`` (one group per remaining static knob value).
@@ -1552,10 +1553,55 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
     ``pipeline=False`` drains each chunk immediately after its own
     dispatch — the overlap-measurement baseline (it blocks on the
     device results INSIDE the dispatch span, so its readback spans
-    time the host transfer alone)."""
+    time the host transfer alone).
+
+    ``warm_start`` (an ``engine.artifact_cache.WarmStart``,
+    duck-typed so this device-side module never imports the host
+    engine package) threads the two-layer persistent cache through
+    the dispatch:
+
+    - **row reuse** (layer 2): each item's scenario is built once up
+      front to compute its content-addressed row key; hits fill
+      ``results`` directly and leave the schedule, so a fully-cached
+      group dispatches NOTHING (its ``first_dispatch_s`` stays
+      None).  Misses are re-built at chunk time: the build is
+      deterministic (and the tools memoize its PRNG-derived arrays),
+      so the double construction costs host arithmetic, whereas
+      holding every missed scenario alive instead would pin O(grid)
+      device buffers.  Stored/loaded metrics are the exact tuples
+      ``drain`` produces (full-precision floats + raw timeline
+      arrays), so a hit is bit-identical to the dispatch it skips.
+    - **serialized executables** (layer 1): each dispatch runs
+      through ``warm_start.batch_runner`` — the deserialized
+      on-disk executable when present (zero XLA compiles), a fresh
+      AOT compile (persisted back) otherwise; same program, same
+      donation signature, bit-exact either way
+      (tests/test_artifact_cache.py)."""
+    rows_on = warm_start is not None and warm_start.rows_enabled
+    aot_on = warm_start is not None and warm_start.aot_enabled
+    groups = [(config, list(items), build)
+              for config, items, build in groups]
+    results = [[None] * len(items) for _, items, _ in groups]
     prepared = []
-    for config, items, build in groups:
-        items = list(items)
+    for gi, (config, items, build) in enumerate(groups):
+        keep = list(range(len(items)))
+        keys = None
+        if rows_on:
+            # layer-2 prefilter: build each item once for its
+            # content hash, fill hits, dispatch only the misses
+            keep, keys = [], []
+            for idx, item in enumerate(items):
+                scenario, join = build(item)
+                key = warm_start.row_key(config, scenario, join,
+                                         n_steps, watch_s=watch_s,
+                                         record_every=record_every)
+                cached = warm_start.row_load(key)
+                if (cached is not None
+                        and (len(cached) > 2) == bool(record_every)):
+                    results[gi][idx] = cached
+                else:
+                    keep.append(idx)
+                    keys.append(key)
         if chunk is None:
             # probe-build one lane so the autotuner sizes the REAL
             # scenario footprint (the general [P, K] path's
@@ -1563,21 +1609,28 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
             # penalty width are invisible to the analytic fallback);
             # costs one duplicate build per group, amortized over
             # every chunk
-            probe = build(items[0])[0] if items else None
+            probe = build(items[keep[0]])[0] if keep else None
             batch = autotune_chunk(config, len(items), n_steps,
                                    record_every=record_every,
                                    scenario=probe)
         else:
             batch = max(min(chunk, len(items)), 1)
-        prepared.append((config, items, build, batch))
-    results = [[None] * len(items) for _, items, _, _ in prepared]
+        # the batch cap uses the PRE-FILTER item count, not len(keep):
+        # the dispatch shape must not depend on how many rows the
+        # cache served, or a partially-warm rerun (grid grew by a few
+        # points) would re-key the [B, P, …] program and throw away
+        # its cached layer-1 executable to save some padded lanes —
+        # trading a fresh XLA compile (~40 s/program on TPU v5e) for
+        # pad compute is the wrong side of the bargain
+        prepared.append((config, items, build, batch, keep, keys))
     stats = [{"items": len(items), "chunk": batch, "chunks": 0,
+              "row_hits": len(items) - len(keep),
               "first_dispatch_s": None}
-             for _, items, _, batch in prepared]
+             for _, items, _, batch, keep, _ in prepared]
 
-    starts = [list(range(0, len(items), batch))
-              for _, items, _, batch in prepared]
-    schedule = []  # (group idx, group-local chunk idx, item offset)
+    starts = [list(range(0, len(keep), batch))
+              for _, _, _, batch, keep, _ in prepared]
+    schedule = []  # (group idx, group-local chunk idx, keep offset)
     if interleave:
         ci = 0
         while any(ci < len(s) for s in starts):
@@ -1589,11 +1642,12 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
         for gi, s in enumerate(starts):
             schedule.extend((gi, ci, off) for ci, off in enumerate(s))
 
-    pending = None  # (gi, ci, offset, n real lanes, offs, rebs, rows)
+    pending = None  # (gi, ci, kept indices, row keys, offs, rebs, rows)
 
     def drain(entry):
-        gi, ci, off, n, offs, rebs, rows = entry
+        gi, ci, kept, kept_keys, offs, rebs, rows = entry
         with _span(tracer, "readback", group=gi, chunk=ci):
+            n = len(kept)
             if rows is None:
                 out = [(float(o), float(r))
                        for o, r in zip(offs[:n], rebs[:n])]
@@ -1602,22 +1656,35 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
                 out = [(float(o), float(r), rows[lane])
                        for lane, (o, r) in enumerate(zip(offs[:n],
                                                          rebs[:n]))]
-            results[gi][off:off + n] = out
+            for pos, metric in enumerate(out):
+                results[gi][kept[pos]] = metric
+                if kept_keys is not None:
+                    warm_start.row_store(kept_keys[pos], metric)
 
     for gi, ci, off in schedule:
-        config, items, build, batch = prepared[gi]
-        chunk_items = items[off:off + batch]
+        config, items, build, batch, keep, keys = prepared[gi]
+        kept = keep[off:off + batch]
+        kept_keys = keys[off:off + batch] if keys is not None else None
         with _span(tracer, "build", group=gi, chunk=ci):
-            built = [build(item) for item in chunk_items]
+            built = [build(items[i]) for i in kept]
             built += [built[-1]] * (batch - len(built))
             scenarios = stack_pytrees([sc for sc, _ in built])
             joins = jnp.stack([j for _, j in built])
             states = stack_pytrees([init_swarm(config)] * batch)
         t0 = time.perf_counter()
         with _span(tracer, "dispatch", group=gi, chunk=ci):
-            res = run_swarm_batch(config, scenarios, states, n_steps,
-                                  record_every=record_every,
-                                  donate_scenarios=True)
+            if aot_on:
+                states = ensure_penalty_width_batch(config, scenarios,
+                                                    states)
+                runner = warm_start.batch_runner(
+                    config, scenarios, states, n_steps,
+                    record_every=record_every, donate_scenarios=True)
+                res = runner(scenarios, states)
+            else:
+                res = run_swarm_batch(config, scenarios, states,
+                                      n_steps,
+                                      record_every=record_every,
+                                      donate_scenarios=True)
             finals = res[0]
             rows = res[2] if record_every else None
             offs = offload_ratio_batch(finals)
@@ -1634,7 +1701,7 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
         if stats[gi]["first_dispatch_s"] is None:
             stats[gi]["first_dispatch_s"] = time.perf_counter() - t0
         stats[gi]["chunks"] += 1
-        entry = (gi, ci, off, len(chunk_items), offs, rebs, rows)
+        entry = (gi, ci, kept, kept_keys, offs, rebs, rows)
         if not pipeline:
             drain(entry)
             continue
@@ -1649,22 +1716,24 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
 def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
                       *, watch_s: float, chunk: Optional[int] = None,
                       record_every: int = 0, tracer=None,
-                      pipeline: bool = True):
+                      pipeline: bool = True, warm_start=None):
     """Single-group front-end for :func:`run_groups_chunked` — the
     dispatch engine shared by ``tools/sweep.py`` and
     ``tools/policy_ab.py``.  Returns per-item ``(offload, rebuffer)``
     floats in item order (a ``[n_samples, M]`` numpy metrics timeline
     appended per item when ``record_every > 0``); ``chunk=None``
     autotunes the scenarios-per-dispatch from device memory
-    (:func:`autotune_chunk`).  See :func:`run_groups_chunked` for the
-    chunking/padding/pipelining contract."""
+    (:func:`autotune_chunk`); ``warm_start`` threads the persistent
+    executable/row caches through the dispatch.  See
+    :func:`run_groups_chunked` for the chunking/padding/pipelining
+    contract."""
     items = list(items)
     if not items:
         return []
     results, _stats = run_groups_chunked(
         [(config, items, build)], n_steps, watch_s=watch_s,
         chunk=chunk, record_every=record_every, tracer=tracer,
-        pipeline=pipeline)
+        pipeline=pipeline, warm_start=warm_start)
     return results[0]
 
 

@@ -1,0 +1,400 @@
+"""The warm-start engine (engine/artifact_cache.py): serialized
+executables and content-addressed rows must be pure performance
+transforms — bit-exact against fresh compiles, zero XLA compiles on
+a disk hit, and any corruption / version skew must fall back to a
+fresh compile (observable in the registry) rather than crash or
+serve stale numbers.  The process-level half of the claim (a SECOND
+process compiles nothing) lives in tools/warmstart_gate.py; these
+tests pin the mechanism, the key discipline, and the hardening."""
+
+import json
+import os
+import struct
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (
+    _MAGIC, CompileCounter, WarmStart, executable_key, row_key,
+    toolchain_versions)
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (
+    SwarmConfig, init_swarm, make_scenario, ring_offsets,
+    run_batch_chunked, run_swarm_batch, stack_pytrees, _donate_argnums)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+import sweep as sweep_tool  # noqa: E402
+
+PEERS = 16
+BITRATES = jnp.array([300_000.0, 800_000.0])
+N_STEPS = 40
+WATCH_S = 10.0
+
+
+def small_config(**kwargs):
+    return SwarmConfig(n_peers=PEERS, n_segments=8, n_levels=2,
+                       neighbor_offsets=ring_offsets(4), **kwargs)
+
+
+def batch_fixture(config, margins=(0.5, 4.0)):
+    cdn = jnp.full((PEERS,), 8_000_000.0)
+    scenarios = stack_pytrees([
+        make_scenario(config, BITRATES, None, cdn,
+                      urgent_margin_s=margin) for margin in margins])
+    states = stack_pytrees([init_swarm(config)] * len(margins))
+    return scenarios, states
+
+
+def chunked_fixture(config):
+    cdn = jnp.full((PEERS,), 8_000_000.0)
+
+    def build(margin):
+        return (make_scenario(config, BITRATES, None, cdn,
+                              urgent_margin_s=margin),
+                jnp.zeros((PEERS,)))
+
+    return [0.5, 2.0, 4.0, 8.0, 16.0], build
+
+
+def assert_trees_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b), strict=True):
+        assert jnp.array_equal(x, y)
+
+
+# -- layer 1: serialized executables -----------------------------------
+
+def test_executable_cache_bit_exact_across_instances(tmp_path):
+    """Populate with one WarmStart, reload with a FRESH one (empty
+    in-process memo = the second-process path): the deserialized
+    executable's outputs must be bit-identical to run_swarm_batch."""
+    config = small_config()
+    scenarios, states = batch_fixture(config)
+    ref = run_swarm_batch(config, scenarios, states, N_STEPS)
+
+    ws1 = WarmStart(cache_dir=str(tmp_path))
+    runner = ws1.batch_runner(config, scenarios, states, N_STEPS)
+    assert_trees_equal(runner(scenarios, states), ref)
+    assert ws1.event_counts("executable") == {"miss": 1, "store": 1}
+
+    ws2 = WarmStart(cache_dir=str(tmp_path))
+    loaded = ws2.batch_runner(config, scenarios, states, N_STEPS)
+    assert_trees_equal(loaded(scenarios, states), ref)
+    assert ws2.event_counts("executable") == {"hit": 1}
+
+
+def test_warm_hit_performs_zero_xla_compiles(tmp_path):
+    config = small_config()
+    scenarios, states = batch_fixture(config)
+    WarmStart(cache_dir=str(tmp_path)).batch_runner(
+        config, scenarios, states, N_STEPS)
+
+    ws = WarmStart(cache_dir=str(tmp_path))
+    with CompileCounter() as probe:
+        runner = ws.batch_runner(config, scenarios, states, N_STEPS)
+        jax.block_until_ready(runner(scenarios, states))
+    assert probe.compiles == 0
+    assert ws.event_counts("executable") == {"hit": 1}
+
+
+def test_truncated_artifact_falls_back_and_repopulates(tmp_path):
+    config = small_config()
+    scenarios, states = batch_fixture(config)
+    ref = run_swarm_batch(config, scenarios, states, N_STEPS)
+    WarmStart(cache_dir=str(tmp_path)).batch_runner(
+        config, scenarios, states, N_STEPS)
+    (path,) = [os.path.join(tmp_path, "aot", name)
+               for name in os.listdir(tmp_path / "aot")]
+    with open(path, "rb") as fh:
+        blob = fh.read()
+    with open(path, "wb") as fh:
+        fh.write(blob[:len(blob) // 2])
+
+    ws = WarmStart(cache_dir=str(tmp_path))
+    runner = ws.batch_runner(config, scenarios, states, N_STEPS)
+    assert_trees_equal(runner(scenarios, states), ref)
+    assert ws.event_counts("executable") == {"corrupt": 1, "store": 1}
+    # the repopulated artifact serves the next instance
+    ws3 = WarmStart(cache_dir=str(tmp_path))
+    ws3.batch_runner(config, scenarios, states, N_STEPS)
+    assert ws3.event_counts("executable") == {"hit": 1}
+
+
+def test_bitflipped_artifact_reads_as_corrupt(tmp_path):
+    config = small_config()
+    scenarios, states = batch_fixture(config)
+    ref = run_swarm_batch(config, scenarios, states, N_STEPS)
+    WarmStart(cache_dir=str(tmp_path)).batch_runner(
+        config, scenarios, states, N_STEPS)
+    (path,) = [os.path.join(tmp_path, "aot", name)
+               for name in os.listdir(tmp_path / "aot")]
+    blob = bytearray(open(path, "rb").read())
+    blob[-100] ^= 0x40  # one bit, deep in the executable body
+    open(path, "wb").write(bytes(blob))
+
+    ws = WarmStart(cache_dir=str(tmp_path))
+    runner = ws.batch_runner(config, scenarios, states, N_STEPS)
+    assert_trees_equal(runner(scenarios, states), ref)
+    assert ws.event_counts("executable")["corrupt"] == 1
+
+
+def test_version_skew_falls_back_and_is_counted(tmp_path):
+    """A mismatched toolchain header (here: jaxlib) must read as
+    ``skew`` — fresh compile, no stale reuse, artifact overwritten in
+    place with the current versions."""
+    config = small_config()
+    scenarios, states = batch_fixture(config)
+    ref = run_swarm_batch(config, scenarios, states, N_STEPS)
+    WarmStart(cache_dir=str(tmp_path)).batch_runner(
+        config, scenarios, states, N_STEPS)
+    (path,) = [os.path.join(tmp_path, "aot", name)
+               for name in os.listdir(tmp_path / "aot")]
+    blob = open(path, "rb").read()
+    off = len(_MAGIC)
+    (header_len,) = struct.unpack(">I", blob[off:off + 4])
+    header = json.loads(blob[off + 4:off + 4 + header_len])
+    body = blob[off + 4 + header_len:]
+    header["versions"]["jaxlib"] = "0.0.0-other"
+    skewed = json.dumps(header).encode()
+    open(path, "wb").write(_MAGIC + struct.pack(">I", len(skewed))
+                           + skewed + body)
+
+    ws = WarmStart(cache_dir=str(tmp_path))
+    runner = ws.batch_runner(config, scenarios, states, N_STEPS)
+    assert_trees_equal(runner(scenarios, states), ref)
+    assert ws.event_counts("executable") == {"skew": 1, "store": 1}
+    ws2 = WarmStart(cache_dir=str(tmp_path))
+    ws2.batch_runner(config, scenarios, states, N_STEPS)
+    assert ws2.event_counts("executable") == {"hit": 1}
+
+
+def test_executable_key_separates_programs():
+    """Distinct (config, extent, timeline, shape) → distinct keys;
+    identical inputs → identical keys (the no-alias contract)."""
+    config = small_config()
+    scenarios, states = batch_fixture(config)
+    donate = _donate_argnums(jax.default_backend(), True)
+
+    def key(cfg=config, sc=scenarios, st=states, n=N_STEPS, re=0):
+        return executable_key(cfg, sc, st, n, record_every=re,
+                              donate_argnums=donate)
+
+    assert key() == key()
+    assert key(n=N_STEPS + 1) != key()
+    assert key(re=10) != key()
+    assert key(cfg=small_config(max_total_serves=0)) != key()
+    wider, wider_states = batch_fixture(config, margins=(0.5, 4.0, 8.0))
+    assert key(sc=wider, st=wider_states) != key()
+    # a different donation signature is a different executable (the
+    # backend-resolved tuple is () on CPU, so compare two literals)
+    assert executable_key(config, scenarios, states, N_STEPS,
+                          record_every=0,
+                          donate_argnums=(1, 2)) != key()
+
+
+# -- layer 2: content-addressed rows -----------------------------------
+
+def test_row_cache_bit_exact_and_key_content_addressed(tmp_path):
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2)
+
+    ws1 = WarmStart(cache_dir=str(tmp_path))
+    cold = run_batch_chunked(config, items, build, N_STEPS,
+                             watch_s=WATCH_S, chunk=2, warm_start=ws1)
+    assert cold == ref
+    assert ws1.event_counts("row") == {"miss": 5, "store": 5}
+
+    ws2 = WarmStart(cache_dir=str(tmp_path))
+    warm = run_batch_chunked(config, items, build, N_STEPS,
+                             watch_s=WATCH_S, chunk=2, warm_start=ws2)
+    assert warm == ref  # full-precision float equality
+    assert ws2.event_counts("row") == {"hit": 5}
+    assert ws2.event_counts("executable") == {}  # nothing dispatched
+
+    # a changed scenario input misses (content addressing), changed
+    # extents miss (key fields)
+    cdn = jnp.full((PEERS,), 8_000_000.0)
+    scenario, join = build(0.5)
+    base = row_key(config, scenario, join, N_STEPS, watch_s=WATCH_S,
+                   record_every=0)
+    other = make_scenario(config, BITRATES, None, cdn * 2.0,
+                          urgent_margin_s=0.5)
+    assert row_key(config, other, join, N_STEPS, watch_s=WATCH_S,
+                   record_every=0) != base
+    assert row_key(config, scenario, join, N_STEPS + 1,
+                   watch_s=WATCH_S, record_every=0) != base
+    assert row_key(config, scenario, join, N_STEPS, watch_s=WATCH_S,
+                   record_every=5) != base
+    assert row_key(config, scenario, join, N_STEPS, watch_s=WATCH_S,
+                   record_every=0) == base
+
+
+def test_row_cache_round_trips_timelines(tmp_path):
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2, record_every=10)
+
+    ws1 = WarmStart(cache_dir=str(tmp_path))
+    run_batch_chunked(config, items, build, N_STEPS, watch_s=WATCH_S,
+                      chunk=2, record_every=10, warm_start=ws1)
+    ws2 = WarmStart(cache_dir=str(tmp_path))
+    warm = run_batch_chunked(config, items, build, N_STEPS,
+                             watch_s=WATCH_S, chunk=2,
+                             record_every=10, warm_start=ws2)
+    assert ws2.event_counts("row") == {"hit": 5}
+    for (o1, r1, t1), (o2, r2, t2) in zip(ref, warm, strict=True):
+        assert (o1, r1) == (o2, r2)
+        assert t1.dtype == t2.dtype
+        assert np.array_equal(t1, t2)
+    # a timeline-less request is a DIFFERENT key — no cross-serving
+    ws3 = WarmStart(cache_dir=str(tmp_path))
+    plain = run_batch_chunked(config, items, build, N_STEPS,
+                              watch_s=WATCH_S, chunk=2,
+                              warm_start=ws3)
+    assert ws3.event_counts("row")["miss"] == 5
+    assert plain == [(o, r) for o, r, _ in ref]
+
+
+def test_corrupt_row_recomputes(tmp_path):
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ws1 = WarmStart(cache_dir=str(tmp_path))
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2, warm_start=ws1)
+    rows_dir = tmp_path / "rows"
+    victim = sorted(os.listdir(rows_dir))[0]
+    open(rows_dir / victim, "wb").write(b"not an npz")
+
+    ws2 = WarmStart(cache_dir=str(tmp_path))
+    warm = run_batch_chunked(config, items, build, N_STEPS,
+                             watch_s=WATCH_S, chunk=2, warm_start=ws2)
+    assert warm == ref
+    events = ws2.event_counts("row")
+    assert events["corrupt"] == 1
+    assert events["hit"] == 4
+    assert events["store"] == 1  # the recomputed row repopulates
+
+
+def test_no_row_cache_recomputes_but_executables_warm(tmp_path):
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ws1 = WarmStart(cache_dir=str(tmp_path))
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2, warm_start=ws1)
+
+    ws2 = WarmStart(cache_dir=str(tmp_path), row_cache=False)
+    warm = run_batch_chunked(config, items, build, N_STEPS,
+                             watch_s=WATCH_S, chunk=2, warm_start=ws2)
+    assert warm == ref
+    assert ws2.event_counts("row") == {}
+    assert ws2.event_counts("executable") == {"hit": 1}
+
+
+def test_partial_row_hits_keep_the_executable_shape(tmp_path):
+    """A partially-warm rerun (some rows cached, some not) must
+    dispatch its misses at the SAME batch shape as a cold run —
+    shrinking the batch to the miss count would re-key the program
+    and throw away the cached layer-1 executable to save padding."""
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ws1 = WarmStart(cache_dir=str(tmp_path))
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=5, warm_start=ws1)
+    # evict ONE row: the rerun has 4 hits + 1 miss
+    rows_dir = tmp_path / "rows"
+    os.unlink(rows_dir / sorted(os.listdir(rows_dir))[0])
+
+    ws2 = WarmStart(cache_dir=str(tmp_path))
+    warm = run_batch_chunked(config, items, build, N_STEPS,
+                             watch_s=WATCH_S, chunk=5, warm_start=ws2)
+    assert warm == ref
+    assert ws2.event_counts("row")["hit"] == 4
+    # the single miss dispatched through the CACHED executable (the
+    # [5]-lane program, padded), not a fresh [1]-lane compile
+    assert ws2.event_counts("executable") == {"hit": 1}
+
+
+# -- registry + tool surfaces ------------------------------------------
+
+def test_events_land_in_injected_registry(tmp_path):
+    registry = MetricsRegistry()
+    config = small_config()
+    scenarios, states = batch_fixture(config)
+    ws = WarmStart(cache_dir=str(tmp_path), registry=registry)
+    ws.batch_runner(config, scenarios, states, N_STEPS)
+    snapshot = registry.snapshot()
+    assert snapshot[
+        "aot_cache_events{layer=executable,result=miss}"] == 1
+    assert snapshot[
+        "aot_cache_events{layer=executable,result=store}"] == 1
+    assert snapshot[
+        "aot_cache_populate_seconds{layer=executable}"] > 0.0
+    versions = toolchain_versions()
+    assert set(versions) == {"jax", "jaxlib", "xla"}
+
+
+def test_sweep_grid_warm_start_bit_exact(tmp_path):
+    """The tool-level integration: a 6-point slice of the shipped
+    VOD grid through ``sweep.run_grid_batched`` twice, raw floats —
+    the second (row-cached, executable-warm) pass reproduces the
+    first bit-exactly and dispatches nothing."""
+    grid = sweep_tool.vod_grid()[:6]
+    common = dict(peers=PEERS, segments=8, watch_s=WATCH_S, live=False,
+                  seed=0, chunk=3, raw=True)
+    ws1 = WarmStart(cache_dir=str(tmp_path))
+    rows1, info1 = sweep_tool.run_grid_batched(grid, warm_start=ws1,
+                                               **common)
+    ws2 = WarmStart(cache_dir=str(tmp_path))
+    rows2, info2 = sweep_tool.run_grid_batched(grid, warm_start=ws2,
+                                               **common)
+    assert rows1 == rows2
+    assert info1["row_hits"] == 0
+    assert info2["row_hits"] == len(grid)
+    assert info2["groups"][0]["first_dispatch_s"] is None
+    assert ws2.event_counts("row") == {"hit": len(grid)}
+
+
+# -- lint: the uncached-compile discipline ------------------------------
+
+def test_nocache_lint_rule(tmp_path):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import lint as lint_tool
+
+    bad = tmp_path / "bad_tool.py"
+    bad.write_text(
+        "import jax\n"
+        "f = jax.jit(lambda x: x)\n"
+        "g = jax.jit(lambda x: x).lower(1).compile()\n"
+        "s = 'ABC'.lower()\n")  # no args: str.lower, not jit lowering
+    findings = lint_tool.check_nocache(str(bad))
+    assert len(findings) == 3  # two jits + one argful .lower()
+    assert all("# nocache:" in f for f in findings)
+
+    # the bare decorator form must not slip past the rule
+    deco = tmp_path / "deco_tool.py"
+    deco.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x\n")
+    (finding,) = lint_tool.check_nocache(str(deco))
+    assert "@jit decorator" in finding
+
+    good = tmp_path / "good_tool.py"
+    good.write_text(
+        "import jax\n"
+        "f = jax.jit(lambda x: x)  # nocache: measures compile cost\n"
+        "@jax.jit  # nocache: decorator under test\n"
+        "def g(x):\n"
+        "    return x\n"
+        "s = 'ABC'.lower()\n")
+    assert lint_tool.check_nocache(str(good)) == []

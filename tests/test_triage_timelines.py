@@ -49,6 +49,84 @@ def healthy_record():
             "samples": samples}
 
 
+def burst_sample(t, stalled, l0, l1):
+    """A sample with an explicit interval stall count (the plain
+    ``sample`` helper pins stalled_peers to 0)."""
+    return [t, 0.5, 0.0, 1e6, 1e6, stalled, l0, l1]
+
+
+def bursting_record():
+    """Steady 12-peer audience; half of it stalls at t=6..7 with NO
+    arrivals behind the stall — a delivery failure, not a cushion
+    filling."""
+    samples = [burst_sample(t, 6.0 if t in (6, 7) else 0.0, 2.0, 10.0)
+               for t in range(12)]
+    return {"spread_s": 8.0, "columns": COLUMNS, "samples": samples}
+
+
+def join_wave_record():
+    """The same stall spike, but the audience JUMPS 4 -> 12 in the
+    stall window: a flash crowd arriving behind the live cushion —
+    excused, not flagged."""
+    samples = []
+    for t in range(12):
+        present = 4.0 if t < 6 else 12.0
+        stalled = 6.0 if t == 6 else 0.0
+        samples.append(burst_sample(t, stalled, 2.0, present - 2.0))
+    return {"spread_s": 8.0, "columns": COLUMNS, "samples": samples}
+
+
+def test_detects_rebuffer_burst_without_join_wave():
+    record = bursting_record()
+    finding = triage.detect_rebuffer_burst(record["columns"],
+                                           record["samples"])
+    assert finding is not None
+    assert finding["reason"] == "rebuffer_burst"
+    assert finding["bursts"] == 2
+    assert finding["first_t_s"] == 6
+    assert finding["max_stalled_frac"] == 0.5
+    assert finding["join_wave_coincident"] == 0
+
+
+def test_join_wave_burst_is_excused():
+    record = join_wave_record()
+    assert triage.detect_rebuffer_burst(record["columns"],
+                                        record["samples"]) is None
+
+
+def test_burst_after_wave_settles_is_flagged():
+    """A wave at t=6 is excused, but a second stall spike at t=9 —
+    audience flat by then — is a real burst."""
+    record = join_wave_record()
+    record["samples"][9][COLUMNS.index("stalled_peers")] = 7.0
+    finding = triage.detect_rebuffer_burst(record["columns"],
+                                           record["samples"])
+    assert finding is not None
+    assert finding["bursts"] == 1
+    assert finding["first_t_s"] == 9
+    assert finding["join_wave_coincident"] == 1
+
+
+def test_first_populated_sample_counts_as_wave():
+    """Everyone arriving at once in the first populated window is by
+    definition a join wave — startup stalls never flag."""
+    samples = [burst_sample(0, 0.0, 0.0, 0.0),
+               burst_sample(1, 8.0, 2.0, 10.0),
+               burst_sample(2, 0.0, 2.0, 10.0),
+               burst_sample(3, 0.0, 2.0, 10.0)]
+    assert triage.detect_rebuffer_burst(COLUMNS, samples) is None
+
+
+def test_burst_rides_triage_records():
+    triaged = triage.triage_records([bursting_record(),
+                                     join_wave_record(),
+                                     healthy_record()])
+    assert len(triaged) == 1
+    assert triaged[0]["point"] == 0
+    reasons = [f["reason"] for f in triaged[0]["findings"]]
+    assert "rebuffer_burst" in reasons
+
+
 def test_detects_ladder_oscillation_only():
     triaged = triage.triage_records([oscillating_record()])
     assert len(triaged) == 1

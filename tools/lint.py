@@ -7,12 +7,21 @@ library code logs through ``logging`` or counts into the telemetry
 registry (engine/telemetry.py); tools/tests/examples, which OWN their
 stdout, are exempt.
 
-One repo-specific rule: every entry of ``STATIC_KNOBS`` in
-``tools/sweep.py`` (the sweep's compile-group key) must carry an
-inline ``# static:`` justification comment — each static knob costs
-one XLA compile group per distinct grid value, so a knob that could
-be dynamic ``SwarmScenario`` data must not sneak back in silently
-(the live-sync cushion was exactly such a knob for two rounds).
+Two repo-specific rules:
+
+- every entry of ``STATIC_KNOBS`` in ``tools/sweep.py`` (the sweep's
+  compile-group key) must carry an inline ``# static:``
+  justification comment — each static knob costs one XLA compile
+  group per distinct grid value, so a knob that could be dynamic
+  ``SwarmScenario`` data must not sneak back in silently (the
+  live-sync cushion was exactly such a knob for two rounds).
+- any ``jax.jit(`` / ``.lower(...)`` call in ``tools/`` or
+  ``bench.py`` must carry an inline ``# nocache:`` justification:
+  the warm-start engine (engine/artifact_cache.py) exists so tool
+  processes stop paying XLA compiles, and a tool that grows its own
+  jit/lower call outside the artifact-cache entry points silently
+  re-grows an uncached compile path.  Deliberate compilers (the
+  profiling tools, which MEASURE compiles) say so inline.
 
 Run: ``python tools/lint.py`` (exit code 1 on findings).
 """
@@ -118,6 +127,54 @@ def check_file(path):
     return findings
 
 
+def check_nocache(path):
+    """Uncached-compile discipline for ``tools/`` and ``bench.py``:
+    every ``jax.jit(`` call and every ``.lower(...)`` call WITH
+    arguments (jit lowering takes the example args; ``str.lower()``
+    takes none) must carry an inline ``# nocache:`` comment saying
+    why it bypasses the warm-start engine's cached entry points."""
+    findings = []
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []  # check_file already reports the syntax error
+    lines = source.splitlines()
+
+    def is_jit_name(func):
+        return ((isinstance(func, ast.Attribute) and func.attr == "jit")
+                or (isinstance(func, ast.Name) and func.id == "jit"))
+
+    def flag(lineno, what):
+        if "# nocache:" not in lines[lineno - 1]:
+            findings.append(
+                f"{path}:{lineno}: {what} without an inline "
+                f"'# nocache:' justification — tools warm-start "
+                f"through engine/artifact_cache.py; a deliberate "
+                f"uncached compile must say why")
+
+    for node in ast.walk(tree):
+        # bare decorator form (@jax.jit with no call parens) is an
+        # Attribute/Name, not a Call — the most common way to grow a
+        # compile path, so it must not slip past the rule
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit_name(dec):
+                    flag(dec.lineno, "@jit decorator")
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        is_lower = (isinstance(func, ast.Attribute)
+                    and func.attr == "lower"
+                    and len(node.args) + len(node.keywords) > 0)
+        if is_jit_name(func):
+            flag(node.lineno, "jit call")
+        elif is_lower:
+            flag(node.lineno, ".lower() call")
+    return findings
+
+
 def check_static_knobs(sweep_path):
     """Compile-group discipline for ``tools/sweep.py``: the
     ``STATIC_KNOBS`` tuple must exist, and every element's source
@@ -160,9 +217,13 @@ def main():
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     all_findings = []
     count = 0
+    tools_root = os.path.join(repo_root, "tools") + os.sep
     for path in iter_py_files(repo_root):
         count += 1
         all_findings.extend(check_file(path))
+        if (path.startswith(tools_root)
+                or os.path.basename(path) == "bench.py"):
+            all_findings.extend(check_nocache(path))
     all_findings.extend(check_static_knobs(
         os.path.join(repo_root, "tools", "sweep.py")))
     for finding in sorted(all_findings):

@@ -49,6 +49,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
+    WarmStart, enable_persistent_compilation_cache)
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
     SwarmConfig, make_scenario, random_neighbors, ring_offsets,
     run_groups_chunked, stable_ranks, staggered_joins,
@@ -118,7 +120,7 @@ def build_cell_scenario(config, neighbors, audience, *, uplink_bps,
 
 
 def run_cells_batched(config, neighbors, audience, cells, *, watch_s,
-                      chunk, record_every=0):
+                      chunk, record_every=0, warm_start=None):
     """All regime cells of one (topology, policy) compile group
     through the shared chunked/pipelined dispatch engine
     (``run_groups_chunked``); returns ``(metrics, resolved_chunk)``
@@ -127,7 +129,11 @@ def run_cells_batched(config, neighbors, audience, cells, *, watch_s,
     ``record_every > 0``, the on-device metrics timeline,
     ops/swarm_sim.py ``timeline_columns``) plus the chunk the engine
     actually used (autotuned when ``chunk`` is None), so the
-    artifact records the real scenarios-per-dispatch."""
+    artifact records the real scenarios-per-dispatch.
+    ``warm_start`` threads the persistent executable/row caches
+    through the dispatch — notably, cells a re-run (or a partially
+    overlapping grid) has already computed come back from the row
+    cache without touching the device."""
     n_steps = int(watch_s * 1000.0 / config.dt_ms)
     results, stats = run_groups_chunked(
         [(config, cells,
@@ -135,7 +141,7 @@ def run_cells_batched(config, neighbors, audience, cells, *, watch_s,
               config, neighbors, audience, uplink_bps=cell[2] * 1e6,
               pattern=cell[0], wave=cell[1], watch_s=watch_s))],
         n_steps, watch_s=watch_s, chunk=chunk,
-        record_every=record_every)
+        record_every=record_every, warm_start=warm_start)
     metrics = results[0]
     if record_every:
         rounded = [(round(off, 4), round(reb, 5), tl)
@@ -163,6 +169,12 @@ def main():
                          "ops/swarm_sim.py autotune_chunk)")
     ap.add_argument("--out", metavar="FILE",
                     help="write the A/B table as JSON")
+    ap.add_argument("--no-warm-start", action="store_true",
+                    help="disable the persistent warm-start caches "
+                         "(engine/artifact_cache.py)")
+    ap.add_argument("--no-row-cache", action="store_true",
+                    help="disable layer-2 row reuse only (the "
+                         "serialized-executable layer stays on)")
     ap.add_argument("--record-every", type=int, default=0, metavar="N",
                     help="emit an on-device metrics timeline sample "
                          "every N steps per regime cell (0 = off)")
@@ -180,6 +192,15 @@ def main():
 
     cells = [(pattern, wave, up) for pattern in PATTERNS
              for wave in WAVES for up in UPLINK_GRID_MBPS]
+
+    warm_start = None
+    if not args.no_warm_start:
+        # persistent warm start (engine/artifact_cache.py): the six
+        # (topology, policy) programs deserialize instead of
+        # compiling on a re-run, and unchanged regime cells come
+        # back from the row cache
+        warm_start = WarmStart(row_cache=not args.no_row_cache)
+        enable_persistent_compilation_cache(warm_start.cache_dir)
 
     t0 = time.perf_counter()
     tables = {}
@@ -212,7 +233,8 @@ def main():
             per_policy[policy], resolved = run_cells_batched(
                 config, neighbors, audience, cells,
                 watch_s=args.watch_s, chunk=args.chunk,
-                record_every=args.record_every)
+                record_every=args.record_every,
+                warm_start=warm_start)
             resolved_chunks[f"{topology}/{policy}"] = resolved
             if args.record_every:
                 # strip the timeline blocks back off the metric pairs
@@ -318,6 +340,11 @@ def main():
           f"{len(UPLINK_GRID_MBPS)} uplink points x "
           f"{len(POLICIES)} policies in {elapsed:.1f}s "
           f"(batched engine, chunk {chunk_label})", file=sys.stderr)
+    if warm_start is not None:
+        ws = warm_start.summary()
+        print(f"# warm start: executables {ws['executable']} rows "
+              f"{ws['row']} (cache {ws['cache_dir']})",
+              file=sys.stderr)
     if args.out:
         device = jax.devices()[0]
         with open(args.out, "w") as f:
@@ -333,6 +360,8 @@ def main():
                     "resolved_chunks": resolved_chunks,
                     "platform": device.platform,
                     "device_kind": getattr(device, "device_kind", "?"),
+                    "warm_start": (warm_start.summary()
+                                   if warm_start is not None else None),
                     "worst_default_margin": worst["margin"],
                     "worst_cell": worst["cell"],
                     "best_adaptive_vs_spread": best["margin"],

@@ -59,7 +59,10 @@ def main():
     def scanned(fn):
         def body(c, _):
             return fn(c), None
-        return jax.jit(lambda c: jax.lax.scan(body, c, None, length=T)[0])
+        # nocache: a microbenchmark compiles its candidate
+        # kernels by design — caching would time the cache
+        return jax.jit(  # nocache: see above
+            lambda c: jax.lax.scan(body, c, None, length=T)[0])
 
     # baseline: carry chain with trivial work, to subtract dispatch
     base = scanned(lambda c: c * 0.999 + 0.001)

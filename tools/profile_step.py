@@ -65,7 +65,11 @@ def main():
     def scanned(fn, n=T):
         def body(c, _):
             return fn(c), None
-        return jax.jit(lambda c: jax.lax.scan(body, c, None, length=n))
+        # nocache: the profiler times fresh compiles of step
+        # variants by design — warm-starting them would time
+        # the cache instead of the program
+        return jax.jit(  # nocache: see above
+            lambda c: jax.lax.scan(body, c, None, length=n))
 
     # 1. full simulator step
     timeit(f"full step x{T} (scan)",

@@ -36,6 +36,17 @@ Execution model (the batched engine, ``run_swarm_batch``):
    wall-clock against the old sequential per-point dispatch
    (``--sequential`` keeps that path alive as the parity reference).
 
+Since this round the engine also WARM-STARTS across processes
+(engine/artifact_cache.py): each compile group's batched program is
+AOT-compiled once and the serialized executable cached on disk
+(``~/.cache/hlsjs_p2p_wrapper_tpu/``, override
+``HLSJS_P2P_TPU_CACHE_DIR``), and finished grid rows are cached
+content-addressed — so a second ``tools/sweep.py`` process performs
+ZERO XLA compiles and recomputes nothing for unchanged points
+(gated by ``make warmstart-gate``).  ``--no-row-cache`` forces
+recompute (executables still warm); ``--no-warm-start`` disables
+both layers.
+
 On a multi-chip platform the chunk additionally shards across chips
 over the ``scenarios`` mesh axis (``parallel/mesh.py``): scenarios
 are embarrassingly parallel, so the sharded grid adds ZERO
@@ -88,6 +99,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
+    WarmStart, enable_persistent_compilation_cache)
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
     UNREACHABLE_BITRATE, SwarmConfig, init_swarm, make_scenario,
     offload_ratio, rebuffer_ratio, ring_offsets, run_groups_chunked,
@@ -256,7 +269,8 @@ def group_grid(grid, static_live_sync=False):
 def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
                      chunk=None, stagger_s=60.0,
                      record_every=0, tracer=None, pipeline=True,
-                     static_live_sync=False, interleave=True):
+                     static_live_sync=False, interleave=True,
+                     warm_start=None, raw=False):
     """The batched engine: one ``run_swarm_batch`` dispatch per
     padded chunk per compile group, host readback pipelined one chunk
     behind the device, chunks round-robined across groups when more
@@ -271,7 +285,14 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
     ``tracer``/``pipeline`` pass through to the dispatch engine
     (bench.py's overlap metric); ``static_live_sync=True`` +
     ``interleave=False`` reproduce the legacy group-per-cushion
-    sequential-drain behavior as the benchmark reference."""
+    sequential-drain behavior as the benchmark reference.
+    ``warm_start`` (engine/artifact_cache.py ``WarmStart``) threads
+    the persistent executable/row caches through the dispatch — a
+    fully row-cached group dispatches nothing, so its
+    ``first_dispatch_s`` is None and ``info`` carries per-group
+    ``row_hits``.  ``raw=True`` keeps full-precision metric floats
+    in the rows (the warm-start gate's bit-exactness surface)
+    instead of the table-rounded decimals."""
     if not grid:
         return [], {"compile_groups": 0, "chunk": None,
                     "chunk_autotuned": chunk is None, "groups": []}
@@ -291,27 +312,36 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
     results, stats = run_groups_chunked(
         group_list, n_steps, watch_s=watch_s, chunk=chunk,
         record_every=record_every, tracer=tracer, pipeline=pipeline,
-        interleave=interleave)
+        interleave=interleave, warm_start=warm_start)
 
     rows = [None] * len(grid)
     for (key, idxs), metrics in zip(group_keys, results):
         for i, metric in zip(idxs, metrics):
             if record_every:
                 off, reb, tl = metric
-                rows[i] = {**grid[i], "offload": round(off, 4),
-                           "rebuffer": round(reb, 5), "_timeline": tl}
             else:
                 off, reb = metric
-                rows[i] = {**grid[i], "offload": round(off, 4),
-                           "rebuffer": round(reb, 5)}
+                tl = None
+            row = {**grid[i],
+                   "offload": off if raw else round(off, 4),
+                   "rebuffer": reb if raw else round(reb, 5)}
+            if record_every:
+                row["_timeline"] = tl
+            rows[i] = row
     info = {
         "compile_groups": len(group_list),
         "chunk": max(st["chunk"] for st in stats),
         "chunk_autotuned": chunk is None,
+        "row_hits": sum(st["row_hits"] for st in stats),
         "groups": [{"key": list(key), "points": len(idxs),
                     "chunk": st["chunk"], "chunks": st["chunks"],
-                    "first_dispatch_s": round(st["first_dispatch_s"],
-                                              3)}
+                    "row_hits": st["row_hits"],
+                    # None when every point came from the row cache —
+                    # a fully-warm group never dispatches
+                    "first_dispatch_s": (
+                        round(st["first_dispatch_s"], 3)
+                        if st["first_dispatch_s"] is not None
+                        else None)}
                    for (key, idxs), st in zip(group_keys, stats)],
     }
     return rows, info
@@ -361,6 +391,15 @@ def main():
     ap.add_argument("--sequential", action="store_true",
                     help="per-point dispatch (the pre-batching "
                          "reference path)")
+    ap.add_argument("--no-warm-start", action="store_true",
+                    help="disable the persistent warm-start caches "
+                         "entirely (fresh XLA compiles + full "
+                         "recompute; engine/artifact_cache.py)")
+    ap.add_argument("--no-row-cache", action="store_true",
+                    help="disable layer-2 row reuse only: grid "
+                         "points recompute even when an identical "
+                         "finished row is cached (the serialized-"
+                         "executable layer stays on)")
     ap.add_argument("--record-every", type=int, default=0, metavar="N",
                     help="emit an on-device metrics timeline sample "
                          "every N steps per grid point (0 = off; "
@@ -387,13 +426,30 @@ def main():
 
     grid = live_grid() if args.live else vod_grid()
     engine = run_grid_sequential if args.sequential else run_grid_batched
+    warm_start = None
+    if not (args.no_warm_start or args.sequential):
+        # warm-start engine: serialized executables + row reuse
+        # across processes, plus JAX's own persistent compilation
+        # cache for the host-side scalar programs layer 1 does not
+        # cover (engine/artifact_cache.py)
+        warm_start = WarmStart(row_cache=not args.no_row_cache)
+        enable_persistent_compilation_cache(warm_start.cache_dir)
     t0 = time.perf_counter()
     rows, info = engine(
         grid, peers=args.peers, segments=args.segments,
         watch_s=args.watch_s, live=args.live, seed=args.seed,
-        chunk=args.chunk, record_every=args.record_every)
+        chunk=args.chunk, record_every=args.record_every,
+        warm_start=warm_start)
     elapsed = time.perf_counter() - t0
-    n_compiles = info["compile_groups"]
+    # with the warm-start engine active, the honest compile count is
+    # the number of FRESH program compiles it performed (cache misses
+    # + fallbacks), not the structural compile-group count
+    if warm_start is not None:
+        events = warm_start.event_counts("executable")
+        n_compiles = sum(events.get(k, 0)
+                         for k in ("miss", "corrupt", "skew"))
+    else:
+        n_compiles = info["compile_groups"]
 
     # the timeline blocks ride the rows out of the engine but never
     # enter the frontier table / sweep artifact — pop them first
@@ -450,6 +506,12 @@ def main():
                f"{n_compiles} XLA compile{'s' if n_compiles != 1 else ''}"
                f"{chunk_note})")
     print(f"# {summary}", file=sys.stderr)
+    if warm_start is not None:
+        ws = warm_start.summary()
+        print(f"# warm start: executables {ws['executable']} rows "
+              f"{ws['row']} (cache {ws['cache_dir']}; "
+              f"--no-row-cache / --no-warm-start opt out)",
+              file=sys.stderr)
     if args.out:
         device = jax.devices()[0]
         with open(args.out, "w") as f:
@@ -467,6 +529,8 @@ def main():
                     "record_every": args.record_every or None,
                     "platform": device.platform,
                     "device_kind": getattr(device, "device_kind", "?"),
+                    "warm_start": (warm_start.summary()
+                                   if warm_start is not None else None),
                 },
                 "rows": rows,
             }, f, indent=1)

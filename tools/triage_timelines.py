@@ -17,6 +17,16 @@ pathologies the on-device metrics timelines were built to expose
   offload is below ``--stall-offload`` AND the gain over the last
   half of the window is below ``--stall-gain`` (a point that ends
   low but is still climbing is a short window, not a stall).
+- **Rebuffer burst vs join wave** (ROADMAP residual): a sample
+  window where a significant fraction of the present audience
+  stalled (``stalled_peers`` ≥ ``--burst-frac`` of present peers) —
+  flagged ONLY when the window is not coincident with a join wave
+  (present-peer count jumping by ≥ ``--wave-frac`` of the audience
+  in the same window).  Joiners starting ``live_sync_s`` behind the
+  edge legitimately stall while their first segments land; a burst
+  with NO arrivals behind it is the swarm itself failing (uplink
+  collapse, CDN rescue arriving late), which is the pathology worth
+  a work-list line.
 
 Prints one triaged line per flagged grid point (knobs + reasons +
 the numbers behind them) and a summary; ``--strict`` exits nonzero
@@ -84,6 +94,51 @@ def detect_offload_stall(columns, samples, *, stall_offload=0.2,
     return None
 
 
+def detect_rebuffer_burst(columns, samples, *, burst_frac=0.25,
+                          wave_frac=0.1):
+    """Rebuffer-burst finding dict, or None.
+
+    A burst window has ``stalled_peers`` at or above ``burst_frac``
+    of the present audience; it only counts when the SAME window is
+    not a join wave (present count grew by < ``wave_frac`` of the
+    audience) — arrival-driven stalls are the cushion filling, not a
+    delivery failure.  Reports the un-waved burst windows, the first
+    burst's sample clock, and the worst stalled fraction."""
+    t_col = columns.index("t_s")
+    stall_col = columns.index("stalled_peers")
+    level_cols = [i for i, c in enumerate(columns)
+                  if c.startswith("level_") and c.endswith("_peers")]
+    bursts = 0
+    waved = 0
+    first_t = None
+    worst_frac = 0.0
+    prev_present = None
+    for sample in samples:
+        present = sum(sample[i] for i in level_cols)
+        if present <= 0:
+            prev_present = present
+            continue
+        stalled_frac = sample[stall_col] / present
+        grew = (present - prev_present
+                if prev_present is not None else present)
+        is_wave = grew >= wave_frac * present
+        if stalled_frac >= burst_frac:
+            if is_wave:
+                waved += 1
+            else:
+                bursts += 1
+                worst_frac = max(worst_frac, stalled_frac)
+                if first_t is None:
+                    first_t = sample[t_col]
+        prev_present = present
+    if bursts:
+        return {"reason": "rebuffer_burst", "bursts": bursts,
+                "join_wave_coincident": waved,
+                "first_t_s": round(first_t, 3),
+                "max_stalled_frac": round(worst_frac, 4)}
+    return None
+
+
 def knob_label(record):
     """Compact ``k=v`` knob summary for one record's triage line."""
     return " ".join(f"{k}={v}" for k, v in record.items()
@@ -91,7 +146,8 @@ def knob_label(record):
 
 
 def triage_records(records, *, min_flips=4, osc_frac=0.25,
-                   stall_offload=0.2, stall_gain=0.02):
+                   stall_offload=0.2, stall_gain=0.02,
+                   burst_frac=0.25, wave_frac=0.1):
     """Findings list: ``{"point", "knobs", "findings": [...]}`` per
     flagged record, in file order."""
     triaged = []
@@ -104,6 +160,9 @@ def triage_records(records, *, min_flips=4, osc_frac=0.25,
             detect_offload_stall(columns, samples,
                                  stall_offload=stall_offload,
                                  stall_gain=stall_gain),
+            detect_rebuffer_burst(columns, samples,
+                                  burst_frac=burst_frac,
+                                  wave_frac=wave_frac),
         ) if f is not None]
         if findings:
             triaged.append({"point": idx, "knobs": knob_label(record),
@@ -115,6 +174,12 @@ def _describe(finding):
     if finding["reason"] == "ladder_oscillation":
         return (f"ladder_oscillation ({finding['flips']} flips / "
                 f"{finding['transitions']} transitions)")
+    if finding["reason"] == "rebuffer_burst":
+        return (f"rebuffer_burst ({finding['bursts']} windows, worst "
+                f"{finding['max_stalled_frac']:.0%} stalled, first at "
+                f"t={finding['first_t_s']}s; "
+                f"{finding['join_wave_coincident']} join-wave windows "
+                f"excused)")
     return (f"offload_stall (final {finding['final_offload']}, "
             f"last-half gain {finding['last_half_gain']})")
 
@@ -140,13 +205,22 @@ def main(argv=None):
     ap.add_argument("--stall-gain", type=float, default=0.02,
                     help="last-half offload gain below this means "
                          "the ramp stopped (default 0.02)")
+    ap.add_argument("--burst-frac", type=float, default=0.25,
+                    help="stalled share of present peers that makes "
+                         "a sample window a rebuffer burst "
+                         "(default 0.25)")
+    ap.add_argument("--wave-frac", type=float, default=0.1,
+                    help="present-peer growth share that makes the "
+                         "same window a join wave, excusing its "
+                         "burst (default 0.1)")
     args = ap.parse_args(argv)
 
     with open(args.timelines, encoding="utf-8") as f:
         records = [json.loads(line) for line in f if line.strip()]
     triaged = triage_records(
         records, min_flips=args.min_flips, osc_frac=args.osc_frac,
-        stall_offload=args.stall_offload, stall_gain=args.stall_gain)
+        stall_offload=args.stall_offload, stall_gain=args.stall_gain,
+        burst_frac=args.burst_frac, wave_frac=args.wave_frac)
 
     if args.json:
         for entry in triaged:
@@ -159,7 +233,8 @@ def main(argv=None):
     reasons = [f["reason"] for e in triaged for f in e["findings"]]
     print(f"# triaged {len(records)} timelines: {len(triaged)} "
           f"flagged ({reasons.count('ladder_oscillation')} "
-          f"oscillating, {reasons.count('offload_stall')} stalled)",
+          f"oscillating, {reasons.count('offload_stall')} stalled, "
+          f"{reasons.count('rebuffer_burst')} bursting)",
           file=sys.stderr)
     return 1 if (args.strict and triaged) else 0
 

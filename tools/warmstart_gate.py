@@ -1,0 +1,183 @@
+"""Process-level warm-start gate: the second run compiles NOTHING.
+
+The warm-start engine's whole claim (engine/artifact_cache.py) is
+process-level: a SECOND invocation of the sweep tools performs zero
+XLA compiles (serialized executables + JAX's persistent compilation
+cache for the host-side scalar programs) and recomputes nothing for
+unchanged grid points (content-addressed row reuse) — bit-exactly.
+In-process tests cannot prove that (the in-process jit cache would
+mask a broken disk path), so this gate runs both SHIPPED grids
+(48-pt VOD, 144-pt live; tools/sweep.py) as separate child
+PROCESSES against one throwaway cache directory:
+
+1. **cold** — populates both layers; compiles expected,
+2. **warm, row cache off** — every grid point recomputes through
+   the DESERIALIZED executables: must perform 0 XLA compiles
+   (``CompileCounter``: backend-compile events minus
+   persistent-compilation-cache hits) and reproduce run 1's rows
+   bit-exactly (compared as ``float.hex`` of the FULL-precision
+   metrics, not table-rounded decimals),
+3. **warm, row cache on** — the real second-run path: 0 compiles,
+   0 dispatches (every point a layer-2 hit), same rows bit-exactly.
+
+The children run the REAL tool engine (``sweep.run_grid_batched``)
+at gate-sized swarms — grid identity (point count, knob axes,
+compile-group structure) is what the cache keys on, and peer count
+is an env knob (``WARMSTART_GATE_PEERS`` etc.) for accelerator
+hosts that want the gate at artifact size.  The chunk is PINNED:
+the autotuner reads live device memory, and a chunk that drifted
+between processes would change the program shape — an honest cache
+miss, but not what this gate measures.
+
+Run: ``python tools/warmstart_gate.py`` (exit 1 on any violation);
+``make warmstart-gate`` wires it into ``make check``.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def child(args):
+    """One gate run inside a fresh interpreter: attach the compile
+    probe and the persistent caches BEFORE any jax computation, run
+    one shipped grid, report compiles + full-precision rows."""
+    # probe first: a compile the listener misses is a compile the
+    # gate cannot veto
+    from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (
+        CompileCounter, WarmStart, enable_persistent_compilation_cache)
+    probe = CompileCounter().attach()
+    enable_persistent_compilation_cache(args.cache_dir)
+    ws = WarmStart(cache_dir=args.cache_dir,
+                   row_cache=not args.no_row_cache)
+
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import sweep as sweep_tool
+    grid = (sweep_tool.live_grid() if args.grid == "live"
+            else sweep_tool.vod_grid())
+    rows, info = sweep_tool.run_grid_batched(
+        grid, peers=args.peers, segments=args.segments,
+        watch_s=args.watch_s, live=args.grid == "live", seed=0,
+        chunk=args.chunk, warm_start=ws, raw=True)
+    print(json.dumps({
+        "grid": args.grid,
+        "points": len(rows),
+        "compiles": probe.compiles,
+        "backend_compile_events": probe.backend_compiles,
+        "compilation_cache_hits": probe.cache_hits,
+        "row_hits": info["row_hits"],
+        "warm_start": ws.summary(),
+        # float.hex round-trips exactly: bit-exactness is compared
+        # on the full-precision metrics, not the table rounding
+        "rows": [[row["offload"].hex(), row["rebuffer"].hex()]
+                 for row in rows],
+    }))
+    return 0
+
+
+def run_child(grid, cache_dir, sizes, *, no_row_cache):
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--grid", grid, "--cache-dir", cache_dir,
+           "--peers", str(sizes["peers"]),
+           "--segments", str(sizes["segments"]),
+           "--watch-s", str(sizes["watch_s"]),
+           "--chunk", str(sizes["chunk"])]
+    if no_row_cache:
+        cmd.append("--no-row-cache")
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=_REPO)
+    if proc.returncode != 0:
+        raise SystemExit(f"gate child failed ({grid}):\n"
+                         f"{proc.stdout}\n{proc.stderr}")
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
+def gate_grid(grid, cache_dir, sizes):
+    """Three child processes for one shipped grid; returns the
+    violation list (empty = pass)."""
+    cold = run_child(grid, cache_dir, sizes, no_row_cache=False)
+    warm = run_child(grid, cache_dir, sizes, no_row_cache=True)
+    rows_on = run_child(grid, cache_dir, sizes, no_row_cache=False)
+
+    problems = []
+    if warm["compiles"] != 0:
+        problems.append(
+            f"{grid}: warm (no-row-cache) run performed "
+            f"{warm['compiles']} XLA compiles "
+            f"({warm['backend_compile_events']} requests, "
+            f"{warm['compilation_cache_hits']} cache hits) — "
+            f"expected 0")
+    if warm["rows"] != cold["rows"]:
+        diverged = sum(1 for a, b in zip(warm["rows"], cold["rows"])
+                       if a != b)
+        problems.append(f"{grid}: warm executable rows diverged from "
+                        f"cold rows at {diverged}/{len(cold['rows'])} "
+                        f"points — the cache must be bit-exact")
+    if rows_on["compiles"] != 0:
+        problems.append(f"{grid}: row-cache run performed "
+                        f"{rows_on['compiles']} XLA compiles — "
+                        f"expected 0")
+    if rows_on["row_hits"] != cold["points"]:
+        problems.append(f"{grid}: row-cache run reused "
+                        f"{rows_on['row_hits']}/{cold['points']} "
+                        f"rows — expected all")
+    if rows_on["rows"] != cold["rows"]:
+        problems.append(f"{grid}: row-cache rows diverged from cold "
+                        f"rows")
+    label = "ok" if not problems else "FAIL"
+    print(f"warmstart-gate {grid}: cold compiled "
+          f"{cold['compiles']}, warm exec run compiled "
+          f"{warm['compiles']}, row run reused "
+          f"{rows_on['row_hits']}/{cold['points']} rows -> {label}")
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--grid", choices=("vod", "live"), default="vod")
+    ap.add_argument("--cache-dir")
+    ap.add_argument("--no-row-cache", action="store_true")
+    ap.add_argument("--peers", type=int,
+                    default=int(os.environ.get("WARMSTART_GATE_PEERS",
+                                               64)))
+    ap.add_argument("--segments", type=int, default=int(
+        os.environ.get("WARMSTART_GATE_SEGMENTS", 16)))
+    ap.add_argument("--watch-s", type=float, default=float(
+        os.environ.get("WARMSTART_GATE_WATCH_S", 10.0)))
+    ap.add_argument("--chunk", type=int, default=int(
+        os.environ.get("WARMSTART_GATE_CHUNK", 24)))
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return child(args)
+
+    sizes = {"peers": args.peers, "segments": args.segments,
+             "watch_s": args.watch_s, "chunk": args.chunk}
+    cache_dir = args.cache_dir or tempfile.mkdtemp(
+        prefix="warmstart-gate-")
+    problems = []
+    try:
+        for grid in ("vod", "live"):
+            problems.extend(gate_grid(grid, cache_dir, sizes))
+    finally:
+        if args.cache_dir is None:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+    for problem in problems:
+        print(f"warmstart-gate: {problem}", file=sys.stderr)
+    print(f"# warmstart-gate: {'PASS' if not problems else 'FAIL'} "
+          f"(both shipped grids, 3 processes each, "
+          f"{sizes['peers']} peers)", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
