@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
-	coverage soak scaling-artifact warmstart-gate
+	coverage soak scaling-artifact warmstart-gate chaos-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -65,6 +65,18 @@ scaling-artifact:
 warmstart-gate:
 	$(PY) tools/warmstart_gate.py
 
+# process-level fault-tolerance proof (engine/faults.py): the VOD
+# grid under injected OOM (chunk bisection at the canonical shape —
+# zero extra compiles), a transient/timeout burst (bounded jittered
+# retry), and a mid-run SIGKILL followed by a journal-replayed
+# resume — recovered/resumed rows must be bit-identical (float.hex)
+# to a fault-free reference and every recovery counted in the
+# dispatch_faults registry.  The chunk is PINNED and the swarm
+# gate-sized so the gate stays fast on CPU CI; CHAOS_GATE_PEERS
+# etc. scale it up on accelerator hosts.
+chaos-gate:
+	$(PY) tools/chaos_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -73,6 +85,6 @@ examples:
 	$(PY) examples/swarm_demo.py --live
 	$(PY) examples/production_demo.py
 
-check: lint test dryrun warmstart-gate
+check: lint test dryrun warmstart-gate chaos-gate
 
 all: check bench

@@ -315,20 +315,185 @@ def row_key(config, scenario, join, n_steps: int, *, watch_s: float,
     })
 
 
-def _atomic_write(path: str, data: bytes) -> None:
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+def atomic_write_bytes(path: str, data: bytes, *,
+                       durable: bool = True) -> None:
+    """Crash-safe file write: temp file in the target directory,
+    ``fsync``, then ``os.replace``.  A reader — or a crash at ANY
+    point — sees either the complete old content or the complete new
+    content, never a truncated artifact.  Every artifact the tools
+    emit (sweep/policy_ab/bench JSON, timeline JSONL, cache bodies)
+    goes through here.
+
+    ``durable=False`` skips the fsync (the rename is still atomic):
+    for CORRUPTION-TOLERANT consumers — the cache bodies, whose
+    readers detect a torn file and degrade to a counted recompute —
+    where per-write fsyncs on the hot drain path buy nothing.
+    User-facing artifacts and the journal keep the default."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
                                prefix=".tmp-")
     try:
         with os.fdopen(fd, "wb") as fh:
             fh.write(data)
+            fh.flush()
+            if durable:
+                # the rename below is only atomic-DURABLE if the
+                # data is on disk first: replace-before-flush can
+                # surface as an empty file after a power cut
+                os.fsync(fh.fileno())
         os.replace(tmp, path)
     except BaseException:
         try:
             os.unlink(tmp)
         except OSError:
-            pass
+            pass  # fault-ok: best-effort temp cleanup on the re-raise path
         raise
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def atomic_write_json(path: str, obj, *, indent: Optional[int] = 1
+                      ) -> None:
+    atomic_write_text(path, json.dumps(obj, indent=indent) + "\n")
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """Cache-body write: atomic rename, NO fsync — both cache
+    layers detect torn bodies (sha256 / npz parse) and fall back to
+    a counted recompute, so durability would only tax the drain
+    path."""
+    atomic_write_bytes(path, data, durable=False)
+
+
+# -- the crash-safe sweep journal --------------------------------------
+
+def journal_path(cache_dir: str, meta: dict) -> str:
+    """Journal location for one sweep identity: co-located with the
+    row cache (``journals/`` under the warm-start root) and
+    content-addressed by the sweep's meta — two different sweeps can
+    never clobber each other's progress."""
+    return os.path.join(cache_dir, "journals",
+                        _digest({"kind": "sweep-journal", **meta})
+                        + ".jsonl")
+
+
+class SweepJournal:
+    """Crash-safe sweep progress: one JSON line per completed row,
+    appended + flushed + fsync'd chunk-by-chunk as the dispatch
+    engine drains (one fsync per drained chunk, not per row — a
+    mid-drain crash loses at most that chunk, which recomputes), so
+    a SIGKILL'd sweep knows exactly what it finished.
+
+    The journal records row-cache KEYS, not values: the layer-2 row
+    cache already stores every finished row full-precision, so
+    ``--resume`` replays the journal AGAINST the row cache — the
+    journal says "these rows completed", the cache serves their
+    bit-exact values, and the resumed run dispatches only the rest.
+    (A journaled key evicted from the cache degrades to a recompute,
+    never a wrong answer.)
+
+    Line kinds: one ``meta`` header (the sweep-identity digest —
+    ``resume=True`` refuses a journal whose digest does not match the
+    requested sweep), ``row`` per completed row, and a final ``done``
+    marker written by :meth:`finalize` AFTER the artifact is in place
+    (the artifact write itself is atomic via
+    :func:`atomic_write_bytes`).  Reading tolerates a torn trailing
+    line — the one artifact a mid-append SIGKILL can leave."""
+
+    def __init__(self, path: str, meta: dict, *, resume: bool = False):
+        self.path = path
+        self.digest = _digest({"kind": "sweep-journal", **meta})
+        self.completed: set = set()
+        self.finished = False
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if resume and os.path.exists(path):
+            for record in self._read():
+                kind = record.get("kind")
+                if kind == "meta":
+                    if record.get("digest") != self.digest:
+                        raise ValueError(
+                            f"journal {path} was written by a "
+                            f"different sweep configuration — not "
+                            f"resuming against it")
+                elif kind == "row":
+                    self.completed.add(record["key"])
+                elif kind == "done":
+                    self.finished = True
+            self._fh = open(path, "a", encoding="utf-8")
+            with open(path, "rb") as raw:
+                raw.seek(0, os.SEEK_END)
+                size = raw.tell()
+                torn = False
+                if size:
+                    raw.seek(size - 1)
+                    torn = raw.read(1) != b"\n"
+            if torn:
+                # start appends on a fresh line, or the first new
+                # record would concatenate into the torn fragment
+                # and BOTH would be lost to the next reader
+                self._fh.write("\n")
+                self._fh.flush()
+        else:
+            self._fh = open(path, "w", encoding="utf-8")
+            self._append({"kind": "meta", "digest": self.digest})
+
+    def _read(self):
+        with open(self.path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except ValueError:
+                    # torn tail from a crash mid-append: every
+                    # earlier line was fsync'd whole, so skipping the
+                    # fragment loses at most the row that was being
+                    # recorded when the process died — it recomputes
+                    continue
+
+    def _append(self, *records: dict) -> None:
+        self._fh.write("".join(json.dumps(record) + "\n"
+                               for record in records))
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def record_row(self, key: str) -> None:
+        """One completed row (its layer-2 cache key), durable before
+        the engine moves on."""
+        self.record_rows([key])
+
+    def record_rows(self, keys) -> None:
+        """A batch of completed rows under ONE flush + fsync — the
+        dispatch engine journals a whole drained chunk at once, so
+        the durability cost is per-chunk, not per-row (a mid-drain
+        crash loses at most that chunk, which recomputes on
+        ``--resume``)."""
+        fresh = [key for key in keys if key not in self.completed]
+        if not fresh:
+            return
+        self.completed.update(fresh)
+        self._append(*({"kind": "row", "key": key} for key in fresh))
+
+    def finalize(self) -> None:
+        """Mark the sweep complete — call AFTER the artifact write
+        succeeded, and only when no rows failed (a partial run stays
+        resumable)."""
+        if not self.finished:
+            self._append({"kind": "done"})
+            self.finished = True
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            self._fh.close()
+
+    def __enter__(self) -> "SweepJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class WarmStart:
@@ -419,8 +584,9 @@ class WarmStart:
             payload, in_tree, out_tree = pickle.loads(body)
             return serialize_executable.deserialize_and_load(
                 payload, in_tree, out_tree)
-        except Exception:  # noqa: BLE001 — any parse/load failure is
-            # a corrupt artifact; the contract is fall back, repopulate
+        except Exception:  # fault-ok: returned as "corrupt"; the caller
+            # counts it in aot_cache_events and falls back to a fresh
+            # compile — the contract is fall back + repopulate
             return "corrupt"
 
     def _store_executable(self, path: str, compiled) -> None:

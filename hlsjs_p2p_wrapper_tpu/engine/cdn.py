@@ -72,7 +72,7 @@ class HttpCdnTransport:
             except urllib.error.HTTPError as e:
                 if not handle.aborted.is_set():
                     callbacks["on_error"]({"status": e.code})
-            except Exception:  # noqa: BLE001 — network failure → HTTP-shaped 0
+            except Exception:  # fault-ok: surfaced to the caller as an HTTP-shaped status-0 error
                 if not handle.aborted.is_set():
                     callbacks["on_error"]({"status": 0})
 

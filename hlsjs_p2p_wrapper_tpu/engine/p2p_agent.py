@@ -470,14 +470,14 @@ class P2PAgent:
             return
         try:
             window_s = self.player_bridge.get_buffer_level_max()
-        except Exception:  # noqa: BLE001 — player not ready yet
+        except Exception:  # fault-ok: player not ready yet — absence is the signal
             return
         playhead = (self.media_element.current_time
                     if self.media_element is not None else 0.0)
         try:
             segments = self.media_map.get_segment_list(
                 self._current_track, playhead, window_s)
-        except Exception:  # noqa: BLE001 — level vanished mid-switch
+        except Exception:  # fault-ok: level vanished mid-switch; skip this tick
             return
         rotate = self.p2p_config.get("prefetch_rotation", True)
         for segment in segments:
@@ -543,7 +543,7 @@ class P2PAgent:
         if self._is_live is None:
             try:
                 self._is_live = bool(self.player_bridge.is_live())
-            except Exception:  # noqa: BLE001 — manifest not parsed yet
+            except Exception:  # fault-ok: manifest not parsed yet — retry next call
                 return False
         return self._is_live
 

@@ -1513,7 +1513,7 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
                        chunk: Optional[int] = None,
                        record_every: int = 0, tracer=None,
                        pipeline: bool = True, interleave: bool = True,
-                       warm_start=None):
+                       warm_start=None, faults=None, journal=None):
     """Chunked, pipelined dispatch over MULTIPLE compile groups — the
     engine under :func:`run_batch_chunked` (one group) and
     ``tools/sweep.py`` (one group per remaining static knob value).
@@ -1576,7 +1576,40 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
       on-disk executable when present (zero XLA compiles), a fresh
       AOT compile (persisted back) otherwise; same program, same
       donation signature, bit-exact either way
-      (tests/test_artifact_cache.py)."""
+      (tests/test_artifact_cache.py).
+
+    ``faults`` (an ``engine.faults.FaultPolicy``, duck-typed like
+    ``tracer``/``warm_start``) arms per-chunk RECOVERY — without it
+    any dispatch error propagates exactly as before:
+
+    - transient runtime errors / dispatch timeouts retry with
+      jittered exponential backoff up to the policy's budget;
+    - ``RESOURCE_EXHAUSTED`` BISECTS the chunk — each half
+      re-dispatched padded back to the canonical ``batch`` shape (the
+      tail chunks already pad this way), so recovery performs ZERO
+      new XLA compiles and never re-keys the layer-1 AOT cache; a
+      single lane that cannot bisect further retries under the same
+      backoff budget (lone-lane OOMs are usually transient pressure)
+      before its structured give-up;
+    - a (sub-)chunk that exhausts its budget becomes a STRUCTURED
+      partial failure — its item indices + reason + last error
+      appended to ``stats[g]["failures"]``, its ``results`` slots
+      left ``None`` — never an unhandled exception;
+    - every retry / bisection / give-up is counted in the policy's
+      ``dispatch_faults{reason,action}`` registry counters, and the
+      policy's ``FaultPlan`` injection hook fires at the top of every
+      dispatch attempt (the chaos gate's fault plane);
+    - a classified fault surfacing at READBACK (asynchronous
+      dispatch errors materialize late) re-dispatches that segment
+      through the same recovery path, blocking.
+
+    ``journal`` (an ``engine.artifact_cache.SweepJournal``) makes the
+    run CRASH-SAFE: each completed row's layer-2 cache key is
+    appended + fsync'd as the row drains, so a SIGKILL'd sweep can
+    ``--resume`` by replaying the journal against the row cache with
+    zero recompute of completed rows.  Requires ``warm_start`` with
+    the row cache enabled (the journal records keys, the cache holds
+    the values)."""
     rows_on = warm_start is not None and warm_start.rows_enabled
     aot_on = warm_start is not None and warm_start.aot_enabled
     groups = [(config, list(items), build)
@@ -1625,7 +1658,7 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
         prepared.append((config, items, build, batch, keep, keys))
     stats = [{"items": len(items), "chunk": batch, "chunks": 0,
               "row_hits": len(items) - len(keep),
-              "first_dispatch_s": None}
+              "first_dispatch_s": None, "failures": []}
              for _, items, _, batch, keep, _ in prepared]
 
     starts = [list(range(0, len(keep), batch))
@@ -1642,24 +1675,167 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
         for gi, s in enumerate(starts):
             schedule.extend((gi, ci, off) for ci, off in enumerate(s))
 
-    pending = None  # (gi, ci, kept indices, row keys, offs, rebs, rows)
+    def _classify(exc):
+        return faults.classify(exc) if faults is not None else None
+
+    def _dispatch_built(gi, ci, config, built, batch, block):
+        """One padded dispatch attempt of ``len(built)`` real lanes:
+        repeat-pad to the canonical ``batch`` shape, stack, run.
+        Retries and bisected halves re-enter here, so every attempt
+        dispatches the IDENTICAL program shape — recovery can never
+        trigger a compile."""
+        if faults is not None:
+            faults.before_dispatch(group=gi, chunk=ci)
+        padded = built + [built[-1]] * (batch - len(built))
+        scenarios = stack_pytrees([sc for sc, _ in padded])
+        joins = jnp.stack([j for _, j in padded])
+        states = stack_pytrees([init_swarm(config)] * batch)
+        if aot_on:
+            states = ensure_penalty_width_batch(config, scenarios,
+                                                states)
+            runner = warm_start.batch_runner(
+                config, scenarios, states, n_steps,
+                record_every=record_every, donate_scenarios=True)
+            res = runner(scenarios, states)
+        else:
+            res = run_swarm_batch(config, scenarios, states, n_steps,
+                                  record_every=record_every,
+                                  donate_scenarios=True)
+        finals = res[0]
+        rows = res[2] if record_every else None
+        offs = offload_ratio_batch(finals)
+        rebs = rebuffer_ratio_batch(finals, watch_s, joins)
+        if block:
+            # the drain-per-chunk mode is the overlap-measurement
+            # BASELINE: dispatch is async, so without this wait the
+            # readback span would absorb the device-compute time and
+            # deflate the overlap metric's denominator contract
+            # ("blocking readback hidden under compute").  Recovery
+            # re-dispatches also block: a classified fault must
+            # surface HERE, inside the retry loop, not at readback.
+            for arr in (offs, rebs) + (() if rows is None
+                                       else (rows,)):
+                arr.block_until_ready()
+        return offs, rebs, rows
+
+    def _dispatch_resilient(gi, ci, config, built, batch, start,
+                            block):
+        """Dispatch ``built`` (``start``-offset within the chunk's
+        kept list) under the fault policy's bounded recovery.
+
+        Returns ``(segments, failures)``: ``segments`` is a list of
+        ``(start, n, offs, rebs, rows)`` device-array pieces covering
+        the lanes that dispatched (still async unless ``block``), and
+        ``failures`` lists ``{"offset", "count", "reason", "error"}``
+        for lanes whose recovery budget ran out.  Without a policy
+        the first exception propagates — exactly the pre-fault-plane
+        behavior."""
+        attempt = 0
+        while True:
+            try:
+                out = _dispatch_built(gi, ci, config, built, batch,
+                                      block)
+                return [(start, len(built)) + out], []
+            except Exception as exc:  # fault-ok: classified below —
+                # unrecognized reasons (shape errors, typos) re-raise
+                reason = _classify(exc)
+                if reason is None:
+                    raise
+                if reason == "oom" and len(built) > 1:
+                    # bisect: each half re-dispatches PADDED BACK to
+                    # the canonical chunk shape — zero new XLA
+                    # compiles, no AOT-cache re-keying — and recurses
+                    # down to single lanes.  NOTE the shape (and so
+                    # the allocation) is unchanged: bisection
+                    # NARROWS the blast radius of a persistent OOM
+                    # to per-lane structured failures rather than
+                    # relieving memory — transient pressure is
+                    # handled by the backoff-retry below, and a
+                    # repeatedly-OOMing autotune is a ROADMAP residue
+                    # (feed dispatch_faults{reason=oom} back into
+                    # autotune_chunk's memory fraction)
+                    faults.record(reason, "bisect")
+                    mid = (len(built) + 1) // 2
+                    left = _dispatch_resilient(
+                        gi, ci, config, built[:mid], batch, start,
+                        block)
+                    right = _dispatch_resilient(
+                        gi, ci, config, built[mid:], batch,
+                        start + mid, block)
+                    return left[0] + right[0], left[1] + right[1]
+                # transient / timeout — and a single lane's OOM,
+                # which cannot bisect further but is often another
+                # process's memory burst: jittered backoff within
+                # the budget, then a structured give-up
+                if attempt >= faults.max_retries:
+                    faults.record(reason, "giveup")
+                    return [], [{"offset": start, "count": len(built),
+                                 "reason": reason, "error": str(exc)}]
+                faults.record(reason, "retry")
+                faults.sleep_backoff(attempt)
+                attempt += 1
+
+    pending = None  # (gi, ci, kept, keys, segments, failures, ctx)
 
     def drain(entry):
-        gi, ci, kept, kept_keys, offs, rebs, rows = entry
+        (gi, ci, kept, kept_keys, segments, failures, config, built,
+         batch) = entry
         with _span(tracer, "readback", group=gi, chunk=ci):
-            n = len(kept)
-            if rows is None:
-                out = [(float(o), float(r))
-                       for o, r in zip(offs[:n], rebs[:n])]
-            else:
-                rows = np.asarray(rows)
-                out = [(float(o), float(r), rows[lane])
-                       for lane, (o, r) in enumerate(zip(offs[:n],
-                                                         rebs[:n]))]
-            for pos, metric in enumerate(out):
-                results[gi][kept[pos]] = metric
-                if kept_keys is not None:
-                    warm_start.row_store(kept_keys[pos], metric)
+            journaled = []
+            work = list(segments)
+            while work:
+                start, n, offs, rebs, rows = work.pop(0)
+                try:
+                    # host-side transfer THEN slice: slicing the
+                    # device array at a sub-chunk length (bisected
+                    # halves) would compile a fresh slice program
+                    # per length — recovery must stay compile-free
+                    offs_np = np.asarray(offs)[:n]
+                    rebs_np = np.asarray(rebs)[:n]
+                    if rows is None:
+                        out = [(float(o), float(r))
+                               for o, r in zip(offs_np, rebs_np)]
+                    else:
+                        arr = np.asarray(rows)
+                        out = [(float(o), float(r), arr[lane])
+                               for lane, (o, r) in enumerate(
+                                   zip(offs_np, rebs_np))]
+                except Exception as exc:  # fault-ok: classified —
+                    # unrecognized readback failures re-raise
+                    reason = _classify(exc)
+                    if reason is None:
+                        raise
+                    # an async dispatch fault surfacing at readback:
+                    # count it, then re-dispatch the segment through
+                    # the same recovery path, BLOCKING (a blocked
+                    # success cannot fault again at conversion)
+                    faults.record(reason, "retry")
+                    resegs, refails = _dispatch_resilient(
+                        gi, ci, config, built[start:start + n], batch,
+                        start, True)
+                    work = resegs + work
+                    failures = failures + refails
+                    continue
+                for pos, metric in enumerate(out):
+                    results[gi][kept[start + pos]] = metric
+                    if kept_keys is not None:
+                        warm_start.row_store(kept_keys[start + pos],
+                                             metric)
+                        if journal is not None:
+                            journaled.append(kept_keys[start + pos])
+            if journal is not None and journaled:
+                # durable progress: the drained chunk's row keys
+                # under ONE fsync before the engine moves on — what
+                # --resume replays against the row cache (a
+                # mid-drain crash loses only this chunk, which
+                # recomputes)
+                journal.record_rows(journaled)
+            for failure in failures:
+                stats[gi]["failures"].append({
+                    "items": [kept[failure["offset"] + j]
+                              for j in range(failure["count"])],
+                    "reason": failure["reason"],
+                    "error": failure["error"]})
 
     for gi, ci, off in schedule:
         config, items, build, batch, keep, keys = prepared[gi]
@@ -1667,41 +1843,15 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
         kept_keys = keys[off:off + batch] if keys is not None else None
         with _span(tracer, "build", group=gi, chunk=ci):
             built = [build(items[i]) for i in kept]
-            built += [built[-1]] * (batch - len(built))
-            scenarios = stack_pytrees([sc for sc, _ in built])
-            joins = jnp.stack([j for _, j in built])
-            states = stack_pytrees([init_swarm(config)] * batch)
         t0 = time.perf_counter()
         with _span(tracer, "dispatch", group=gi, chunk=ci):
-            if aot_on:
-                states = ensure_penalty_width_batch(config, scenarios,
-                                                    states)
-                runner = warm_start.batch_runner(
-                    config, scenarios, states, n_steps,
-                    record_every=record_every, donate_scenarios=True)
-                res = runner(scenarios, states)
-            else:
-                res = run_swarm_batch(config, scenarios, states,
-                                      n_steps,
-                                      record_every=record_every,
-                                      donate_scenarios=True)
-            finals = res[0]
-            rows = res[2] if record_every else None
-            offs = offload_ratio_batch(finals)
-            rebs = rebuffer_ratio_batch(finals, watch_s, joins)
-            if not pipeline:
-                # the drain-per-chunk mode is the overlap-measurement
-                # BASELINE: dispatch is async, so without this wait
-                # the readback span would absorb the device-compute
-                # time and deflate the overlap metric's denominator
-                # contract ("blocking readback hidden under compute")
-                for arr in (offs, rebs) + (() if rows is None
-                                           else (rows,)):
-                    arr.block_until_ready()
+            segments, failures = _dispatch_resilient(
+                gi, ci, config, built, batch, 0, not pipeline)
         if stats[gi]["first_dispatch_s"] is None:
             stats[gi]["first_dispatch_s"] = time.perf_counter() - t0
         stats[gi]["chunks"] += 1
-        entry = (gi, ci, kept, kept_keys, offs, rebs, rows)
+        entry = (gi, ci, kept, kept_keys, segments, failures, config,
+                 built, batch)
         if not pipeline:
             drain(entry)
             continue
@@ -1716,7 +1866,8 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
 def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
                       *, watch_s: float, chunk: Optional[int] = None,
                       record_every: int = 0, tracer=None,
-                      pipeline: bool = True, warm_start=None):
+                      pipeline: bool = True, warm_start=None,
+                      faults=None, journal=None):
     """Single-group front-end for :func:`run_groups_chunked` — the
     dispatch engine shared by ``tools/sweep.py`` and
     ``tools/policy_ab.py``.  Returns per-item ``(offload, rebuffer)``
@@ -1724,16 +1875,19 @@ def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
     appended per item when ``record_every > 0``); ``chunk=None``
     autotunes the scenarios-per-dispatch from device memory
     (:func:`autotune_chunk`); ``warm_start`` threads the persistent
-    executable/row caches through the dispatch.  See
-    :func:`run_groups_chunked` for the chunking/padding/pipelining
-    contract."""
+    executable/row caches through the dispatch; ``faults`` arms the
+    bounded retry/bisection recovery (items whose budget ran out come
+    back as ``None``) and ``journal`` records completed rows
+    crash-safely.  See :func:`run_groups_chunked` for the
+    chunking/padding/pipelining and recovery contracts."""
     items = list(items)
     if not items:
         return []
     results, _stats = run_groups_chunked(
         [(config, items, build)], n_steps, watch_s=watch_s,
         chunk=chunk, record_every=record_every, tracer=tracer,
-        pipeline=pipeline, warm_start=warm_start)
+        pipeline=pipeline, warm_start=warm_start, faults=faults,
+        journal=journal)
     return results[0]
 
 

@@ -1,0 +1,450 @@
+"""The fault plane + recovery layer (engine/faults.py, the resilient
+dispatch in ops/swarm_sim.py run_groups_chunked, the crash-safe
+SweepJournal and atomic artifact writes in engine/artifact_cache.py):
+injected faults must be deterministic, recovery must be bit-exact and
+compile-free, an exhausted budget must become a structured partial
+failure (never an unhandled exception), every recovery must be
+counted, and no crash may leave a truncated artifact.  The
+process-level half (SIGKILL + --resume through the real tool) lives
+in tests/test_resume_process.py and tools/chaos_gate.py."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (
+    CompileCounter, SweepJournal, WarmStart, atomic_write_bytes,
+    atomic_write_json, atomic_write_text, journal_path)
+from hlsjs_p2p_wrapper_tpu.engine.faults import (
+    FaultPlan, FaultPolicy, InjectedFault, classify_error)
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (
+    SwarmConfig, make_scenario, ring_offsets, run_batch_chunked,
+    run_groups_chunked)
+
+PEERS = 16
+BITRATES = jnp.array([300_000.0, 800_000.0])
+N_STEPS = 40
+WATCH_S = 10.0
+
+
+def small_config():
+    return SwarmConfig(n_peers=PEERS, n_segments=8, n_levels=2,
+                       neighbor_offsets=ring_offsets(4))
+
+
+def chunked_fixture(config):
+    cdn = jnp.full((PEERS,), 8_000_000.0)
+
+    def build(margin):
+        return (make_scenario(config, BITRATES, None, cdn,
+                              urgent_margin_s=margin),
+                jnp.zeros((PEERS,)))
+
+    return [0.5, 2.0, 4.0, 8.0, 16.0], build
+
+
+def no_sleep_policy(plan=None, **kwargs):
+    """A policy that records its backoff schedule instead of
+    sleeping — tests assert the jittered delays without paying them."""
+    sleeps = []
+    policy = FaultPolicy(plan=plan, sleep=sleeps.append, **kwargs)
+    return policy, sleeps
+
+
+# -- the fault plane ----------------------------------------------------
+
+def test_fault_plan_parse_and_pop():
+    plan = FaultPlan.parse("oom@0:1,transient@1:2x3, timeout@0:4")
+    assert plan.remaining() == 5
+    assert plan.pop(0, 0) is None
+    assert plan.pop(0, 1) == "oom"
+    assert plan.pop(0, 1) is None  # consumed
+    assert [plan.pop(1, 2) for _ in range(4)] == \
+        ["transient"] * 3 + [None]
+    assert plan.pop(0, 4) == "timeout"
+    assert plan.remaining() == 0
+
+
+def test_fault_plan_rejects_bad_specs():
+    with pytest.raises(ValueError):
+        FaultPlan.parse("explode@0:1")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("oom@nowhere")
+    with pytest.raises(ValueError):
+        FaultPlan([{"kind": "nope", "group": 0, "chunk": 0}])
+
+
+def test_classify_error_mapping():
+    assert classify_error(InjectedFault("oom", "whatever")) == "oom"
+    assert classify_error(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying "
+                     "to allocate 123 bytes")) == "oom"
+    assert classify_error(
+        RuntimeError("DEADLINE_EXCEEDED: dispatch timed out")) \
+        == "timeout"
+    assert classify_error(
+        RuntimeError("UNAVAILABLE: connection reset")) == "transient"
+    assert classify_error(
+        RuntimeError("INTERNAL: generated function failed")) \
+        == "transient"
+    # programming errors are NEVER retried, whatever their message
+    assert classify_error(
+        ValueError("RESOURCE_EXHAUSTED lookalike")) is None
+    assert classify_error(RuntimeError("something else")) is None
+
+
+def test_backoff_is_deterministic_and_bounded():
+    a = FaultPolicy(seed=7)
+    b = FaultPolicy(seed=7)
+    seq_a = [a.backoff_s(i) for i in range(6)]
+    seq_b = [b.backoff_s(i) for i in range(6)]
+    assert seq_a == seq_b  # same seed, same jittered schedule
+    assert FaultPolicy(seed=1).backoff_s(0) != \
+        FaultPolicy(seed=2).backoff_s(0)
+    for attempt, delay in enumerate(seq_a):
+        assert delay <= a.backoff_cap_s * (1.0 + a.jitter)
+        assert delay >= min(a.backoff_cap_s,
+                            a.backoff_base_s * 2.0 ** attempt)
+
+
+# -- recovery: retry / bisection / give-up ------------------------------
+
+def test_transient_retry_recovers_bit_exact():
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2)
+    policy, sleeps = no_sleep_policy(
+        FaultPlan.parse("transient@0:1x2,timeout@0:2"))
+    out = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2, faults=policy)
+    assert out == ref  # recovery is a pure performance event
+    assert policy.fault_counts() == {"transient|retry": 2,
+                                     "timeout|retry": 1}
+    # two backoffs for the double transient (attempts 0 and 1), one
+    # for the timeout — the exact jittered schedule of seed 0 (one
+    # probe policy: the jitter RNG draws sequentially per policy)
+    probe = FaultPolicy(seed=0)
+    assert len(sleeps) == 3
+    assert sleeps[:2] == [probe.backoff_s(0), probe.backoff_s(1)]
+
+
+def test_oom_bisection_bit_exact_and_compile_free():
+    """Injected OOM bisects (recursively) at the canonical chunk
+    shape: results bit-identical, ZERO XLA compiles once the chunk
+    program is warm — the acceptance bar the chaos gate holds at
+    process level."""
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=4)  # warms the jit
+    policy, _sleeps = no_sleep_policy(FaultPlan.parse("oom@0:0x2"))
+    with CompileCounter() as probe:
+        out = run_batch_chunked(config, items, build, N_STEPS,
+                                watch_s=WATCH_S, chunk=4,
+                                faults=policy)
+    assert out == ref
+    # chunk 0 (4 lanes) bisects, then its first half (2 lanes)
+    # bisects again — both halves re-padded to the 4-lane shape
+    assert policy.fault_counts() == {"oom|bisect": 2}
+    assert probe.compiles == 0
+
+
+def test_exhausted_budget_is_a_structured_partial_failure():
+    config = small_config()
+    items, build = chunked_fixture(config)
+    policy, sleeps = no_sleep_policy(
+        FaultPlan.parse("transient@0:0x9"), max_retries=3)
+    results, stats = run_groups_chunked(
+        [(config, items, build)], N_STEPS, watch_s=WATCH_S, chunk=2,
+        faults=policy)
+    # chunk 0 (items 0, 1) exhausted its budget; the rest completed
+    assert results[0][0] is None and results[0][1] is None
+    assert all(isinstance(m, tuple) for m in results[0][2:])
+    (failure,) = stats[0]["failures"]
+    assert failure["items"] == [0, 1]
+    assert failure["reason"] == "transient"
+    assert "injected fault" in failure["error"]
+    assert policy.fault_counts() == {"transient|retry": 3,
+                                     "transient|giveup": 1}
+    assert len(sleeps) == 3  # one backoff per counted retry
+
+
+def test_single_lane_oom_retries_then_gives_up_structured():
+    """A lane that OOMs alone cannot bisect further: it retries
+    under the backoff budget (a real single-lane OOM is often
+    another process's transient memory burst — the shape is
+    unchanged, so retrying stays compile-free) and then becomes a
+    counted give-up with its item index, not a crash or a loop.
+    The x99 plan outlives every budget, so both lanes exhaust."""
+    config = small_config()
+    items, build = chunked_fixture(config)
+    policy, sleeps = no_sleep_policy(FaultPlan.parse("oom@0:0x99"),
+                                     max_retries=3)
+    results, stats = run_groups_chunked(
+        [(config, items, build)], N_STEPS, watch_s=WATCH_S, chunk=2,
+        faults=policy)
+    assert results[0][0] is None and results[0][1] is None
+    assert stats[0]["failures"] == [
+        {"items": [0], "reason": "oom",
+         "error": stats[0]["failures"][0]["error"]},
+        {"items": [1], "reason": "oom",
+         "error": stats[0]["failures"][1]["error"]},
+    ]
+    counts = policy.fault_counts()
+    assert counts["oom|bisect"] == 1
+    assert counts["oom|retry"] == 6  # 3 per lane, with backoff
+    assert counts["oom|giveup"] == 2
+    assert len(sleeps) == 6
+
+
+def test_single_lane_oom_recovers_on_a_transient_burst():
+    """The case the retry exists for: a lane whose OOM clears after
+    two attempts completes bit-exactly with no failure report."""
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2)
+    # chunk 0 OOMs, bisects; lane 0 OOMs twice more, then clears
+    policy, _sleeps = no_sleep_policy(FaultPlan.parse("oom@0:0x3"))
+    out = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2, faults=policy)
+    assert out == ref
+    assert policy.fault_counts() == {"oom|bisect": 1, "oom|retry": 2}
+
+
+def test_unclassified_errors_propagate():
+    """Recovery must never swallow a programming error: an exception
+    the classifier does not recognize re-raises even under an armed
+    policy."""
+    class _Boom(FaultPolicy):
+        fired = False
+
+        def before_dispatch(self, *, group, chunk):
+            if not _Boom.fired:
+                _Boom.fired = True
+                raise ValueError("a shape bug, not weather")
+
+    config = small_config()
+    items, build = chunked_fixture(config)
+    with pytest.raises(ValueError, match="shape bug"):
+        run_batch_chunked(config, items, build, N_STEPS,
+                          watch_s=WATCH_S, chunk=2, faults=_Boom())
+
+
+def test_faults_land_in_injected_registry():
+    registry = MetricsRegistry()
+    config = small_config()
+    items, build = chunked_fixture(config)
+    policy = FaultPolicy(FaultPlan.parse("transient@0:0"),
+                         registry=registry, sleep=lambda _s: None)
+    run_batch_chunked(config, items, build, N_STEPS, watch_s=WATCH_S,
+                      chunk=2, faults=policy)
+    snapshot = registry.snapshot()
+    assert snapshot[
+        "dispatch_faults{action=retry,reason=transient}"] == 1
+
+
+# -- the crash-safe journal ---------------------------------------------
+
+def test_journal_records_and_resumes(tmp_path):
+    meta = {"tool": "test", "x": 1}
+    path = journal_path(str(tmp_path), meta)
+    with SweepJournal(path, meta) as journal:
+        journal.record_row("k1")
+        journal.record_row("k2")
+        journal.record_row("k1")  # idempotent
+    resumed = SweepJournal(path, meta, resume=True)
+    assert resumed.completed == {"k1", "k2"}
+    assert not resumed.finished
+    resumed.record_row("k3")
+    resumed.finalize()
+    resumed.close()
+    done = SweepJournal(path, meta, resume=True)
+    assert done.completed == {"k1", "k2", "k3"}
+    assert done.finished
+    done.close()
+
+
+def test_journal_refuses_a_different_sweep(tmp_path):
+    meta = {"tool": "test", "x": 1}
+    path = journal_path(str(tmp_path), meta)
+    SweepJournal(path, meta).close()
+    with pytest.raises(ValueError, match="different sweep"):
+        SweepJournal(path, {"tool": "test", "x": 2}, resume=True)
+    # distinct meta → distinct journal path, so real sweeps never
+    # collide in the first place
+    assert journal_path(str(tmp_path), {"tool": "test", "x": 2}) \
+        != path
+
+
+def test_journal_tolerates_a_torn_tail(tmp_path):
+    """A SIGKILL mid-append can leave a half-written last line; the
+    reader must keep every fsync'd whole line and drop the tear."""
+    meta = {"tool": "test"}
+    path = journal_path(str(tmp_path), meta)
+    with SweepJournal(path, meta) as journal:
+        journal.record_row("whole-1")
+        journal.record_row("whole-2")
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"kind": "row", "key": "torn-')  # no newline, cut
+    resumed = SweepJournal(path, meta, resume=True)
+    assert resumed.completed == {"whole-1", "whole-2"}
+    resumed.record_row("after-tear")  # appending still works
+    resumed.close()
+    again = SweepJournal(path, meta, resume=True)
+    assert "after-tear" in again.completed
+    again.close()
+
+
+def test_fresh_open_truncates_an_old_journal(tmp_path):
+    meta = {"tool": "test"}
+    path = journal_path(str(tmp_path), meta)
+    with SweepJournal(path, meta) as journal:
+        journal.record_row("old")
+    fresh = SweepJournal(path, meta)  # resume=False: a new run
+    assert fresh.completed == set()
+    fresh.close()
+    assert SweepJournal(path, meta, resume=True).completed == set()
+
+
+def test_engine_journals_rows_and_resume_skips_them(tmp_path):
+    """The dispatch engine records each drained row's cache key; a
+    resumed run replays them against the row cache and re-dispatches
+    nothing for journaled rows."""
+    config = small_config()
+    items, build = chunked_fixture(config)
+    meta = {"tool": "test-engine"}
+    path = journal_path(str(tmp_path), meta)
+    ws = WarmStart(cache_dir=str(tmp_path))
+    journal = SweepJournal(path, meta)
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2, warm_start=ws,
+                            journal=journal)
+    assert len(journal.completed) == len(items)
+    journal.close()
+
+    ws2 = WarmStart(cache_dir=str(tmp_path))
+    journal2 = SweepJournal(path, meta, resume=True)
+    out = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2, warm_start=ws2,
+                            journal=journal2)
+    assert out == ref
+    assert ws2.event_counts("row") == {"hit": len(items)}
+    assert ws2.event_counts("executable") == {}  # nothing dispatched
+    journal2.close()
+
+
+# -- atomic artifact writes ---------------------------------------------
+
+def test_atomic_write_round_trips(tmp_path):
+    target = tmp_path / "artifact.json"
+    atomic_write_bytes(str(target), b"\x00\x01raw")
+    assert target.read_bytes() == b"\x00\x01raw"
+    atomic_write_text(str(target), "text now")
+    assert target.read_text() == "text now"
+    atomic_write_json(str(target), {"rows": [1, 2]})
+    assert json.loads(target.read_text()) == {"rows": [1, 2]}
+    # no temp litter on the happy path
+    assert os.listdir(tmp_path) == ["artifact.json"]
+
+
+_KILL_WRITER = r"""
+import json, os, signal, sys
+sys.path.insert(0, {repo!r})
+from hlsjs_p2p_wrapper_tpu.engine import artifact_cache
+
+point = sys.argv[1]
+target = sys.argv[2]
+payload = json.dumps({{"rows": list(range(50_000))}})
+
+def die(*a, **k):
+    os.kill(os.getpid(), signal.SIGKILL)
+
+if point == "replace":
+    os.replace_real = os.replace
+    os.replace = die           # the instant before the atomic rename
+elif point == "fsync":
+    os.fsync = die             # mid-dump, data not yet durable
+artifact_cache.atomic_write_text(target, payload)
+"""
+
+
+@pytest.mark.parametrize("point", ["fsync", "replace"])
+def test_killed_writer_never_truncates_the_artifact(tmp_path, point):
+    """SIGKILL a writer mid-dump (at the fsync, and at the instant
+    before the rename): the pre-existing artifact must remain intact
+    and parseable — a crash can cost the NEW write, never the file."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    target = tmp_path / "artifact.json"
+    old = json.dumps({"rows": ["old", "but", "valid"]})
+    target.write_text(old)
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_WRITER.format(repo=repo),
+         point, str(target)],
+        capture_output=True, text=True)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    assert target.read_text() == old  # untouched, still valid JSON
+    json.loads(target.read_text())
+
+
+# -- lint: the silent-broad-except discipline ---------------------------
+
+def test_broad_except_lint_rule(tmp_path):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import lint as lint_tool
+
+    bad = tmp_path / "bad_engine.py"
+    bad.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        return None\n"
+        "def g():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except (OSError, BaseException):\n"
+        "        pass\n")
+    findings = lint_tool.check_broad_excepts(str(bad))
+    assert len(findings) == 2
+    assert all("fault-ok" in f for f in findings)
+
+    good = tmp_path / "good_engine.py"
+    good.write_text(
+        "import logging\n"
+        "log = logging.getLogger()\n"
+        "def a():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        log.exception('counted')\n"
+        "def b(registry):\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        registry.counter('x').inc()\n"
+        "def c():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as e:\n"
+        "        raise RuntimeError('wrapped') from e\n"
+        "def d():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:  # fault-ok: absence is the signal\n"
+        "        return None\n"
+        "def e():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except OSError:\n"  # narrow: not this rule's business
+        "        return None\n")
+    assert lint_tool.check_broad_excepts(str(good)) == []

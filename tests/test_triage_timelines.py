@@ -163,6 +163,98 @@ def test_pre_join_empty_samples_are_skipped():
         ["ladder_oscillation"]
 
 
+def edge_sample(t, cdn, p2p, present):
+    """A sample with explicit byte rates and presence — the stagger
+    overshoot detector's inputs."""
+    return [t, 0.5, 0.0, cdn, p2p, 0.0, present, 0.0]
+
+
+def overshoot_record(spread_s=4.0):
+    """Steady audience; CDN keeps carrying 90% of the bytes long
+    after the configured stagger window elapsed — the edge cohort
+    never hands off to P2P."""
+    samples = [edge_sample(t, 0.9e6, 0.1e6, 10.0) for t in range(16)]
+    return {"spread_s": spread_s, "columns": COLUMNS,
+            "samples": samples}
+
+
+def handoff_record():
+    """The healthy shape: CDN-heavy only inside the window, P2P
+    carries the bytes once it closes."""
+    samples = [edge_sample(t, 0.9e6 if t <= 5 else 0.1e6,
+                           0.1e6 if t <= 5 else 0.9e6, 10.0)
+               for t in range(16)]
+    return {"spread_s": 4.0, "columns": COLUMNS, "samples": samples}
+
+
+def wave_restart_record(with_wave=True):
+    """High CDN share ONLY within the stagger window that a t=8
+    flash crowd restarts: excused when the wave is present, an
+    overshoot when the same trajectory has no arrivals behind it."""
+    samples = []
+    for t in range(16):
+        present = (4.0 if t < 8 else 12.0) if with_wave else 12.0
+        high = t <= 5 or 8 <= t <= 13
+        samples.append(edge_sample(t, 0.9e6 if high else 0.1e6,
+                                   0.1e6 if high else 0.9e6,
+                                   present))
+    return {"spread_s": 4.0, "columns": COLUMNS, "samples": samples}
+
+
+def test_detects_stagger_overshoot():
+    record = overshoot_record()
+    finding = triage.detect_stagger_overshoot(
+        record["columns"], record["samples"], record["spread_s"])
+    assert finding is not None
+    assert finding["reason"] == "stagger_overshoot"
+    assert finding["window_s"] == 4.0
+    # window [0, 5] (spread 4 + one 1s sample interval): t=6..15 are
+    # post-window, all ten carrying a 90% CDN share
+    assert finding["post_window_samples"] == 10
+    assert finding["overshoot_samples"] == 10
+    assert finding["worst_cdn_share"] == 0.9
+    assert finding["first_t_s"] == 6
+
+
+def test_clean_handoff_is_not_overshoot():
+    record = handoff_record()
+    assert triage.detect_stagger_overshoot(
+        record["columns"], record["samples"],
+        record["spread_s"]) is None
+
+
+def test_no_window_means_no_overshoot():
+    """A point that configured NO stagger (spread 0) never flags —
+    there is no window to overshoot."""
+    record = overshoot_record(spread_s=0.0)
+    assert triage.detect_stagger_overshoot(
+        record["columns"], record["samples"], 0.0) is None
+    assert triage.detect_stagger_overshoot(
+        record["columns"], record["samples"], None) is None
+
+
+def test_join_wave_restarts_the_stagger_window():
+    record = wave_restart_record(with_wave=True)
+    assert triage.detect_stagger_overshoot(
+        record["columns"], record["samples"],
+        record["spread_s"]) is None
+    # the SAME CDN trajectory with no arrivals behind it is the
+    # swarm failing to absorb the edge, not a restarted window
+    record = wave_restart_record(with_wave=False)
+    finding = triage.detect_stagger_overshoot(
+        record["columns"], record["samples"], record["spread_s"])
+    assert finding is not None
+    assert finding["overshoot_samples"] == 6  # t=8..13
+
+
+def test_overshoot_rides_triage_records():
+    triaged = triage.triage_records([overshoot_record(),
+                                     handoff_record()])
+    assert [e["point"] for e in triaged] == [0]
+    reasons = [f["reason"] for f in triaged[0]["findings"]]
+    assert "stagger_overshoot" in reasons
+
+
 def test_knob_label_skips_structure_keys():
     label = triage.knob_label({"urgent_margin_s": 0.5, "columns": [],
                                "samples": [], "offload": 0.5,

@@ -1,0 +1,258 @@
+"""Chaos gate: the dispatch engine survives the faults hosts throw.
+
+The resilience layer's claims (engine/faults.py, ops/swarm_sim.py
+``run_groups_chunked``) are only worth shipping if they hold at
+PROCESS granularity, against the deterministic fault plane, with the
+recovery observable — so this gate runs the shipped VOD grid
+(tools/sweep.py) in child processes against one throwaway cache
+directory and asserts, in order:
+
+1. **cold** — fault-free, row cache off: the bit-exactness reference
+   (``float.hex`` of the full-precision rows) and the AOT-cache
+   populate run.
+2. **oom** — injected ``RESOURCE_EXHAUSTED`` faults (one of them on
+   an already-bisected half, exercising recursive bisection): the
+   run must complete with rows BIT-IDENTICAL to the reference, ZERO
+   XLA compiles (bisected halves re-dispatch padded back to the
+   canonical chunk shape, so the warm serialized executable covers
+   every recovery dispatch — ``CompileCounter``), zero failed
+   points, and every bisection counted in
+   ``dispatch_faults{reason="oom",action="bisect"}``.
+3. **transient** — an injected transient + timeout burst: recovered
+   within the retry budget, rows bit-identical, zero compiles, every
+   retry counted.
+4. **kill** — a SIGKILL injected mid-grid (the preemption model):
+   the process must die hard (no artifact), leaving the crash-safe
+   journal with the completed rows fsync'd.
+5. **resume** — ``--resume`` semantics: replays the journal against
+   the layer-2 row cache, performs zero compiles, re-dispatches NONE
+   of the journaled rows (row-cache hit count == journal length),
+   completes the rest, reproduces the reference bit-exactly, and
+   finalizes the journal.
+
+Gate-sized swarms by default; ``CHAOS_GATE_PEERS`` etc. scale it up
+on accelerator hosts.  The chunk is PINNED for the same reason the
+warm-start gate pins it: the autotuner reads live device memory, and
+a chunk that drifted between children would change the program shape
+— an honest cache miss, but not what this gate measures.
+
+Run: ``python tools/chaos_gate.py`` (exit 1 on any violation);
+``make chaos-gate`` wires it into ``make check``.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+#: injected fault schedules per gate mode (engine/faults.py
+#: FaultPlan.parse syntax).  oom@0:2x2 fires on chunk 2's first
+#: dispatch AND on its first bisected half — recursive bisection.
+FAULT_SPECS = {
+    "oom": "oom@0:1,oom@0:2x2",
+    "transient": "transient@0:0x2,timeout@0:3",
+    "kill": "kill@0:3",
+}
+#: expected dispatch_faults counters per mode (every recovery must be
+#: COUNTED, not just survived)
+EXPECTED_FAULTS = {
+    "oom": {"oom|bisect": 3},
+    "transient": {"transient|retry": 2, "timeout|retry": 1},
+}
+
+
+def child(args):
+    """One gate run in a fresh interpreter: probe + caches attached
+    BEFORE any jax computation, then the real tool engine
+    (``sweep.run_grid_batched``) under the mode's fault plan."""
+    from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (
+        CompileCounter, SweepJournal, WarmStart,
+        enable_persistent_compilation_cache, journal_path)
+    from hlsjs_p2p_wrapper_tpu.engine.faults import (FaultPlan,
+                                                     FaultPolicy)
+    probe = CompileCounter().attach()
+    enable_persistent_compilation_cache(args.cache_dir)
+    ws = WarmStart(cache_dir=args.cache_dir,
+                   row_cache=not args.no_row_cache)
+
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import sweep as sweep_tool
+    grid = sweep_tool.vod_grid()
+    spec = FAULT_SPECS.get(args.mode)
+    faults = FaultPolicy(plan=FaultPlan.parse(spec) if spec else None,
+                         registry=ws.registry,
+                         backoff_base_s=0.001)  # the gate asserts
+    # counts, not wall time — no reason to sleep through backoff
+    journal = None
+    preloaded = 0
+    if not args.no_row_cache:
+        meta = sweep_tool.journal_meta(
+            grid, peers=args.peers, segments=args.segments,
+            watch_s=args.watch_s, live=False, seed=0, record_every=0)
+        journal = SweepJournal(journal_path(args.cache_dir, meta),
+                               meta, resume=args.resume)
+        preloaded = len(journal.completed)
+    rows, info = sweep_tool.run_grid_batched(
+        grid, peers=args.peers, segments=args.segments,
+        watch_s=args.watch_s, live=False, seed=0, chunk=args.chunk,
+        warm_start=ws, faults=faults, journal=journal, raw=True)
+    failed = [row for row in rows if row.get("failed")]
+    if journal is not None and not failed:
+        journal.finalize()
+    print(json.dumps({
+        "mode": args.mode,
+        "points": len(rows),
+        "compiles": probe.compiles,
+        "row_hits": info["row_hits"],
+        "failed_points": len(failed),
+        "failures": info["failures"],
+        "faults": faults.fault_counts(),
+        "journal_preloaded": preloaded,
+        # float.hex round-trips exactly: bit-exactness is compared
+        # on the full-precision metrics (warmstart_gate.py pattern)
+        "rows": [[None, None] if row.get("failed")
+                 else [row["offload"].hex(), row["rebuffer"].hex()]
+                 for row in rows],
+    }))
+    return 0
+
+
+def run_child(mode, cache_dir, sizes, *, no_row_cache=False,
+              resume=False, expect_kill=False):
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--mode", mode, "--cache-dir", cache_dir,
+           "--peers", str(sizes["peers"]),
+           "--segments", str(sizes["segments"]),
+           "--watch-s", str(sizes["watch_s"]),
+           "--chunk", str(sizes["chunk"])]
+    if no_row_cache:
+        cmd.append("--no-row-cache")
+    if resume:
+        cmd.append("--resume")
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=_REPO)
+    if expect_kill:
+        if proc.returncode != -signal.SIGKILL:
+            raise SystemExit(
+                f"chaos-gate: kill child exited {proc.returncode}, "
+                f"expected SIGKILL ({-signal.SIGKILL}):\n"
+                f"{proc.stdout}\n{proc.stderr}")
+        return None
+    if proc.returncode != 0:
+        raise SystemExit(f"chaos-gate child failed ({mode}):\n"
+                         f"{proc.stdout}\n{proc.stderr}")
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
+def check_recovered(mode, report, cold, problems):
+    """The shared recovered-run contract: bit-identical rows, zero
+    compiles, zero failed points, every recovery counted."""
+    if report["compiles"] != 0:
+        problems.append(f"{mode}: performed {report['compiles']} XLA "
+                        f"compiles under recovery — expected 0 (the "
+                        f"canonical-shape padding exists precisely "
+                        f"so recovery never compiles)")
+    if report["failed_points"] != 0:
+        problems.append(f"{mode}: {report['failed_points']} points "
+                        f"failed ({report['failures']}) — the "
+                        f"injected schedule is within budget, all "
+                        f"must recover")
+    if report["rows"] != cold["rows"]:
+        diverged = sum(1 for a, b in zip(report["rows"], cold["rows"])
+                       if a != b)
+        problems.append(f"{mode}: recovered rows diverged from the "
+                        f"fault-free reference at {diverged}/"
+                        f"{len(cold['rows'])} points — recovery must "
+                        f"be bit-exact")
+    for key, want in EXPECTED_FAULTS.get(mode, {}).items():
+        got = report["faults"].get(key, 0)
+        if got != want:
+            problems.append(f"{mode}: dispatch_faults[{key}] == "
+                            f"{got}, expected {want} — every "
+                            f"recovery must be counted")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--mode", default="cold",
+                    choices=("cold", "oom", "transient", "kill",
+                             "resume"))
+    ap.add_argument("--cache-dir")
+    ap.add_argument("--no-row-cache", action="store_true")
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--peers", type=int, default=int(
+        os.environ.get("CHAOS_GATE_PEERS", 48)))
+    ap.add_argument("--segments", type=int, default=int(
+        os.environ.get("CHAOS_GATE_SEGMENTS", 12)))
+    ap.add_argument("--watch-s", type=float, default=float(
+        os.environ.get("CHAOS_GATE_WATCH_S", 8.0)))
+    ap.add_argument("--chunk", type=int, default=int(
+        os.environ.get("CHAOS_GATE_CHUNK", 8)))
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return child(args)
+
+    sizes = {"peers": args.peers, "segments": args.segments,
+             "watch_s": args.watch_s, "chunk": args.chunk}
+    cache_dir = args.cache_dir or tempfile.mkdtemp(
+        prefix="chaos-gate-")
+    problems = []
+    try:
+        # 1. the fault-free reference (row cache off so the faulted
+        # runs below actually dispatch; populates the AOT cache)
+        cold = run_child("cold", cache_dir, sizes, no_row_cache=True)
+
+        # 2-3. recovery under injected OOM (bisection) and a
+        # transient/timeout burst (retry + backoff)
+        oom = run_child("oom", cache_dir, sizes, no_row_cache=True)
+        check_recovered("oom", oom, cold, problems)
+        transient = run_child("transient", cache_dir, sizes,
+                              no_row_cache=True)
+        check_recovered("transient", transient, cold, problems)
+
+        # 4. preemption: SIGKILL mid-grid, journal + row cache armed
+        run_child("kill", cache_dir, sizes, expect_kill=True)
+
+        # 5. crash-safe resume: journal replayed against the row
+        # cache — zero compiles, zero recompute of completed rows
+        resume = run_child("resume", cache_dir, sizes, resume=True)
+        check_recovered("resume", resume, cold, problems)
+        if resume["journal_preloaded"] == 0:
+            problems.append("resume: the killed run journaled no "
+                            "rows — the kill fired before any chunk "
+                            "drained, so the gate proved nothing")
+        elif resume["row_hits"] != resume["journal_preloaded"]:
+            problems.append(
+                f"resume: {resume['row_hits']} row-cache hits vs "
+                f"{resume['journal_preloaded']} journaled rows — "
+                f"completed rows must not re-dispatch (and "
+                f"un-journaled ones must)")
+        print(f"chaos-gate: cold compiled {cold['compiles']}; "
+              f"oom recovered via {oom['faults']}; transient via "
+              f"{transient['faults']}; resume replayed "
+              f"{resume['journal_preloaded']} journaled rows with "
+              f"{resume['compiles']} compiles -> "
+              f"{'ok' if not problems else 'FAIL'}")
+    finally:
+        if args.cache_dir is None:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+    for problem in problems:
+        print(f"chaos-gate: {problem}", file=sys.stderr)
+    print(f"# chaos-gate: {'PASS' if not problems else 'FAIL'} "
+          f"(VOD grid, 5 processes, {sizes['peers']} peers, "
+          f"chunk {sizes['chunk']})", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,7 @@ library code logs through ``logging`` or counts into the telemetry
 registry (engine/telemetry.py); tools/tests/examples, which OWN their
 stdout, are exempt.
 
-Two repo-specific rules:
+Three repo-specific rules:
 
 - every entry of ``STATIC_KNOBS`` in ``tools/sweep.py`` (the sweep's
   compile-group key) must carry an inline ``# static:``
@@ -22,6 +22,14 @@ Two repo-specific rules:
   jit/lower call outside the artifact-cache entry points silently
   re-grows an uncached compile path.  Deliberate compilers (the
   profiling tools, which MEASURE compiles) say so inline.
+- any ``except Exception:`` / ``except BaseException:`` in the
+  package or ``tools/`` must re-raise, RECORD the fault (a telemetry
+  instrument bump or a logger call), or carry an inline
+  ``# fault-ok: <why>`` justification: the fault-tolerance layer
+  (engine/faults.py) exists precisely because swallowed errors turn
+  into silent data loss at sweep scale — no recovery path may eat a
+  fault invisibly.  (Bare ``except:`` stays banned outright,
+  everywhere.)
 
 Run: ``python tools/lint.py`` (exit code 1 on findings).
 """
@@ -175,6 +183,76 @@ def check_nocache(path):
     return findings
 
 
+#: calls that count as "recording" a swallowed fault inside a broad
+#: except handler: telemetry instruments (engine/telemetry.py) and
+#: logger methods — anything that leaves an observable trace
+RECORD_ATTRS = {"inc", "observe", "set", "set_value", "_event",
+                "record", "record_row", "warning", "error",
+                "exception", "info", "debug", "log", "critical"}
+
+
+def _broad_except_names(handler):
+    """Exception-type names a handler catches (flattening tuples)."""
+    if handler.type is None:
+        return []
+    types = (handler.type.elts
+             if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    names = []
+    for t in types:
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, ast.Attribute):
+            names.append(t.attr)
+    return names
+
+
+def check_broad_excepts(path):
+    """Fault-handling discipline for the package and ``tools/`` (the
+    fault-tolerance round, engine/faults.py): an ``except
+    Exception:`` / ``except BaseException:`` that neither re-raises
+    nor records the fault can swallow a recovery path silently —
+    exactly the failure mode the fault plane exists to surface.
+    ``# fault-ok: <why>`` on the except line is the documented
+    escape for handlers whose silence IS the contract (e.g. "player
+    not ready yet — absence is the signal").  Bare ``except:`` is
+    handled (banned outright) by ``check_file``."""
+    findings = []
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []  # check_file already reports the syntax error
+    lines = source.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not any(name in ("Exception", "BaseException")
+                   for name in _broad_except_names(node)):
+            continue
+        if "# fault-ok:" in lines[node.lineno - 1]:
+            continue
+        handled = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                handled = True
+                break
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in RECORD_ATTRS):
+                handled = True
+                break
+        if not handled:
+            findings.append(
+                f"{path}:{node.lineno}: broad except that neither "
+                f"re-raises nor records the fault (telemetry "
+                f"counter or logger) — recovery paths must stay "
+                f"observable; annotate '# fault-ok: <why>' if "
+                f"silence is the contract")
+    return findings
+
+
 def check_static_knobs(sweep_path):
     """Compile-group discipline for ``tools/sweep.py``: the
     ``STATIC_KNOBS`` tuple must exist, and every element's source
@@ -218,12 +296,16 @@ def main():
     all_findings = []
     count = 0
     tools_root = os.path.join(repo_root, "tools") + os.sep
+    package_root = os.path.join(repo_root,
+                                "hlsjs_p2p_wrapper_tpu") + os.sep
     for path in iter_py_files(repo_root):
         count += 1
         all_findings.extend(check_file(path))
         if (path.startswith(tools_root)
                 or os.path.basename(path) == "bench.py"):
             all_findings.extend(check_nocache(path))
+        if path.startswith((tools_root, package_root)):
+            all_findings.extend(check_broad_excepts(path))
     all_findings.extend(check_static_knobs(
         os.path.join(repo_root, "tools", "sweep.py")))
     for finding in sorted(all_findings):

@@ -50,7 +50,10 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
-    WarmStart, enable_persistent_compilation_cache)
+    SweepJournal, WarmStart, atomic_write_json, atomic_write_text,
+    enable_persistent_compilation_cache, journal_path)
+from hlsjs_p2p_wrapper_tpu.engine.faults import (  # noqa: E402
+    FaultPlan, FaultPolicy)
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
     SwarmConfig, make_scenario, random_neighbors, ring_offsets,
     run_groups_chunked, stable_ranks, staggered_joins,
@@ -120,7 +123,8 @@ def build_cell_scenario(config, neighbors, audience, *, uplink_bps,
 
 
 def run_cells_batched(config, neighbors, audience, cells, *, watch_s,
-                      chunk, record_every=0, warm_start=None):
+                      chunk, record_every=0, warm_start=None,
+                      faults=None, journal=None):
     """All regime cells of one (topology, policy) compile group
     through the shared chunked/pipelined dispatch engine
     (``run_groups_chunked``); returns ``(metrics, resolved_chunk)``
@@ -133,7 +137,10 @@ def run_cells_batched(config, neighbors, audience, cells, *, watch_s,
     ``warm_start`` threads the persistent executable/row caches
     through the dispatch — notably, cells a re-run (or a partially
     overlapping grid) has already computed come back from the row
-    cache without touching the device."""
+    cache without touching the device.  ``faults`` arms the engine's
+    bounded retry/bisection recovery (a cell whose chunk exhausted
+    its budget comes back as ``None``); ``journal`` records each
+    completed cell crash-safely for ``--resume``."""
     n_steps = int(watch_s * 1000.0 / config.dt_ms)
     results, stats = run_groups_chunked(
         [(config, cells,
@@ -141,14 +148,17 @@ def run_cells_batched(config, neighbors, audience, cells, *, watch_s,
               config, neighbors, audience, uplink_bps=cell[2] * 1e6,
               pattern=cell[0], wave=cell[1], watch_s=watch_s))],
         n_steps, watch_s=watch_s, chunk=chunk,
-        record_every=record_every, warm_start=warm_start)
+        record_every=record_every, warm_start=warm_start,
+        faults=faults, journal=journal)
     metrics = results[0]
     if record_every:
-        rounded = [(round(off, 4), round(reb, 5), tl)
-                   for off, reb, tl in metrics]
+        rounded = [m if m is None else (round(m[0], 4),
+                                        round(m[1], 5), m[2])
+                   for m in metrics]
     else:
-        rounded = [(round(off, 4), round(reb, 5))
-                   for off, reb in metrics]
+        rounded = [m if m is None else (round(m[0], 4),
+                                        round(m[1], 5))
+                   for m in metrics]
     return rounded, stats[0]["chunk"]
 
 
@@ -182,6 +192,14 @@ def main():
                     help="write per-(topology, policy, cell) "
                          "timelines as JSON lines; implies "
                          "--record-every 20 when that is unset")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume an interrupted run: replay the "
+                         "crash-safe journal against the layer-2 "
+                         "row cache and dispatch only the rest")
+    ap.add_argument("--inject-faults", metavar="SPEC",
+                    help="deterministic fault plane (chaos/test "
+                         "hook): kind@group:chunk[xN] coordinates "
+                         "(engine/faults.py FaultPlan)")
     args = ap.parse_args()
     if args.timelines_out and not args.record_every:
         args.record_every = 20
@@ -201,6 +219,34 @@ def main():
         # back from the row cache
         warm_start = WarmStart(row_cache=not args.no_row_cache)
         enable_persistent_compilation_cache(warm_start.cache_dir)
+    # default-on recovery + crash-safe journal (tools/sweep.py has
+    # the same wiring; engine/faults.py, SweepJournal)
+    faults = FaultPolicy(
+        plan=(FaultPlan.parse(args.inject_faults)
+              if args.inject_faults else None),
+        registry=(warm_start.registry if warm_start is not None
+                  else None))
+    journal = None
+    if args.resume and (warm_start is None
+                        or not warm_start.rows_enabled):
+        ap.error("--resume replays the journal against the row "
+                 "cache (drop --no-row-cache/--no-warm-start)")
+    if warm_start is not None and warm_start.rows_enabled:
+        meta = {"tool": "policy_ab", "peers": args.peers,
+                "ring_peers": args.ring_peers,
+                "segments": args.segments, "watch_s": args.watch_s,
+                "seed": args.seed,
+                "record_every": args.record_every,
+                "cells": cells, "policies": list(POLICIES)}
+        jpath = journal_path(warm_start.cache_dir, meta)
+        if args.resume and not os.path.exists(jpath):
+            ap.error(f"--resume: no journal for this configuration "
+                     f"({jpath})")
+        journal = SweepJournal(jpath, meta, resume=args.resume)
+        if args.resume:
+            print(f"# resume: journal lists "
+                  f"{len(journal.completed)} completed cells",
+                  file=sys.stderr)
 
     t0 = time.perf_counter()
     tables = {}
@@ -234,15 +280,20 @@ def main():
                 config, neighbors, audience, cells,
                 watch_s=args.watch_s, chunk=args.chunk,
                 record_every=args.record_every,
-                warm_start=warm_start)
+                warm_start=warm_start, faults=faults,
+                journal=journal)
             resolved_chunks[f"{topology}/{policy}"] = resolved
             if args.record_every:
                 # strip the timeline blocks back off the metric pairs
                 # (the A/B table stays pairs-only) and keep them as
-                # labeled trajectory records
+                # labeled trajectory records (a failed cell computed
+                # no timeline)
                 columns = list(timeline_columns(config))
-                for (pattern, wave, up), (off, reb, tl) in zip(
+                for (pattern, wave, up), metric in zip(
                         cells, per_policy[policy]):
+                    if metric is None:
+                        continue
+                    off, reb, tl = metric
                     timeline_records.append({
                         "topology": topology, "policy": policy,
                         "pattern": pattern, "wave": wave,
@@ -255,17 +306,31 @@ def main():
                         # tools/sweep.py)
                         "samples": [[float(v) for v in sample]
                                     for sample in tl]})
-                per_policy[policy] = [(off, reb)
-                                      for off, reb, _
-                                      in per_policy[policy]]
+                per_policy[policy] = [m if m is None else
+                                      (m[0], m[1])
+                                      for m in per_policy[policy]]
         rows = []
         for i, (pattern, wave, uplink_mbps) in enumerate(cells):
             row = {"uplink_mbps": uplink_mbps,
                    "pattern": pattern, "wave": wave}
+            cell_failed = False
             for policy in POLICIES:
-                off, reb = per_policy[policy][i]
+                metric = per_policy[policy][i]
+                if metric is None:
+                    cell_failed = True
+                    row[f"{policy}_offload"] = None
+                    row[f"{policy}_rebuffer"] = None
+                    continue
+                off, reb = metric
                 row[f"{policy}_offload"] = off
                 row[f"{policy}_rebuffer"] = reb
+            if cell_failed:
+                # structured partial failure: the cell's row ships
+                # with nulls and is excluded from the acceptance
+                # margins (a rerun/--resume retries just these)
+                row["failed"] = True
+                rows.append(row)
+                continue
             # acceptance margin: the SHIPPED default (spread)
             # vs adaptive — the two QUANTITATIVE twins.
             # "ranked" is recorded but excluded from the bar:
@@ -299,11 +364,15 @@ def main():
     elapsed = time.perf_counter() - t0
 
     if args.timelines_out:
-        with open(args.timelines_out, "w", encoding="utf-8") as f:
-            for record in timeline_records:
-                f.write(json.dumps(record) + "\n")
+        # atomic: a crash mid-dump must never leave a truncated JSONL
+        atomic_write_text(args.timelines_out,
+                          "".join(json.dumps(record) + "\n"
+                                  for record in timeline_records))
         print(f"# wrote {len(timeline_records)} timelines to "
               f"{args.timelines_out}", file=sys.stderr)
+
+    def _fmt(value, spec=">8.4f"):
+        return f"{value:{spec}}" if value is not None else f"{'—':>8}"
 
     for topology, table in tables.items():
         print(f"\n{topology} topology ({table['peers']} peers):")
@@ -315,10 +384,10 @@ def main():
             cell = (f"{row['pattern']}/{row['wave']}"
                     f"@{row['uplink_mbps']}M")
             print(f"{cell:>24} |"
-                  f" {row['ranked_offload']:>8.4f}"
-                  f" | {row['spread_offload']:>8.4f}"
-                  f" | {row['adaptive_offload']:>8.4f}"
-                  f" | {row['default_margin']:>+8.4f}")
+                  f" {_fmt(row['ranked_offload'])}"
+                  f" | {_fmt(row['spread_offload'])}"
+                  f" | {_fmt(row['adaptive_offload'])}"
+                  f" | {_fmt(row.get('default_margin'), '>+8.4f')}")
     verdict = worst["margin"] >= -0.02
     print(f"\n# worst default (spread) margin: {worst['margin']:+.4f} "
           f"at {worst['cell']} -> SIM acceptance (>= -0.02): "
@@ -345,10 +414,18 @@ def main():
         print(f"# warm start: executables {ws['executable']} rows "
               f"{ws['row']} (cache {ws['cache_dir']})",
               file=sys.stderr)
+    fault_counts = faults.fault_counts()
+    failed_cells = sum(1 for table in tables.values()
+                       for row in table["rows"] if row.get("failed"))
+    if fault_counts or failed_cells:
+        detail = ", ".join(f"{k}={v}"
+                           for k, v in sorted(fault_counts.items()))
+        print(f"# dispatch faults: {detail or 'none'}; "
+              f"{failed_cells} cells failed (rerun with --resume "
+              f"to retry just those)", file=sys.stderr)
     if args.out:
         device = jax.devices()[0]
-        with open(args.out, "w") as f:
-            json.dump({
+        atomic_write_json(args.out, {
                 "meta": {
                     "segments": args.segments,
                     "watch_s": args.watch_s, "bitrate": BITRATE,
@@ -362,6 +439,9 @@ def main():
                     "device_kind": getattr(device, "device_kind", "?"),
                     "warm_start": (warm_start.summary()
                                    if warm_start is not None else None),
+                    "resume": bool(args.resume),
+                    "dispatch_faults": fault_counts,
+                    "failed_cells": failed_cells,
                     "worst_default_margin": worst["margin"],
                     "worst_cell": worst["cell"],
                     "best_adaptive_vs_spread": best["margin"],
@@ -409,8 +489,14 @@ def main():
                             "holder_selection)",
                 },
                 "topologies": tables,
-            }, f, indent=1)
+        })
         print(f"# wrote {args.out}", file=sys.stderr)
+    if journal is not None:
+        # finalize ONLY a fully-successful run: with failed cells
+        # the journal stays open-ended so --resume retries them
+        if not failed_cells:
+            journal.finalize()
+        journal.close()
 
 
 if __name__ == "__main__":

@@ -47,6 +47,17 @@ ZERO XLA compiles and recomputes nothing for unchanged points
 recompute (executables still warm); ``--no-warm-start`` disables
 both layers.
 
+Since the fault-tolerance round the dispatch is also RESILIENT
+(engine/faults.py): transient runtime errors retry with jittered
+backoff, ``RESOURCE_EXHAUSTED`` bisects the chunk at the canonical
+padded shape (zero extra compiles), an exhausted budget becomes a
+``"failed": true`` row plus a structured ``meta.failures`` report
+instead of a crash, completed rows are journaled crash-safely
+(append + fsync) so ``--resume`` replays a SIGKILL'd run against the
+row cache with zero recompute, and the artifact itself is written
+atomically (``make chaos-gate`` proves the whole ladder
+bit-exactly; ``--inject-faults`` is the deterministic chaos hook).
+
 On a multi-chip platform the chunk additionally shards across chips
 over the ``scenarios`` mesh axis (``parallel/mesh.py``): scenarios
 are embarrassingly parallel, so the sharded grid adds ZERO
@@ -100,7 +111,10 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
-    WarmStart, enable_persistent_compilation_cache)
+    SweepJournal, WarmStart, atomic_write_json, atomic_write_text,
+    enable_persistent_compilation_cache, journal_path)
+from hlsjs_p2p_wrapper_tpu.engine.faults import (  # noqa: E402
+    FaultPlan, FaultPolicy)
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
     UNREACHABLE_BITRATE, SwarmConfig, init_swarm, make_scenario,
     offload_ratio, rebuffer_ratio, ring_offsets, run_groups_chunked,
@@ -266,11 +280,22 @@ def group_grid(grid, static_live_sync=False):
     return groups
 
 
+def journal_meta(grid, *, peers, segments, watch_s, live, seed,
+                 record_every):
+    """The sweep-identity material the crash-safe journal is
+    content-addressed by — everything that changes what a row IS, so
+    a ``--resume`` can never replay a different sweep's progress."""
+    return {"tool": "sweep", "peers": peers, "segments": segments,
+            "watch_s": watch_s, "live": bool(live), "seed": seed,
+            "record_every": record_every, "grid": grid}
+
+
 def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
                      chunk=None, stagger_s=60.0,
                      record_every=0, tracer=None, pipeline=True,
                      static_live_sync=False, interleave=True,
-                     warm_start=None, raw=False):
+                     warm_start=None, raw=False, faults=None,
+                     journal=None):
     """The batched engine: one ``run_swarm_batch`` dispatch per
     padded chunk per compile group, host readback pipelined one chunk
     behind the device, chunks round-robined across groups when more
@@ -292,7 +317,14 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
     ``first_dispatch_s`` is None and ``info`` carries per-group
     ``row_hits``.  ``raw=True`` keeps full-precision metric floats
     in the rows (the warm-start gate's bit-exactness surface)
-    instead of the table-rounded decimals."""
+    instead of the table-rounded decimals.  ``faults``
+    (engine/faults.py ``FaultPolicy``) arms the engine's bounded
+    retry / OOM-bisection recovery: a point whose chunk exhausted
+    its budget comes back as a ``failed`` row (``offload`` /
+    ``rebuffer`` None) and ``info["failures"]`` carries the
+    structured report.  ``journal``
+    (engine/artifact_cache.py ``SweepJournal``) records each
+    completed row crash-safely for ``--resume``."""
     if not grid:
         return [], {"compile_groups": 0, "chunk": None,
                     "chunk_autotuned": chunk is None, "groups": []}
@@ -312,11 +344,19 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
     results, stats = run_groups_chunked(
         group_list, n_steps, watch_s=watch_s, chunk=chunk,
         record_every=record_every, tracer=tracer, pipeline=pipeline,
-        interleave=interleave, warm_start=warm_start)
+        interleave=interleave, warm_start=warm_start, faults=faults,
+        journal=journal)
 
     rows = [None] * len(grid)
     for (key, idxs), metrics in zip(group_keys, results):
         for i, metric in zip(idxs, metrics):
+            if metric is None:
+                # this point's chunk exhausted its recovery budget —
+                # a structured partial failure (the reason rides in
+                # info["failures"] and the artifact meta), not a crash
+                rows[i] = {**grid[i], "offload": None,
+                           "rebuffer": None, "failed": True}
+                continue
             if record_every:
                 off, reb, tl = metric
             else:
@@ -333,9 +373,17 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
         "chunk": max(st["chunk"] for st in stats),
         "chunk_autotuned": chunk is None,
         "row_hits": sum(st["row_hits"] for st in stats),
+        # structured partial-failure report: grid indices + reason +
+        # last error per exhausted (sub-)chunk, in dispatch order
+        "failures": [{"group": list(key),
+                      "items": [idxs[j] for j in f["items"]],
+                      "reason": f["reason"], "error": f["error"]}
+                     for (key, idxs), st in zip(group_keys, stats)
+                     for f in st["failures"]],
         "groups": [{"key": list(key), "points": len(idxs),
                     "chunk": st["chunk"], "chunks": st["chunks"],
                     "row_hits": st["row_hits"],
+                    "failures": st["failures"],
                     # None when every point came from the row cache —
                     # a fully-warm group never dispatches
                     "first_dispatch_s": (
@@ -412,6 +460,17 @@ def main():
                     help="one JSON line per grid point")
     ap.add_argument("--out", metavar="FILE",
                     help="write the full sweep (meta + rows) as JSON")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume an interrupted sweep: replay the "
+                         "crash-safe journal against the layer-2 row "
+                         "cache (zero recompute of completed rows) "
+                         "and dispatch only the rest")
+    ap.add_argument("--inject-faults", metavar="SPEC",
+                    help="deterministic fault plane (chaos/test "
+                         "hook): comma-separated kind@group:chunk"
+                         "[xN] coordinates, kind one of oom/"
+                         "transient/timeout/kill "
+                         "(engine/faults.py FaultPlan)")
     args = ap.parse_args()
 
     if args.timelines_out and not args.record_every:
@@ -423,6 +482,9 @@ def main():
         ap.error("--record-every without --timelines-out would "
                  "compute every timeline and then discard it — "
                  "name an output file")
+    if args.sequential and (args.resume or args.inject_faults):
+        ap.error("--resume/--inject-faults need the batched engine "
+                 "(drop --sequential)")
 
     grid = live_grid() if args.live else vod_grid()
     engine = run_grid_sequential if args.sequential else run_grid_batched
@@ -434,12 +496,43 @@ def main():
         # cover (engine/artifact_cache.py)
         warm_start = WarmStart(row_cache=not args.no_row_cache)
         enable_persistent_compilation_cache(warm_start.cache_dir)
+    # recovery is DEFAULT-ON for the batched engine: transient
+    # faults retry with backoff, OOM bisects at the canonical chunk
+    # shape, an exhausted budget becomes a failed row, and every
+    # action lands in dispatch_faults{reason,action} (shared with
+    # the warm-start registry so one export sees both)
+    faults = FaultPolicy(
+        plan=(FaultPlan.parse(args.inject_faults)
+              if args.inject_faults else None),
+        registry=(warm_start.registry if warm_start is not None
+                  else None))
+    journal = None
+    if args.resume and (warm_start is None
+                        or not warm_start.rows_enabled):
+        ap.error("--resume replays the journal against the row "
+                 "cache (drop --no-row-cache/--no-warm-start)")
+    if warm_start is not None and warm_start.rows_enabled:
+        meta = journal_meta(grid, peers=args.peers,
+                            segments=args.segments,
+                            watch_s=args.watch_s, live=args.live,
+                            seed=args.seed,
+                            record_every=args.record_every)
+        jpath = journal_path(warm_start.cache_dir, meta)
+        if args.resume and not os.path.exists(jpath):
+            ap.error(f"--resume: no journal for this sweep "
+                     f"configuration ({jpath})")
+        journal = SweepJournal(jpath, meta, resume=args.resume)
+        if args.resume:
+            print(f"# resume: journal lists "
+                  f"{len(journal.completed)} completed rows; "
+                  f"replaying against the row cache",
+                  file=sys.stderr)
     t0 = time.perf_counter()
     rows, info = engine(
         grid, peers=args.peers, segments=args.segments,
         watch_s=args.watch_s, live=args.live, seed=args.seed,
         chunk=args.chunk, record_every=args.record_every,
-        warm_start=warm_start)
+        warm_start=warm_start, faults=faults, journal=journal)
     elapsed = time.perf_counter() - t0
     # with the warm-start engine active, the honest compile count is
     # the number of FRESH program compiles it performed (cache misses
@@ -462,39 +555,48 @@ def main():
         columns = timeline_columns(
             build_config(args.peers, args.segments, args.live,
                          grid[0]["degree"]))
-        with open(args.timelines_out, "w", encoding="utf-8") as f:
-            for row, tl in zip(rows, timelines):
-                f.write(json.dumps({
-                    **{k: v for k, v in row.items()
-                       if k not in ("offload", "rebuffer")},
-                    "offload": row["offload"],
-                    "rebuffer": row["rebuffer"],
-                    "record_every": args.record_every,
-                    "columns": list(columns),
-                    # FULL precision: the artifact's last sample IS
-                    # the final-state metric pair (the row's
-                    # offload/rebuffer are the table-rounded view of
-                    # the same numbers), so completeness checks hold
-                    # on the file, not just in-process
-                    "samples": [[float(v) for v in sample]
-                                for sample in tl],
-                }) + "\n")
-        print(f"# wrote {len(rows)} timelines "
+        lines = []
+        for row, tl in zip(rows, timelines):
+            if tl is None:
+                continue  # a failed point computed no timeline
+            lines.append(json.dumps({
+                **{k: v for k, v in row.items()
+                   if k not in ("offload", "rebuffer")},
+                "offload": row["offload"],
+                "rebuffer": row["rebuffer"],
+                "record_every": args.record_every,
+                "columns": list(columns),
+                # FULL precision: the artifact's last sample IS
+                # the final-state metric pair (the row's
+                # offload/rebuffer are the table-rounded view of
+                # the same numbers), so completeness checks hold
+                # on the file, not just in-process
+                "samples": [[float(v) for v in sample]
+                            for sample in tl],
+            }))
+        # atomic: a crash mid-dump must never leave a truncated JSONL
+        atomic_write_text(args.timelines_out,
+                          "".join(line + "\n" for line in lines))
+        print(f"# wrote {len(lines)} timelines "
               f"({len(columns)} columns) to {args.timelines_out}",
               file=sys.stderr)
 
-    rows.sort(key=lambda r: (-r["offload"], r["rebuffer"]))
+    failed = [row for row in rows if row.get("failed")]
+    rows.sort(key=lambda r: (r["offload"] is None,
+                             -(r["offload"] or 0.0),
+                             r["rebuffer"] or 0.0))
     if args.json:
         for row in rows:
             print(json.dumps(row))
     else:
-        knob_names = [k for k in rows[0] if k not in ("offload", "rebuffer")]
+        knob_names = [k for k in rows[0]
+                      if k not in ("offload", "rebuffer", "failed")]
         header = " | ".join(f"{k:>15}" for k in knob_names
                             + ["offload", "rebuffer"])
         print(header)
         print("-" * len(header))
         for row in rows:
-            print(" | ".join(f"{row[k]!s:>15}" for k in knob_names
+            print(" | ".join(f"{row.get(k)!s:>15}" for k in knob_names
                              + ["offload", "rebuffer"]))
     mode = "sequential" if args.sequential else "batched"
     chunk_note = ("" if args.sequential else
@@ -512,29 +614,49 @@ def main():
               f"{ws['row']} (cache {ws['cache_dir']}; "
               f"--no-row-cache / --no-warm-start opt out)",
               file=sys.stderr)
+    fault_counts = faults.fault_counts()
+    if fault_counts or failed:
+        detail = ", ".join(f"{k}={v}"
+                           for k, v in sorted(fault_counts.items()))
+        print(f"# dispatch faults: {detail or 'none'}; "
+              f"{len(failed)} point"
+              f"{'s' if len(failed) != 1 else ''} failed "
+              f"(failed rows carry offload/rebuffer null; rerun "
+              f"with --resume to retry just those)",
+              file=sys.stderr)
     if args.out:
         device = jax.devices()[0]
-        with open(args.out, "w") as f:
-            json.dump({
-                "meta": {
-                    "peers": args.peers, "segments": args.segments,
-                    "watch_s": args.watch_s, "live": args.live,
-                    "elapsed_s": round(elapsed, 1),
-                    "grid_points": len(rows),
-                    "points_per_sec": round(len(rows) / elapsed, 3),
-                    "engine": mode,
-                    "chunk": info.get("chunk"),
-                    "chunk_autotuned": info.get("chunk_autotuned"),
-                    "compile_groups": n_compiles,
-                    "record_every": args.record_every or None,
-                    "platform": device.platform,
-                    "device_kind": getattr(device, "device_kind", "?"),
-                    "warm_start": (warm_start.summary()
-                                   if warm_start is not None else None),
-                },
-                "rows": rows,
-            }, f, indent=1)
+        atomic_write_json(args.out, {
+            "meta": {
+                "peers": args.peers, "segments": args.segments,
+                "watch_s": args.watch_s, "live": args.live,
+                "elapsed_s": round(elapsed, 1),
+                "grid_points": len(rows),
+                "points_per_sec": round(len(rows) / elapsed, 3),
+                "engine": mode,
+                "chunk": info.get("chunk"),
+                "chunk_autotuned": info.get("chunk_autotuned"),
+                "compile_groups": n_compiles,
+                "record_every": args.record_every or None,
+                "platform": device.platform,
+                "device_kind": getattr(device, "device_kind", "?"),
+                "warm_start": (warm_start.summary()
+                               if warm_start is not None else None),
+                "resume": bool(args.resume),
+                "dispatch_faults": fault_counts,
+                "failed_points": len(failed),
+                "failures": info.get("failures", []),
+            },
+            "rows": rows,
+        })
         print(f"# wrote {args.out}", file=sys.stderr)
+    if journal is not None:
+        # finalize ONLY a fully-successful sweep: a run with failed
+        # rows stays resumable (the failed points were never
+        # journaled, so --resume retries exactly those)
+        if not failed:
+            journal.finalize()
+        journal.close()
 
 
 if __name__ == "__main__":

@@ -17,7 +17,18 @@ pathologies the on-device metrics timelines were built to expose
   offload is below ``--stall-offload`` AND the gain over the last
   half of the window is below ``--stall-gain`` (a point that ends
   low but is still climbing is a short window, not a stall).
-- **Rebuffer burst vs join wave** (ROADMAP residual): a sample
+- **Stagger-window overshoot** (ROADMAP residual): a live point
+  configured with a CDN-stagger window (``spread_s > 0``) whose edge
+  cohort KEEPS pulling from the CDN past that window.  During the
+  window — restarted whenever a join wave lands, since arrivals
+  legitimately re-stagger — a high CDN byte share is the stagger's
+  configured cost; once the window (plus one sample interval) has
+  elapsed, delivery should have handed off to P2P.  Flagged when at
+  least ``--overshoot-frac`` of the post-window samples still carry
+  a CDN byte share at or above ``--overshoot-share``: the stagger is
+  not bounding the CDN load it exists to bound (supply too scarce,
+  or the edge cohort thrashing back to the CDN).
+- **Rebuffer burst vs join wave**: a sample
   window where a significant fraction of the present audience
   stalled (``stalled_peers`` ≥ ``--burst-frac`` of present peers) —
   flagged ONLY when the window is not coincident with a join wave
@@ -139,6 +150,68 @@ def detect_rebuffer_burst(columns, samples, *, burst_frac=0.25,
     return None
 
 
+def detect_stagger_overshoot(columns, samples, spread_s, *,
+                             overshoot_share=0.5, overshoot_frac=0.5,
+                             wave_frac=0.1):
+    """Stagger-window-overshoot finding dict, or None.
+
+    Applies only to points with a configured stagger window
+    (``spread_s > 0``).  The window restarts at first presence and
+    at every join wave (present-peer growth ≥ ``wave_frac`` of the
+    audience — the same wave rule the burst detector uses): a fresh
+    cohort staggering onto the CDN is the window working, not
+    overshooting.  A sample more than ``spread_s`` plus one sample
+    interval past the latest window start is POST-WINDOW; among
+    post-window samples with any delivery, those whose CDN byte
+    share (``cdn_rate / (cdn_rate + p2p_rate)``) is at or above
+    ``overshoot_share`` are overshooting.  Flags when at least
+    ``overshoot_frac`` of (two or more) post-window samples
+    overshoot, reporting the worst share and the first offending
+    sample clock."""
+    if not spread_s or spread_s <= 0 or len(samples) < 2:
+        return None
+    t_col = columns.index("t_s")
+    cdn_col = columns.index("cdn_rate_bps")
+    p2p_col = columns.index("p2p_rate_bps")
+    level_cols = [i for i, c in enumerate(columns)
+                  if c.startswith("level_") and c.endswith("_peers")]
+    interval = samples[1][t_col] - samples[0][t_col]
+    window_start = None
+    prev_present = None
+    post = over = 0
+    worst = 0.0
+    first_t = None
+    for sample in samples:
+        present = sum(sample[i] for i in level_cols)
+        if present <= 0:
+            prev_present = present
+            continue
+        grew = present - (prev_present or 0.0)
+        if window_start is None or grew >= wave_frac * present:
+            window_start = sample[t_col]
+        prev_present = present
+        if sample[t_col] - window_start <= spread_s + interval:
+            continue
+        total = sample[cdn_col] + sample[p2p_col]
+        if total <= 0:
+            continue
+        post += 1
+        share = sample[cdn_col] / total
+        if share >= overshoot_share:
+            over += 1
+            worst = max(worst, share)
+            if first_t is None:
+                first_t = sample[t_col]
+    if post >= 2 and over / post >= overshoot_frac:
+        return {"reason": "stagger_overshoot",
+                "window_s": spread_s,
+                "post_window_samples": post,
+                "overshoot_samples": over,
+                "worst_cdn_share": round(worst, 4),
+                "first_t_s": round(first_t, 3)}
+    return None
+
+
 def knob_label(record):
     """Compact ``k=v`` knob summary for one record's triage line."""
     return " ".join(f"{k}={v}" for k, v in record.items()
@@ -147,7 +220,8 @@ def knob_label(record):
 
 def triage_records(records, *, min_flips=4, osc_frac=0.25,
                    stall_offload=0.2, stall_gain=0.02,
-                   burst_frac=0.25, wave_frac=0.1):
+                   burst_frac=0.25, wave_frac=0.1,
+                   overshoot_share=0.5, overshoot_frac=0.5):
     """Findings list: ``{"point", "knobs", "findings": [...]}`` per
     flagged record, in file order."""
     triaged = []
@@ -163,6 +237,11 @@ def triage_records(records, *, min_flips=4, osc_frac=0.25,
             detect_rebuffer_burst(columns, samples,
                                   burst_frac=burst_frac,
                                   wave_frac=wave_frac),
+            detect_stagger_overshoot(columns, samples,
+                                     record.get("spread_s"),
+                                     overshoot_share=overshoot_share,
+                                     overshoot_frac=overshoot_frac,
+                                     wave_frac=wave_frac),
         ) if f is not None]
         if findings:
             triaged.append({"point": idx, "knobs": knob_label(record),
@@ -180,6 +259,13 @@ def _describe(finding):
                 f"t={finding['first_t_s']}s; "
                 f"{finding['join_wave_coincident']} join-wave windows "
                 f"excused)")
+    if finding["reason"] == "stagger_overshoot":
+        return (f"stagger_overshoot ({finding['overshoot_samples']}/"
+                f"{finding['post_window_samples']} post-window "
+                f"samples ≥ CDN share bar, worst "
+                f"{finding['worst_cdn_share']:.0%}, first at "
+                f"t={finding['first_t_s']}s past the "
+                f"{finding['window_s']}s window)")
     return (f"offload_stall (final {finding['final_offload']}, "
             f"last-half gain {finding['last_half_gain']})")
 
@@ -212,7 +298,16 @@ def main(argv=None):
     ap.add_argument("--wave-frac", type=float, default=0.1,
                     help="present-peer growth share that makes the "
                          "same window a join wave, excusing its "
-                         "burst (default 0.1)")
+                         "burst (and restarting the stagger window; "
+                         "default 0.1)")
+    ap.add_argument("--overshoot-share", type=float, default=0.5,
+                    help="CDN byte share at or above which a "
+                         "post-window sample counts as the edge "
+                         "cohort still pulling CDN (default 0.5)")
+    ap.add_argument("--overshoot-frac", type=float, default=0.5,
+                    help="fraction of post-window samples over the "
+                         "share bar before a point is flagged as "
+                         "stagger overshoot (default 0.5)")
     args = ap.parse_args(argv)
 
     with open(args.timelines, encoding="utf-8") as f:
@@ -220,7 +315,9 @@ def main(argv=None):
     triaged = triage_records(
         records, min_flips=args.min_flips, osc_frac=args.osc_frac,
         stall_offload=args.stall_offload, stall_gain=args.stall_gain,
-        burst_frac=args.burst_frac, wave_frac=args.wave_frac)
+        burst_frac=args.burst_frac, wave_frac=args.wave_frac,
+        overshoot_share=args.overshoot_share,
+        overshoot_frac=args.overshoot_frac)
 
     if args.json:
         for entry in triaged:
@@ -234,7 +331,8 @@ def main(argv=None):
     print(f"# triaged {len(records)} timelines: {len(triaged)} "
           f"flagged ({reasons.count('ladder_oscillation')} "
           f"oscillating, {reasons.count('offload_stall')} stalled, "
-          f"{reasons.count('rebuffer_burst')} bursting)",
+          f"{reasons.count('rebuffer_burst')} bursting, "
+          f"{reasons.count('stagger_overshoot')} overshooting)",
           file=sys.stderr)
     return 1 if (args.strict and triaged) else 0
 
