@@ -4,7 +4,8 @@
 PY ?= python
 
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
-	coverage soak scaling-artifact warmstart-gate chaos-gate
+	coverage soak scaling-artifact warmstart-gate chaos-gate \
+	fleet-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -77,6 +78,18 @@ warmstart-gate:
 chaos-gate:
 	$(PY) tools/chaos_gate.py
 
+# process-level multi-host proof (engine/fabric.py): the VOD grid
+# sharded across 3 worker processes through the lease-based work
+# ledger, with one worker SIGKILLed mid-grid and another stalled
+# past its lease (stolen while still alive) — the merged artifact
+# must be bit-identical (float.hex) to a single-host fault-free
+# reference, every steal/expiry/duplicate counted in fabric_claims
+# AND in the claim files, and the killed host's finalized rows
+# recovered from the row cache.  FLEET_GATE_PEERS etc. scale it up;
+# FLEET_GATE_LEASE_S stretches the lease on slow hosts.
+fleet-gate:
+	$(PY) tools/fleet_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -85,6 +98,6 @@ examples:
 	$(PY) examples/swarm_demo.py --live
 	$(PY) examples/production_demo.py
 
-check: lint test dryrun warmstart-gate chaos-gate
+check: lint test dryrun warmstart-gate chaos-gate fleet-gate
 
 all: check bench

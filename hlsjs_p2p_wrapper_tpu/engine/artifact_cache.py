@@ -367,16 +367,65 @@ def _atomic_write(path: str, data: bytes) -> None:
     atomic_write_bytes(path, data, durable=False)
 
 
+def read_jsonl_records(path: str):
+    """Stream the parseable records of an append-only JSON-lines
+    file, skipping blank lines and unparsable fragments — the ONE
+    torn-tail-tolerance protocol shared by the sweep journal and the
+    fabric's claim files (engine/fabric.py): every whole line was
+    fsync'd before its writer moved on, so a skipped fragment is at
+    most the record a crash interrupted, which recomputes."""
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue
+
+
 # -- the crash-safe sweep journal --------------------------------------
 
-def journal_path(cache_dir: str, meta: dict) -> str:
+def journal_path(cache_dir: str, meta: dict,
+                 host_id: Optional[str] = None) -> str:
     """Journal location for one sweep identity: co-located with the
     row cache (``journals/`` under the warm-start root) and
     content-addressed by the sweep's meta — two different sweeps can
-    never clobber each other's progress."""
-    return os.path.join(cache_dir, "journals",
-                        _digest({"kind": "sweep-journal", **meta})
-                        + ".jsonl")
+    never clobber each other's progress.
+
+    ``host_id=None`` (the single-host default) keeps the original
+    ``journals/<digest>.jsonl`` layout BYTE-COMPATIBLE with previous
+    rounds.  With a ``host_id``, the journal is that host's PRIVATE
+    shard ``journals/<digest>/<host_id>.jsonl``: two processes
+    appending to one journal path interleave unsynchronized (flush +
+    fsync order races can tear each other's lines), so the multi-host
+    fabric gives every host its own append-only shard and readers
+    merge (:func:`journal_shards`, ``SweepJournal(merge=...)``)."""
+    digest = _digest({"kind": "sweep-journal", **meta})
+    if host_id is None:
+        return os.path.join(cache_dir, "journals", digest + ".jsonl")
+    return os.path.join(cache_dir, "journals", digest,
+                        f"{host_id}.jsonl")
+
+
+def journal_shards(cache_dir: str, meta: dict) -> list:
+    """Every existing journal file for one sweep identity, merged-read
+    order: the legacy single-host file first, then the per-host
+    shards sorted by host id.  The merged completed-row set of a
+    sweep is the union over these (each shard is torn-tail tolerant
+    independently)."""
+    digest = _digest({"kind": "sweep-journal", **meta})
+    paths = []
+    legacy = os.path.join(cache_dir, "journals", digest + ".jsonl")
+    if os.path.exists(legacy):
+        paths.append(legacy)
+    shard_dir = os.path.join(cache_dir, "journals", digest)
+    if os.path.isdir(shard_dir):
+        paths.extend(os.path.join(shard_dir, name)
+                     for name in sorted(os.listdir(shard_dir))
+                     if name.endswith(".jsonl"))
+    return paths
 
 
 class SweepJournal:
@@ -400,14 +449,37 @@ class SweepJournal:
     marker written by :meth:`finalize` AFTER the artifact is in place
     (the artifact write itself is atomic via
     :func:`atomic_write_bytes`).  Reading tolerates a torn trailing
-    line — the one artifact a mid-append SIGKILL can leave."""
+    line — the one artifact a mid-append SIGKILL can leave.
 
-    def __init__(self, path: str, meta: dict, *, resume: bool = False):
+    ``merge`` names OTHER journal files of the same sweep identity
+    (the per-host shards :func:`journal_path` lays out under
+    ``journals/<digest>/``) whose completed rows are folded into
+    ``completed`` read-only — the merged-reader half of the fabric's
+    per-host sharding: this journal only ever APPENDS to its own
+    ``path``, so concurrent hosts never interleave writes.  A merged
+    shard with a mismatched meta digest is refused exactly like a
+    mismatched resume."""
+
+    def __init__(self, path: str, meta: dict, *, resume: bool = False,
+                 merge=()):
         self.path = path
         self.digest = _digest({"kind": "sweep-journal", **meta})
         self.completed: set = set()
         self.finished = False
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        for other in merge:
+            if os.path.abspath(other) == os.path.abspath(path):
+                continue  # own shard is read by the resume path below
+            for record in self._read(other):
+                kind = record.get("kind")
+                if kind == "meta":
+                    if record.get("digest") != self.digest:
+                        raise ValueError(
+                            f"journal shard {other} was written by a "
+                            f"different sweep configuration — not "
+                            f"merging it")
+                elif kind == "row":
+                    self.completed.add(record["key"])
         if resume and os.path.exists(path):
             for record in self._read():
                 kind = record.get("kind")
@@ -439,20 +511,8 @@ class SweepJournal:
             self._fh = open(path, "w", encoding="utf-8")
             self._append({"kind": "meta", "digest": self.digest})
 
-    def _read(self):
-        with open(self.path, encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    yield json.loads(line)
-                except ValueError:
-                    # torn tail from a crash mid-append: every
-                    # earlier line was fsync'd whole, so skipping the
-                    # fragment loses at most the row that was being
-                    # recorded when the process died — it recomputes
-                    continue
+    def _read(self, path: Optional[str] = None):
+        yield from read_jsonl_records(path or self.path)
 
     def _append(self, *records: dict) -> None:
         self._fh.write("".join(json.dumps(record) + "\n"

@@ -1427,6 +1427,40 @@ AUTOTUNE_FALLBACK_BYTES = 4 << 30
 #: not get to pick an unbounded batch
 MAX_AUTOTUNE_CHUNK = 64
 
+#: how many bisected OOMs this process has seen (the dispatch engine
+#: bumps it via :func:`note_oom_bisection`): a chunk the autotuner
+#: sized from ``memory_stats`` that still OOM'd is the autotuner
+#: telling on itself, so every later :func:`autotune_chunk` call in
+#: the same process derives its cap from a HALVED memory fraction
+#: per bisection (floored at 1/16 of the base fraction — past that
+#: the chunk floor of 1 dominates anyway)
+_OOM_BISECTIONS = 0
+
+
+def note_oom_bisection() -> None:
+    """Record one OOM-triggered chunk bisection (called by the
+    dispatch engine's recovery path)."""
+    global _OOM_BISECTIONS
+    _OOM_BISECTIONS += 1
+
+
+def oom_bisections() -> int:
+    return _OOM_BISECTIONS
+
+
+def reset_oom_feedback() -> None:
+    """Forget recorded OOM bisections (test isolation hook)."""
+    global _OOM_BISECTIONS
+    _OOM_BISECTIONS = 0
+
+
+def autotune_memory_fraction() -> float:
+    """The memory fraction :func:`autotune_chunk` commits, shrunk by
+    the process's bisected-OOM history (the ROADMAP's
+    ``dispatch_faults{reason=oom}`` feedback: a bisected OOM means
+    the analytic footprint model under-counted, so trust it less)."""
+    return AUTOTUNE_MEMORY_FRACTION * (0.5 ** min(_OOM_BISECTIONS, 4))
+
 
 def batch_lane_bytes(config: SwarmConfig, n_steps: int, *,
                      record_every: int = 0, n_neighbors: int = 0,
@@ -1505,8 +1539,349 @@ def autotune_chunk(config: SwarmConfig, n_items: int, n_steps: int, *,
         free = AUTOTUNE_FALLBACK_BYTES
     lane = batch_lane_bytes(config, n_steps, record_every=record_every,
                             n_neighbors=n_neighbors, scenario=scenario)
-    fit = int(free * AUTOTUNE_MEMORY_FRACTION // max(lane, 1))
+    fit = int(free * autotune_memory_fraction() // max(lane, 1))
     return max(1, min(fit, n_items, MAX_AUTOTUNE_CHUNK))
+
+
+class RowEvent(NamedTuple):
+    """One completed (or failed) sweep row, streamed out of
+    :func:`stream_groups_chunked` the moment its chunk drains —
+    row-cache hits first, then dispatch results in drain order.
+
+    ``metric`` is the ``(offload, rebuffer[, timeline])`` tuple, or
+    ``None`` for a row whose recovery budget ran out (``reason`` /
+    ``error`` then carry the structured failure).  ``key`` is the
+    layer-2 row-cache key when the warm-start row cache is on (the
+    same key the journal records), ``cached`` marks rows served by
+    the row cache without a dispatch."""
+
+    group: int
+    index: int               # position in the group's item list
+    metric: object           # tuple, or None when failed
+    key: Optional[str] = None
+    cached: bool = False
+    reason: Optional[str] = None
+    error: Optional[str] = None
+
+
+def stream_groups_chunked(groups, n_steps: int, *, watch_s: float,
+                          chunk: Optional[int] = None,
+                          record_every: int = 0, tracer=None,
+                          pipeline: bool = True,
+                          interleave: bool = True,
+                          warm_start=None, faults=None, journal=None,
+                          stats_out=None, exact_chunk: bool = False):
+    """The chunked, pipelined dispatch engine as a ROW STREAM: a
+    generator yielding one :class:`RowEvent` per grid row as its
+    chunk drains (row-cache hits up front, dispatched rows one
+    pipelined chunk behind the device), instead of holding every
+    result behind the end-of-grid barrier.  Consumers — the journal,
+    the layer-2 row cache, the multi-host fabric's partial-artifact
+    writer (engine/fabric.py), triage — see rows the moment they are
+    durable, so a consumer that dies mid-grid has still consumed
+    every drained row.
+
+    :func:`run_groups_chunked` is the barrier-shaped wrapper (same
+    ``(results, stats)`` contract as before this round); this
+    generator is the engine.  All parameters match
+    :func:`run_groups_chunked` except:
+
+    - ``stats_out``: an optional list the per-group stats dicts are
+      appended to as groups are prepared (the same dicts the wrapper
+      returns — they keep updating as the stream advances, and are
+      also this generator's ``return`` value);
+    - ``exact_chunk=True`` makes an explicit ``chunk`` the canonical
+      batch shape even when a group holds fewer items (the fabric's
+      work units are chunk-sized slices whose TAIL unit is smaller,
+      but every host must dispatch the one fleet-wide ``[B, P, …]``
+      program shape or steals would recompile and re-key the AOT
+      cache; padding lanes are repeats, and vmap lanes are
+      independent, so the padded tail is bit-identical to the
+      single-host schedule).
+
+    Fault/journal/warm-start semantics are those documented on
+    :func:`run_groups_chunked`: a failed row streams as a
+    ``RowEvent`` with ``metric=None`` and the failure ``reason``, and
+    is also appended to its group's ``stats["failures"]``."""
+    rows_on = warm_start is not None and warm_start.rows_enabled
+    aot_on = warm_start is not None and warm_start.aot_enabled
+    groups = [(config, list(items), build)
+              for config, items, build in groups]
+    hit_events = []
+    prepared = []
+    for gi, (config, items, build) in enumerate(groups):
+        keep = list(range(len(items)))
+        keys = None
+        if rows_on:
+            # layer-2 prefilter: build each item once for its
+            # content hash, stream hits immediately, dispatch only
+            # the misses
+            keep, keys = [], []
+            for idx, item in enumerate(items):
+                scenario, join = build(item)
+                key = warm_start.row_key(config, scenario, join,
+                                         n_steps, watch_s=watch_s,
+                                         record_every=record_every)
+                cached = warm_start.row_load(key)
+                if (cached is not None
+                        and (len(cached) > 2) == bool(record_every)):
+                    hit_events.append(RowEvent(gi, idx, cached,
+                                               key=key, cached=True))
+                else:
+                    keep.append(idx)
+                    keys.append(key)
+        if chunk is None:
+            # probe-build one lane so the autotuner sizes the REAL
+            # scenario footprint (the general [P, K] path's
+            # neighbor/inverse-edge matrices and the adaptive
+            # penalty width are invisible to the analytic fallback);
+            # costs one duplicate build per group, amortized over
+            # every chunk
+            probe = build(items[keep[0]])[0] if keep else None
+            batch = autotune_chunk(config, len(items), n_steps,
+                                   record_every=record_every,
+                                   scenario=probe)
+        elif exact_chunk:
+            batch = max(chunk, 1)
+        else:
+            batch = max(min(chunk, len(items)), 1)
+        # the batch cap uses the PRE-FILTER item count, not len(keep):
+        # the dispatch shape must not depend on how many rows the
+        # cache served, or a partially-warm rerun (grid grew by a few
+        # points) would re-key the [B, P, …] program and throw away
+        # its cached layer-1 executable to save some padded lanes —
+        # trading a fresh XLA compile (~40 s/program on TPU v5e) for
+        # pad compute is the wrong side of the bargain
+        prepared.append((config, items, build, batch, keep, keys))
+    stats = [{"items": len(items), "chunk": batch, "chunks": 0,
+              "row_hits": len(items) - len(keep),
+              "first_dispatch_s": None, "failures": []}
+             for _, items, _, batch, keep, _ in prepared]
+    if stats_out is not None:
+        stats_out.extend(stats)
+    # hits stream before any dispatch: they are already durable in
+    # the row cache, so consumers may act on them immediately
+    for event in hit_events:
+        yield event
+
+    starts = [list(range(0, len(keep), batch))
+              for _, _, _, batch, keep, _ in prepared]
+    schedule = []  # (group idx, group-local chunk idx, keep offset)
+    if interleave:
+        ci = 0
+        while any(ci < len(s) for s in starts):
+            schedule.extend((gi, ci, s[ci])
+                            for gi, s in enumerate(starts)
+                            if ci < len(s))
+            ci += 1
+    else:
+        for gi, s in enumerate(starts):
+            schedule.extend((gi, ci, off) for ci, off in enumerate(s))
+
+    def _classify(exc):
+        return faults.classify(exc) if faults is not None else None
+
+    def _dispatch_built(gi, ci, config, built, batch, block):
+        """One padded dispatch attempt of ``len(built)`` real lanes:
+        repeat-pad to the canonical ``batch`` shape, stack, run.
+        Retries and bisected halves re-enter here, so every attempt
+        dispatches the IDENTICAL program shape — recovery can never
+        trigger a compile."""
+        if faults is not None:
+            faults.before_dispatch(group=gi, chunk=ci)
+        padded = built + [built[-1]] * (batch - len(built))
+        scenarios = stack_pytrees([sc for sc, _ in padded])
+        joins = jnp.stack([j for _, j in padded])
+        states = stack_pytrees([init_swarm(config)] * batch)
+        if aot_on:
+            states = ensure_penalty_width_batch(config, scenarios,
+                                                states)
+            runner = warm_start.batch_runner(
+                config, scenarios, states, n_steps,
+                record_every=record_every, donate_scenarios=True)
+            res = runner(scenarios, states)
+        else:
+            res = run_swarm_batch(config, scenarios, states, n_steps,
+                                  record_every=record_every,
+                                  donate_scenarios=True)
+        finals = res[0]
+        rows = res[2] if record_every else None
+        offs = offload_ratio_batch(finals)
+        rebs = rebuffer_ratio_batch(finals, watch_s, joins)
+        if block:
+            # the drain-per-chunk mode is the overlap-measurement
+            # BASELINE: dispatch is async, so without this wait the
+            # readback span would absorb the device-compute time and
+            # deflate the overlap metric's denominator contract
+            # ("blocking readback hidden under compute").  Recovery
+            # re-dispatches also block: a classified fault must
+            # surface HERE, inside the retry loop, not at readback.
+            for arr in (offs, rebs) + (() if rows is None
+                                       else (rows,)):
+                arr.block_until_ready()
+        return offs, rebs, rows
+
+    def _dispatch_resilient(gi, ci, config, built, batch, start,
+                            block):
+        """Dispatch ``built`` (``start``-offset within the chunk's
+        kept list) under the fault policy's bounded recovery.
+
+        Returns ``(segments, failures)``: ``segments`` is a list of
+        ``(start, n, offs, rebs, rows)`` device-array pieces covering
+        the lanes that dispatched (still async unless ``block``), and
+        ``failures`` lists ``{"offset", "count", "reason", "error"}``
+        for lanes whose recovery budget ran out.  Without a policy
+        the first exception propagates — exactly the pre-fault-plane
+        behavior."""
+        attempt = 0
+        while True:
+            try:
+                out = _dispatch_built(gi, ci, config, built, batch,
+                                      block)
+                return [(start, len(built)) + out], []
+            except Exception as exc:  # fault-ok: classified below —
+                # unrecognized reasons (shape errors, typos) re-raise
+                reason = _classify(exc)
+                if reason is None:
+                    raise
+                if reason == "oom" and len(built) > 1:
+                    # bisect: each half re-dispatches PADDED BACK to
+                    # the canonical chunk shape — zero new XLA
+                    # compiles, no AOT-cache re-keying — and recurses
+                    # down to single lanes.  NOTE the shape (and so
+                    # the allocation) is unchanged: bisection
+                    # NARROWS the blast radius of a persistent OOM
+                    # to per-lane structured failures rather than
+                    # relieving memory — transient pressure is
+                    # handled by the backoff-retry below, while
+                    # note_oom_bisection() feeds the event back into
+                    # autotune_chunk's memory fraction so the NEXT
+                    # autotuned dispatch in this process sizes a
+                    # smaller chunk
+                    faults.record(reason, "bisect")
+                    note_oom_bisection()
+                    mid = (len(built) + 1) // 2
+                    left = _dispatch_resilient(
+                        gi, ci, config, built[:mid], batch, start,
+                        block)
+                    right = _dispatch_resilient(
+                        gi, ci, config, built[mid:], batch,
+                        start + mid, block)
+                    return left[0] + right[0], left[1] + right[1]
+                # transient / timeout — and a single lane's OOM,
+                # which cannot bisect further but is often another
+                # process's memory burst: jittered backoff within
+                # the budget, then a structured give-up
+                if attempt >= faults.max_retries:
+                    faults.record(reason, "giveup")
+                    return [], [{"offset": start, "count": len(built),
+                                 "reason": reason, "error": str(exc)}]
+                faults.record(reason, "retry")
+                faults.sleep_backoff(attempt)
+                attempt += 1
+
+    pending = None  # (gi, ci, kept, keys, segments, failures, ctx)
+
+    def drain(entry):
+        """Readback + durability for one dispatched chunk; returns
+        the chunk's :class:`RowEvent` list (rows first, then failed
+        items), emitted by the caller AFTER the readback span
+        closes."""
+        (gi, ci, kept, kept_keys, segments, failures, config, built,
+         batch) = entry
+        events = []
+        with _span(tracer, "readback", group=gi, chunk=ci):
+            journaled = []
+            work = list(segments)
+            while work:
+                start, n, offs, rebs, rows = work.pop(0)
+                try:
+                    # host-side transfer THEN slice: slicing the
+                    # device array at a sub-chunk length (bisected
+                    # halves) would compile a fresh slice program
+                    # per length — recovery must stay compile-free
+                    offs_np = np.asarray(offs)[:n]
+                    rebs_np = np.asarray(rebs)[:n]
+                    if rows is None:
+                        out = [(float(o), float(r))
+                               for o, r in zip(offs_np, rebs_np)]
+                    else:
+                        arr = np.asarray(rows)
+                        out = [(float(o), float(r), arr[lane])
+                               for lane, (o, r) in enumerate(
+                                   zip(offs_np, rebs_np))]
+                except Exception as exc:  # fault-ok: classified —
+                    # unrecognized readback failures re-raise
+                    reason = _classify(exc)
+                    if reason is None:
+                        raise
+                    # an async dispatch fault surfacing at readback:
+                    # count it, then re-dispatch the segment through
+                    # the same recovery path, BLOCKING (a blocked
+                    # success cannot fault again at conversion)
+                    faults.record(reason, "retry")
+                    resegs, refails = _dispatch_resilient(
+                        gi, ci, config, built[start:start + n], batch,
+                        start, True)
+                    work = resegs + work
+                    failures = failures + refails
+                    continue
+                for pos, metric in enumerate(out):
+                    key = (kept_keys[start + pos]
+                           if kept_keys is not None else None)
+                    if key is not None:
+                        warm_start.row_store(key, metric)
+                        if journal is not None:
+                            journaled.append(key)
+                    events.append(RowEvent(gi, kept[start + pos],
+                                           metric, key=key))
+            if journal is not None and journaled:
+                # durable progress: the drained chunk's row keys
+                # under ONE fsync before the engine moves on — what
+                # --resume replays against the row cache (a
+                # mid-drain crash loses only this chunk, which
+                # recomputes)
+                journal.record_rows(journaled)
+            for failure in failures:
+                stats[gi]["failures"].append({
+                    "items": [kept[failure["offset"] + j]
+                              for j in range(failure["count"])],
+                    "reason": failure["reason"],
+                    "error": failure["error"]})
+                events.extend(
+                    RowEvent(gi, kept[failure["offset"] + j], None,
+                             reason=failure["reason"],
+                             error=failure["error"])
+                    for j in range(failure["count"]))
+        return events
+
+    for gi, ci, off in schedule:
+        config, items, build, batch, keep, keys = prepared[gi]
+        kept = keep[off:off + batch]
+        kept_keys = keys[off:off + batch] if keys is not None else None
+        with _span(tracer, "build", group=gi, chunk=ci):
+            built = [build(items[i]) for i in kept]
+        t0 = time.perf_counter()
+        with _span(tracer, "dispatch", group=gi, chunk=ci):
+            segments, failures = _dispatch_resilient(
+                gi, ci, config, built, batch, 0, not pipeline)
+        if stats[gi]["first_dispatch_s"] is None:
+            stats[gi]["first_dispatch_s"] = time.perf_counter() - t0
+        stats[gi]["chunks"] += 1
+        entry = (gi, ci, kept, kept_keys, segments, failures, config,
+                 built, batch)
+        if not pipeline:
+            for event in drain(entry):
+                yield event
+            continue
+        if pending is not None:
+            for event in drain(pending):
+                yield event
+        pending = entry
+    if pending is not None:
+        for event in drain(pending):
+            yield event
+    return stats
 
 
 def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
@@ -1517,6 +1892,10 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
     """Chunked, pipelined dispatch over MULTIPLE compile groups — the
     engine under :func:`run_batch_chunked` (one group) and
     ``tools/sweep.py`` (one group per remaining static knob value).
+    Since the fabric round this is a thin barrier-shaped wrapper over
+    :func:`stream_groups_chunked` (the row-streaming generator the
+    multi-host fabric consumes directly): it drains the stream and
+    returns everything at once, with the contract below unchanged.
 
     ``groups`` is a sequence of ``(config, items, build)`` triples;
     ``build(item)`` returns one item's ``(scenario, join_s [P])``
@@ -1610,256 +1989,18 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
     zero recompute of completed rows.  Requires ``warm_start`` with
     the row cache enabled (the journal records keys, the cache holds
     the values)."""
-    rows_on = warm_start is not None and warm_start.rows_enabled
-    aot_on = warm_start is not None and warm_start.aot_enabled
     groups = [(config, list(items), build)
               for config, items, build in groups]
     results = [[None] * len(items) for _, items, _ in groups]
-    prepared = []
-    for gi, (config, items, build) in enumerate(groups):
-        keep = list(range(len(items)))
-        keys = None
-        if rows_on:
-            # layer-2 prefilter: build each item once for its
-            # content hash, fill hits, dispatch only the misses
-            keep, keys = [], []
-            for idx, item in enumerate(items):
-                scenario, join = build(item)
-                key = warm_start.row_key(config, scenario, join,
-                                         n_steps, watch_s=watch_s,
-                                         record_every=record_every)
-                cached = warm_start.row_load(key)
-                if (cached is not None
-                        and (len(cached) > 2) == bool(record_every)):
-                    results[gi][idx] = cached
-                else:
-                    keep.append(idx)
-                    keys.append(key)
-        if chunk is None:
-            # probe-build one lane so the autotuner sizes the REAL
-            # scenario footprint (the general [P, K] path's
-            # neighbor/inverse-edge matrices and the adaptive
-            # penalty width are invisible to the analytic fallback);
-            # costs one duplicate build per group, amortized over
-            # every chunk
-            probe = build(items[keep[0]])[0] if keep else None
-            batch = autotune_chunk(config, len(items), n_steps,
-                                   record_every=record_every,
-                                   scenario=probe)
-        else:
-            batch = max(min(chunk, len(items)), 1)
-        # the batch cap uses the PRE-FILTER item count, not len(keep):
-        # the dispatch shape must not depend on how many rows the
-        # cache served, or a partially-warm rerun (grid grew by a few
-        # points) would re-key the [B, P, …] program and throw away
-        # its cached layer-1 executable to save some padded lanes —
-        # trading a fresh XLA compile (~40 s/program on TPU v5e) for
-        # pad compute is the wrong side of the bargain
-        prepared.append((config, items, build, batch, keep, keys))
-    stats = [{"items": len(items), "chunk": batch, "chunks": 0,
-              "row_hits": len(items) - len(keep),
-              "first_dispatch_s": None, "failures": []}
-             for _, items, _, batch, keep, _ in prepared]
-
-    starts = [list(range(0, len(keep), batch))
-              for _, _, _, batch, keep, _ in prepared]
-    schedule = []  # (group idx, group-local chunk idx, keep offset)
-    if interleave:
-        ci = 0
-        while any(ci < len(s) for s in starts):
-            schedule.extend((gi, ci, s[ci])
-                            for gi, s in enumerate(starts)
-                            if ci < len(s))
-            ci += 1
-    else:
-        for gi, s in enumerate(starts):
-            schedule.extend((gi, ci, off) for ci, off in enumerate(s))
-
-    def _classify(exc):
-        return faults.classify(exc) if faults is not None else None
-
-    def _dispatch_built(gi, ci, config, built, batch, block):
-        """One padded dispatch attempt of ``len(built)`` real lanes:
-        repeat-pad to the canonical ``batch`` shape, stack, run.
-        Retries and bisected halves re-enter here, so every attempt
-        dispatches the IDENTICAL program shape — recovery can never
-        trigger a compile."""
-        if faults is not None:
-            faults.before_dispatch(group=gi, chunk=ci)
-        padded = built + [built[-1]] * (batch - len(built))
-        scenarios = stack_pytrees([sc for sc, _ in padded])
-        joins = jnp.stack([j for _, j in padded])
-        states = stack_pytrees([init_swarm(config)] * batch)
-        if aot_on:
-            states = ensure_penalty_width_batch(config, scenarios,
-                                                states)
-            runner = warm_start.batch_runner(
-                config, scenarios, states, n_steps,
-                record_every=record_every, donate_scenarios=True)
-            res = runner(scenarios, states)
-        else:
-            res = run_swarm_batch(config, scenarios, states, n_steps,
-                                  record_every=record_every,
-                                  donate_scenarios=True)
-        finals = res[0]
-        rows = res[2] if record_every else None
-        offs = offload_ratio_batch(finals)
-        rebs = rebuffer_ratio_batch(finals, watch_s, joins)
-        if block:
-            # the drain-per-chunk mode is the overlap-measurement
-            # BASELINE: dispatch is async, so without this wait the
-            # readback span would absorb the device-compute time and
-            # deflate the overlap metric's denominator contract
-            # ("blocking readback hidden under compute").  Recovery
-            # re-dispatches also block: a classified fault must
-            # surface HERE, inside the retry loop, not at readback.
-            for arr in (offs, rebs) + (() if rows is None
-                                       else (rows,)):
-                arr.block_until_ready()
-        return offs, rebs, rows
-
-    def _dispatch_resilient(gi, ci, config, built, batch, start,
-                            block):
-        """Dispatch ``built`` (``start``-offset within the chunk's
-        kept list) under the fault policy's bounded recovery.
-
-        Returns ``(segments, failures)``: ``segments`` is a list of
-        ``(start, n, offs, rebs, rows)`` device-array pieces covering
-        the lanes that dispatched (still async unless ``block``), and
-        ``failures`` lists ``{"offset", "count", "reason", "error"}``
-        for lanes whose recovery budget ran out.  Without a policy
-        the first exception propagates — exactly the pre-fault-plane
-        behavior."""
-        attempt = 0
-        while True:
-            try:
-                out = _dispatch_built(gi, ci, config, built, batch,
-                                      block)
-                return [(start, len(built)) + out], []
-            except Exception as exc:  # fault-ok: classified below —
-                # unrecognized reasons (shape errors, typos) re-raise
-                reason = _classify(exc)
-                if reason is None:
-                    raise
-                if reason == "oom" and len(built) > 1:
-                    # bisect: each half re-dispatches PADDED BACK to
-                    # the canonical chunk shape — zero new XLA
-                    # compiles, no AOT-cache re-keying — and recurses
-                    # down to single lanes.  NOTE the shape (and so
-                    # the allocation) is unchanged: bisection
-                    # NARROWS the blast radius of a persistent OOM
-                    # to per-lane structured failures rather than
-                    # relieving memory — transient pressure is
-                    # handled by the backoff-retry below, and a
-                    # repeatedly-OOMing autotune is a ROADMAP residue
-                    # (feed dispatch_faults{reason=oom} back into
-                    # autotune_chunk's memory fraction)
-                    faults.record(reason, "bisect")
-                    mid = (len(built) + 1) // 2
-                    left = _dispatch_resilient(
-                        gi, ci, config, built[:mid], batch, start,
-                        block)
-                    right = _dispatch_resilient(
-                        gi, ci, config, built[mid:], batch,
-                        start + mid, block)
-                    return left[0] + right[0], left[1] + right[1]
-                # transient / timeout — and a single lane's OOM,
-                # which cannot bisect further but is often another
-                # process's memory burst: jittered backoff within
-                # the budget, then a structured give-up
-                if attempt >= faults.max_retries:
-                    faults.record(reason, "giveup")
-                    return [], [{"offset": start, "count": len(built),
-                                 "reason": reason, "error": str(exc)}]
-                faults.record(reason, "retry")
-                faults.sleep_backoff(attempt)
-                attempt += 1
-
-    pending = None  # (gi, ci, kept, keys, segments, failures, ctx)
-
-    def drain(entry):
-        (gi, ci, kept, kept_keys, segments, failures, config, built,
-         batch) = entry
-        with _span(tracer, "readback", group=gi, chunk=ci):
-            journaled = []
-            work = list(segments)
-            while work:
-                start, n, offs, rebs, rows = work.pop(0)
-                try:
-                    # host-side transfer THEN slice: slicing the
-                    # device array at a sub-chunk length (bisected
-                    # halves) would compile a fresh slice program
-                    # per length — recovery must stay compile-free
-                    offs_np = np.asarray(offs)[:n]
-                    rebs_np = np.asarray(rebs)[:n]
-                    if rows is None:
-                        out = [(float(o), float(r))
-                               for o, r in zip(offs_np, rebs_np)]
-                    else:
-                        arr = np.asarray(rows)
-                        out = [(float(o), float(r), arr[lane])
-                               for lane, (o, r) in enumerate(
-                                   zip(offs_np, rebs_np))]
-                except Exception as exc:  # fault-ok: classified —
-                    # unrecognized readback failures re-raise
-                    reason = _classify(exc)
-                    if reason is None:
-                        raise
-                    # an async dispatch fault surfacing at readback:
-                    # count it, then re-dispatch the segment through
-                    # the same recovery path, BLOCKING (a blocked
-                    # success cannot fault again at conversion)
-                    faults.record(reason, "retry")
-                    resegs, refails = _dispatch_resilient(
-                        gi, ci, config, built[start:start + n], batch,
-                        start, True)
-                    work = resegs + work
-                    failures = failures + refails
-                    continue
-                for pos, metric in enumerate(out):
-                    results[gi][kept[start + pos]] = metric
-                    if kept_keys is not None:
-                        warm_start.row_store(kept_keys[start + pos],
-                                             metric)
-                        if journal is not None:
-                            journaled.append(kept_keys[start + pos])
-            if journal is not None and journaled:
-                # durable progress: the drained chunk's row keys
-                # under ONE fsync before the engine moves on — what
-                # --resume replays against the row cache (a
-                # mid-drain crash loses only this chunk, which
-                # recomputes)
-                journal.record_rows(journaled)
-            for failure in failures:
-                stats[gi]["failures"].append({
-                    "items": [kept[failure["offset"] + j]
-                              for j in range(failure["count"])],
-                    "reason": failure["reason"],
-                    "error": failure["error"]})
-
-    for gi, ci, off in schedule:
-        config, items, build, batch, keep, keys = prepared[gi]
-        kept = keep[off:off + batch]
-        kept_keys = keys[off:off + batch] if keys is not None else None
-        with _span(tracer, "build", group=gi, chunk=ci):
-            built = [build(items[i]) for i in kept]
-        t0 = time.perf_counter()
-        with _span(tracer, "dispatch", group=gi, chunk=ci):
-            segments, failures = _dispatch_resilient(
-                gi, ci, config, built, batch, 0, not pipeline)
-        if stats[gi]["first_dispatch_s"] is None:
-            stats[gi]["first_dispatch_s"] = time.perf_counter() - t0
-        stats[gi]["chunks"] += 1
-        entry = (gi, ci, kept, kept_keys, segments, failures, config,
-                 built, batch)
-        if not pipeline:
-            drain(entry)
-            continue
-        if pending is not None:
-            drain(pending)
-        pending = entry
-    if pending is not None:
-        drain(pending)
+    stats = []
+    for event in stream_groups_chunked(
+            groups, n_steps, watch_s=watch_s, chunk=chunk,
+            record_every=record_every, tracer=tracer,
+            pipeline=pipeline, interleave=interleave,
+            warm_start=warm_start, faults=faults, journal=journal,
+            stats_out=stats):
+        if event.metric is not None:
+            results[event.group][event.index] = event.metric
     return results, stats
 
 

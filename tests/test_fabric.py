@@ -1,0 +1,429 @@
+"""The multi-host sweep fabric (engine/fabric.py): the lease-based
+work ledger's claim/steal/finalize protocol under fake clocks, the
+slow-but-alive double-completion edge cases, the row-streaming
+executor the fabric consumes (ops/swarm_sim.py
+``stream_groups_chunked``), the per-host journal shards, and the
+OOM→autotune feedback.  The process-level half (real SIGKILL, real
+lease expiry, merged-artifact bit-identity) lives in
+tools/fleet_gate.py."""
+
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (
+    SweepJournal, WarmStart, journal_path, journal_shards)
+from hlsjs_p2p_wrapper_tpu.engine.fabric import (
+    WAIT, FleetChaos, WorkLedger, WorkUnit, barrier, fleet_report,
+    plan_units, run_units)
+from hlsjs_p2p_wrapper_tpu.engine.faults import FaultPlan, FaultPolicy
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (
+    MAX_AUTOTUNE_CHUNK, RowEvent, SwarmConfig, autotune_chunk,
+    make_scenario, oom_bisections, reset_oom_feedback, ring_offsets,
+    run_batch_chunked, stream_groups_chunked)
+
+PEERS = 16
+BITRATES = jnp.array([300_000.0, 800_000.0])
+N_STEPS = 40
+WATCH_S = 10.0
+META = {"tool": "test-fabric", "n": 1}
+
+
+def small_config():
+    return SwarmConfig(n_peers=PEERS, n_segments=8, n_levels=2,
+                       neighbor_offsets=ring_offsets(4))
+
+
+def chunked_fixture(config):
+    cdn = jnp.full((PEERS,), 8_000_000.0)
+
+    def build(margin):
+        return (make_scenario(config, BITRATES, None, cdn,
+                              urgent_margin_s=margin),
+                jnp.zeros((PEERS,)))
+
+    return [0.5, 2.0, 4.0, 8.0, 16.0], build
+
+
+class FakeClock:
+    def __init__(self, now=100.0):
+        self.now = now
+        self.slept = []
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, seconds):
+        self.slept.append(seconds)
+        self.now += seconds
+
+
+def make_ledger(tmp_path, host, clock, **kwargs):
+    return WorkLedger(str(tmp_path / "fabric"), META, host,
+                      lease_s=kwargs.pop("lease_s", 5.0), clock=clock,
+                      sleep=clock.sleep, **kwargs)
+
+
+# -- unit planning / manifest -------------------------------------------
+
+def test_plan_units_slices_groups_chunk_sized():
+    units = plan_units([10, 3], [4, 4])
+    assert units == [WorkUnit(0, 0, 0, 4), WorkUnit(1, 0, 4, 4),
+                     WorkUnit(2, 0, 8, 2), WorkUnit(3, 1, 0, 3)]
+
+
+def test_manifest_first_writer_wins_and_all_adopt(tmp_path):
+    clock = FakeClock()
+    a = make_ledger(tmp_path, "a", clock)
+    units_a, chunks_a = a.ensure_manifest([10], [4])
+    # b proposes DIFFERENT chunking — it must adopt a's manifest, not
+    # fork the unit boundaries
+    b = make_ledger(tmp_path, "b", clock)
+    units_b, chunks_b = b.ensure_manifest([10], [2])
+    assert units_b == units_a
+    assert chunks_b == chunks_a == [4]
+
+
+def test_fabric_dir_refuses_different_sweep(tmp_path):
+    clock = FakeClock()
+    make_ledger(tmp_path, "a", clock)
+    with pytest.raises(ValueError):
+        WorkLedger(str(tmp_path / "fabric"), {"tool": "other"}, "b",
+                   lease_s=5.0, clock=clock, sleep=clock.sleep)
+
+
+# -- the lease protocol -------------------------------------------------
+
+def test_claim_busy_done_lifecycle(tmp_path):
+    clock = FakeClock()
+    a = make_ledger(tmp_path, "a", clock)
+    b = make_ledger(tmp_path, "b", clock)
+    a.ensure_manifest([4], [2])
+    b.ensure_manifest([4], [2])
+    unit = a.units[0]
+    assert a.try_claim(unit) == "claimed"
+    assert b.try_claim(unit) == "busy"     # live lease elsewhere
+    assert a.finalize(unit, rows=2) is True
+    assert b.try_claim(unit) == "done"
+    assert a.claim_counts() == {"claim": 1}
+    assert b.claim_counts() == {}
+
+
+def test_heartbeat_extends_the_lease(tmp_path):
+    clock = FakeClock()
+    a = make_ledger(tmp_path, "a", clock, lease_s=5.0)
+    b = make_ledger(tmp_path, "b", clock, lease_s=5.0)
+    a.ensure_manifest([2], [2])
+    b.ensure_manifest([2], [2])
+    unit = a.units[0]
+    assert a.try_claim(unit) == "claimed"
+    clock.now += 4.0
+    a.heartbeat(unit)                      # renews to now + 5
+    clock.now += 4.0                       # original lease long gone
+    assert b.try_claim(unit) == "busy"
+    clock.now += 2.0                       # renewed lease expired too
+    assert b.try_claim(unit) == "claimed"
+    assert b.claim_counts() == {"expire": 1, "steal": 1}
+
+
+def test_expired_lease_is_stolen_and_counted(tmp_path):
+    clock = FakeClock()
+    a = make_ledger(tmp_path, "a", clock, lease_s=5.0)
+    b = make_ledger(tmp_path, "b", clock, lease_s=5.0)
+    a.ensure_manifest([4], [2])
+    b.ensure_manifest([4], [2])
+    assert a.try_claim(a.units[0]) == "claimed"
+    assert a.try_claim(a.units[1]) == "claimed"
+    clock.now += 6.0
+    # a takeover from ANOTHER host is a steal...
+    assert b.try_claim(a.units[0]) == "claimed"
+    assert b.claim_counts() == {"expire": 1, "steal": 1}
+    # ...re-claiming one's OWN expired unit is an expire + claim
+    assert a.try_claim(a.units[1]) == "claimed"
+    assert a.claim_counts() == {"claim": 3, "expire": 1}
+
+
+def test_double_completion_first_done_wins(tmp_path):
+    """The slow-not-dead host: claim stolen while the original is
+    still alive, BOTH finish — the first finalized append wins
+    deterministically, the loser counts a duplicate, and both
+    completions are on disk for fleet_report."""
+    clock = FakeClock()
+    a = make_ledger(tmp_path, "a", clock, lease_s=5.0)
+    b = make_ledger(tmp_path, "b", clock, lease_s=5.0)
+    a.ensure_manifest([2], [2])
+    b.ensure_manifest([2], [2])
+    unit = a.units[0]
+    assert a.try_claim(unit) == "claimed"
+    clock.now += 6.0                       # a stalls past its lease
+    assert b.try_claim(unit) == "claimed"  # stolen while a is alive
+    assert b.finalize(unit, rows=2) is True
+    assert a.finalize(unit, rows=2) is False   # a finishes late
+    assert a.claim_counts() == {"claim": 1, "duplicate": 1}
+    report = fleet_report(str(tmp_path / "fabric"))
+    assert report["steals"] == 1
+    assert report["expires"] == 1
+    assert report["duplicates"] == 1
+    assert report["per_host"]["b"]["wins"] == 1
+    assert report["per_host"]["a"]["duplicates"] == 1
+
+
+def test_next_unit_scans_waits_and_completes(tmp_path):
+    clock = FakeClock()
+    a = make_ledger(tmp_path, "a", clock)
+    b = make_ledger(tmp_path, "b", clock)
+    a.ensure_manifest([4], [2])
+    b.ensure_manifest([4], [2])
+    first = a.next_unit()
+    second = a.next_unit()
+    assert {first.unit, second.unit} == {0, 1}
+    # b finds only live leases — it must wait, not spin or exit
+    assert b.next_unit() == WAIT
+    assert a.finalize(first, rows=2) is True
+    assert a.finalize(second, rows=2) is True
+    assert a.next_unit() is None
+    # b skips re-reading leased units until their remembered expiry
+    # passes (the O(1)-scan cache), so it observes the completions
+    # only after the lease window — still WAIT before, None after
+    assert b.next_unit() == WAIT
+    clock.now += 6.0
+    assert b.next_unit() is None
+
+
+def test_torn_claim_tail_is_tolerated(tmp_path):
+    clock = FakeClock()
+    a = make_ledger(tmp_path, "a", clock)
+    a.ensure_manifest([2], [2])
+    unit = a.units[0]
+    assert a.try_claim(unit) == "claimed"
+    path = os.path.join(str(tmp_path / "fabric"), "claims",
+                        "unit-00000.jsonl")
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"kind": "done", "host": "ghost", "ro')  # torn
+    # the fragment is skipped: the unit still reads as held by a
+    clock2 = FakeClock()
+    b = make_ledger(tmp_path, "b", clock2)
+    b.ensure_manifest([2], [2])
+    assert b.try_claim(unit) == "busy"
+    assert fleet_report(str(tmp_path / "fabric"))["finished"] == 0
+
+
+def test_fleet_chaos_parse_rejects_bad_specs():
+    plan = FleetChaos.parse("kill@1,stall@2:1.5")
+    assert plan.specs[0]["kind"] == "kill"
+    assert plan.specs[1]["stall_s"] == 1.5
+    with pytest.raises(ValueError):
+        FleetChaos.parse("explode@1")
+    with pytest.raises(ValueError):
+        FleetChaos.parse("kill@nowhere")
+
+
+def test_chaos_stall_fires_on_claim_ordinal(tmp_path):
+    clock = FakeClock()
+    chaos = FleetChaos.parse("stall@1:3.0")
+    a = make_ledger(tmp_path, "a", clock, chaos=chaos)
+    a.ensure_manifest([4], [2])
+    a.try_claim(a.units[0])
+    assert clock.slept == []               # ordinal 0: no chaos
+    a.try_claim(a.units[1])
+    assert clock.slept == [3.0]            # ordinal 1: the stall
+
+
+def test_barrier_releases_and_times_out(tmp_path):
+    clock = FakeClock()
+    fabric = str(tmp_path / "fabric")
+    barrier(fabric, "a", 1, clock=clock, sleep=clock.sleep)
+    with pytest.raises(RuntimeError):
+        barrier(fabric, "a", 3, clock=clock, sleep=clock.sleep,
+                timeout_s=2.0)
+
+
+# -- the fabric executor over a real grid -------------------------------
+
+def test_run_units_bit_identical_and_steal_safe(tmp_path):
+    """Two ledgers over one tiny grid: host a computes one unit then
+    stalls past its lease; host b steals it and completes the grid;
+    a's late completion is a counted duplicate whose rows are
+    BIT-IDENTICAL to b's via the row cache — the steals-are-safe
+    contract at engine level."""
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2)
+    clock = FakeClock()
+    ws_a = WarmStart(cache_dir=str(tmp_path / "cache"))
+    ws_b = WarmStart(cache_dir=str(tmp_path / "cache"))
+    a = make_ledger(tmp_path, "a", clock, lease_s=5.0,
+                    registry=ws_a.registry)
+    b = make_ledger(tmp_path, "b", clock, lease_s=5.0,
+                    registry=ws_b.registry)
+    sizes = [len(items)]
+    a.ensure_manifest(sizes, [2])
+    b.ensure_manifest(sizes, [2])
+    stalled = a.units[0]
+    assert a.try_claim(stalled) == "claimed"
+    a_rows = run_batch_chunked(config, items[:2], build, N_STEPS,
+                               watch_s=WATCH_S, chunk=2,
+                               warm_start=ws_a)
+    clock.now += 6.0                       # a's lease expires mid-"compute"
+    results, unit_log = run_units(b, [(config, items, build)],
+                                  N_STEPS, watch_s=WATCH_S,
+                                  warm_start=ws_b)
+    assert all(entry["won"] for entry in unit_log)
+    got = [results[0][i] for i in range(len(items))]
+    assert got == ref                      # steal is a pure transform
+    assert b.claim_counts()["steal"] == 1
+    # a finishes late: duplicate counted, rows bit-identical
+    assert a.finalize(stalled, rows=2) is False
+    assert a.claim_counts()["duplicate"] == 1
+    assert a_rows == ref[:2]
+    report = fleet_report(str(tmp_path / "fabric"))
+    assert report["duplicates"] == 1
+    for unit in report["units_detail"]:
+        assert len(unit["done"]) <= len(unit["gens"])
+
+
+def test_run_units_requires_row_cache(tmp_path):
+    config = small_config()
+    items, build = chunked_fixture(config)
+    clock = FakeClock()
+    ws = WarmStart(cache_dir=str(tmp_path / "cache"), row_cache=False)
+    a = make_ledger(tmp_path, "a", clock, registry=ws.registry)
+    a.ensure_manifest([len(items)], [2])
+    with pytest.raises(ValueError):
+        run_units(a, [(config, items, build)], N_STEPS,
+                  watch_s=WATCH_S, warm_start=ws)
+
+
+# -- the row-streaming executor -----------------------------------------
+
+def test_stream_matches_barrier_wrapper_bit_exact():
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=2)
+    events = list(stream_groups_chunked([(config, items, build)],
+                                        N_STEPS, watch_s=WATCH_S,
+                                        chunk=2))
+    assert sorted(e.index for e in events) == list(range(len(items)))
+    assert all(isinstance(e, RowEvent) and e.group == 0
+               for e in events)
+    got = [None] * len(items)
+    for e in events:
+        got[e.index] = e.metric
+    assert got == ref
+
+
+def test_stream_emits_cache_hits_first(tmp_path):
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ws = WarmStart(cache_dir=str(tmp_path / "cache"))
+    run_batch_chunked(config, items[:2], build, N_STEPS,
+                      watch_s=WATCH_S, chunk=2, warm_start=ws)
+    events = list(stream_groups_chunked([(config, items, build)],
+                                        N_STEPS, watch_s=WATCH_S,
+                                        chunk=2, warm_start=ws))
+    cached = [e for e in events if e.cached]
+    assert sorted(e.index for e in cached) == [0, 1]
+    # hits stream before any dispatched row
+    assert all(e.cached for e in events[:2])
+    assert all(e.key is not None for e in events)
+
+
+def test_stream_failure_events_carry_reason():
+    config = small_config()
+    items, build = chunked_fixture(config)
+    policy = FaultPolicy(plan=FaultPlan.parse("transient@0:1x4"),
+                         sleep=lambda s: None)
+    stats = []
+    events = list(stream_groups_chunked([(config, items, build)],
+                                        N_STEPS, watch_s=WATCH_S,
+                                        chunk=2, faults=policy,
+                                        stats_out=stats))
+    failed = [e for e in events if e.metric is None]
+    assert {e.index for e in failed} == {2, 3}
+    assert all(e.reason == "transient" for e in failed)
+    assert stats[0]["failures"][0]["items"] == [2, 3]
+
+
+def test_stream_exact_chunk_pads_small_groups_bit_exact():
+    """The fabric's tail unit: fewer items than the fleet chunk must
+    still dispatch the canonical [B, P, …] shape and produce the
+    same rows (vmap lanes are independent — pad content never
+    bleeds)."""
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ref = run_batch_chunked(config, items, build, N_STEPS,
+                            watch_s=WATCH_S, chunk=4)
+    events = list(stream_groups_chunked(
+        [(config, items[4:], build)], N_STEPS, watch_s=WATCH_S,
+        chunk=4, exact_chunk=True, stats_out=(stats := [])))
+    assert stats[0]["chunk"] == 4          # padded, not shrunk
+    assert [e.metric for e in events] == ref[4:]
+
+
+# -- per-host journal shards --------------------------------------------
+
+def test_journal_shard_layout_keeps_single_host_path():
+    legacy = journal_path("/c", META)
+    shard = journal_path("/c", META, "host00")
+    assert legacy.endswith(".jsonl")
+    assert os.path.dirname(shard) == legacy[:-len(".jsonl")]
+    assert os.path.basename(shard) == "host00.jsonl"
+
+
+def test_journal_shards_merge_reader(tmp_path):
+    cache = str(tmp_path)
+    with SweepJournal(journal_path(cache, META, "a"), META) as ja:
+        ja.record_rows(["k1", "k2"])
+    with SweepJournal(journal_path(cache, META, "b"), META) as jb:
+        jb.record_row("k3")
+    with SweepJournal(journal_path(cache, META), META) as legacy:
+        legacy.record_row("k0")
+    shards = journal_shards(cache, META)
+    assert len(shards) == 3                # legacy + two host shards
+    merged = SweepJournal(journal_path(cache, META), META,
+                          resume=True, merge=shards)
+    assert merged.completed == {"k0", "k1", "k2", "k3"}
+    merged.close()
+
+
+def test_journal_shard_merge_refuses_other_sweep(tmp_path):
+    cache = str(tmp_path)
+    other = {"tool": "other"}
+    with SweepJournal(journal_path(cache, other, "a"), other) as jo:
+        jo.record_row("kx")
+    with pytest.raises(ValueError):
+        SweepJournal(journal_path(cache, META), META,
+                     merge=[journal_path(cache, other, "a")])
+
+
+# -- OOM feedback into the autotuner ------------------------------------
+
+def test_bisected_oom_shrinks_autotune_memory_fraction():
+    """The ROADMAP carried item: a bisected OOM is the autotuner
+    telling on itself — later autotune_chunk calls in the same
+    process must derive a smaller cap."""
+    reset_oom_feedback()
+    try:
+        # sized so the 4 GiB CPU fallback budget fits ~70 lanes at
+        # the base fraction: the cap starts at the MAX ceiling and
+        # one halving makes memory the binding constraint
+        big = SwarmConfig(n_peers=1 << 17, n_segments=64, n_levels=3,
+                          neighbor_offsets=ring_offsets(8))
+        before = autotune_chunk(big, 4096, 2000)
+        assert before == MAX_AUTOTUNE_CHUNK  # memory is not binding yet
+        config = small_config()
+        items, build = chunked_fixture(config)
+        policy = FaultPolicy(plan=FaultPlan.parse("oom@0:1"),
+                             sleep=lambda s: None)
+        run_batch_chunked(config, items, build, N_STEPS,
+                          watch_s=WATCH_S, chunk=2, faults=policy)
+        assert policy.fault_counts() == {"oom|bisect": 1}
+        assert oom_bisections() == 1
+        after = autotune_chunk(big, 4096, 2000)
+        assert after < before
+    finally:
+        reset_oom_feedback()
+    assert autotune_chunk(big, 4096, 2000) == before  # reset restores

@@ -1,0 +1,297 @@
+"""Fleet gate: the multi-host fabric survives host death, at process
+granularity.
+
+The fabric's claims (engine/fabric.py, tools/sweep.py --fabric) are
+only worth shipping if an actual SIGKILL'd worker and an actual
+lease-expired straggler leave the merged artifact bit-identical to a
+single-host fault-free run, with every steal / expiry / duplicate
+observed.  This gate runs the shipped VOD grid and asserts exactly
+that, in order:
+
+1. **reference** — one fault-free single-host child
+   (``run_grid_batched(raw=True)``, own cache dir): the float.hex
+   bit-exactness reference.
+2. **fleet** — three ``tools/sweep.py --fabric`` worker processes
+   against one fabric dir + one (separate) cache dir, synchronized
+   at a start barrier with the batched executable pre-warmed so the
+   chaos schedule fires deterministically:
+
+   - ``host01`` carries ``kill@1``: SIGKILLed the moment it claims
+     its SECOND unit — it dies holding a fresh lease, with one
+     finalized unit that never reached a partial artifact (the
+     row-cache backfill path);
+   - ``host02`` carries ``stall@1:3×lease``: stalls mid-lease on its
+     second unit, gets that unit STOLEN while still alive, finishes
+     anyway, and loses the finalize race — the counted-duplicate
+     path;
+   - ``host00`` is the survivor that steals both expired claims.
+
+3. **merge** — a child merges the partial artifacts (plus the
+   row-cache backfill) and reports the claim-file ground truth
+   (``fleet_report``).
+
+Asserted: the kill child died by SIGKILL and wrote no partial; the
+survivors exited 0 with zero tracebacks in any worker log; the
+merged rows are BIT-IDENTICAL (float.hex) to the reference; exactly
+2 steals, 2 lease expiries, and 1 duplicate happened and were
+counted BOTH in the surviving workers' ``fabric_claims`` registries
+and in the claim files; no unit carries more completions than claim
+generations (no row dispatched more than once per surviving claim);
+and the killed host's finalized rows were recovered from the row
+cache.
+
+Gate-sized swarms by default; ``FLEET_GATE_PEERS`` etc. scale it up.
+The chunk is PINNED (the unit manifest must be identical across
+children) and the lease short (``FLEET_GATE_LEASE_S``, default 2 s)
+so the steal path runs in seconds on CPU CI.
+
+Run: ``python tools/fleet_gate.py`` (exit 1 on any violation);
+``make fleet-gate`` wires it into ``make check``.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+HOSTS = ("host00", "host01", "host02")
+#: per-host chaos: the kill and the stall both fire on that host's
+#: SECOND successful claim (ordinal 1) — mid-grid, lease held
+CHAOS = {"host01": "kill@1", "host02": None}  # host02 set in main()
+
+
+def _sizes_from_env():
+    return {
+        "peers": int(os.environ.get("FLEET_GATE_PEERS", 48)),
+        "segments": int(os.environ.get("FLEET_GATE_SEGMENTS", 12)),
+        "watch_s": float(os.environ.get("FLEET_GATE_WATCH_S", 8.0)),
+        "chunk": int(os.environ.get("FLEET_GATE_CHUNK", 6)),
+        "lease_s": float(os.environ.get("FLEET_GATE_LEASE_S", 2.0)),
+    }
+
+
+def _hex_rows(rows):
+    return [[None, None] if row.get("failed")
+            else [row["offload"].hex(), row["rebuffer"].hex()]
+            for row in rows]
+
+
+def child(args):
+    """The jax-importing roles, each in a fresh interpreter so the
+    parent stays stdlib-only (it must read worker logs and claim
+    files without owning a device)."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import sweep as sweep_tool
+    from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import WarmStart
+    from hlsjs_p2p_wrapper_tpu.engine.fabric import fleet_report
+
+    grid = sweep_tool.vod_grid()
+    ws = WarmStart(cache_dir=args.cache_dir)
+    common = dict(peers=args.peers, segments=args.segments,
+                  watch_s=args.watch_s, live=False, seed=0)
+    if args.role == "ref":
+        rows, _info = sweep_tool.run_grid_batched(
+            grid, chunk=args.chunk, warm_start=ws, raw=True, **common)
+        print(json.dumps({"rows": _hex_rows(rows)}))
+        return 0
+    # role == "merge": overlay the partials + row-cache backfill and
+    # report the claim-file ground truth
+    rows, info = sweep_tool.merge_fabric(
+        grid, fabric_dir=args.fabric_dir, warm_start=ws,
+        chunk=args.chunk, raw=True, **common)
+    print(json.dumps({
+        "rows": _hex_rows(rows),
+        "fabric": info["fabric"],
+        "failures": info["failures"],
+        "detail": fleet_report(args.fabric_dir)["units_detail"],
+    }))
+    return 0
+
+
+def run_role(role, cache_dir, fabric_dir, sizes):
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--role", role, "--cache-dir", cache_dir,
+           "--fabric-dir", fabric_dir,
+           "--peers", str(sizes["peers"]),
+           "--segments", str(sizes["segments"]),
+           "--watch-s", str(sizes["watch_s"]),
+           "--chunk", str(sizes["chunk"])]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=_REPO)
+    if proc.returncode != 0:
+        raise SystemExit(f"fleet-gate {role} child failed:\n"
+                         f"{proc.stdout}\n{proc.stderr}")
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
+def spawn_worker(host, cache_dir, fabric_dir, sizes, log_dir):
+    cmd = [sys.executable,
+           os.path.join(_REPO, "tools", "sweep.py"),
+           "--fabric", fabric_dir, "--host-id", host,
+           "--fabric-lease-s", str(sizes["lease_s"]),
+           "--fabric-barrier", str(len(HOSTS)),
+           "--peers", str(sizes["peers"]),
+           "--segments", str(sizes["segments"]),
+           "--watch-s", str(sizes["watch_s"]),
+           "--chunk", str(sizes["chunk"])]
+    if CHAOS.get(host):
+        cmd.extend(["--fabric-chaos", CHAOS[host]])
+    env = {**os.environ, "HLSJS_P2P_TPU_CACHE_DIR": cache_dir}
+    log_path = os.path.join(log_dir, f"{host}.log")
+    log = open(log_path, "w", encoding="utf-8")
+    return subprocess.Popen(cmd, stdout=log, stderr=log, cwd=_REPO,
+                            env=env), log_path, log
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--role", default="ref", choices=("ref", "merge"))
+    ap.add_argument("--cache-dir")
+    ap.add_argument("--fabric-dir")
+    sizes_default = _sizes_from_env()
+    ap.add_argument("--peers", type=int,
+                    default=sizes_default["peers"])
+    ap.add_argument("--segments", type=int,
+                    default=sizes_default["segments"])
+    ap.add_argument("--watch-s", type=float,
+                    default=sizes_default["watch_s"])
+    ap.add_argument("--chunk", type=int,
+                    default=sizes_default["chunk"])
+    args = ap.parse_args(argv)
+    if args.child:
+        return child(args)
+
+    sizes = _sizes_from_env()
+    stall_s = 3.0 * sizes["lease_s"]
+    CHAOS["host02"] = f"stall@1:{stall_s}"
+    root = tempfile.mkdtemp(prefix="fleet-gate-")
+    cache_ref = os.path.join(root, "cache-ref")
+    cache_fleet = os.path.join(root, "cache-fleet")
+    fabric_dir = os.path.join(root, "fabric")
+    log_dir = os.path.join(root, "logs")
+    os.makedirs(log_dir)
+    problems = []
+    try:
+        # 1. the single-host fault-free bit-exactness reference
+        ref = run_role("ref", cache_ref, fabric_dir, sizes)
+
+        # 2. the fleet: 3 workers, one killed, one stalled into
+        # lease expiry
+        procs = [spawn_worker(host, cache_fleet, fabric_dir, sizes,
+                              log_dir) for host in HOSTS]
+        rcs = {}
+        for host, (proc, log_path, log) in zip(HOSTS, procs):
+            rcs[host] = proc.wait()
+            log.close()
+        if rcs["host01"] != -signal.SIGKILL:
+            problems.append(
+                f"kill worker exited {rcs['host01']}, expected "
+                f"SIGKILL ({-signal.SIGKILL}) — the chaos schedule "
+                f"did not fire (did it claim a second unit?)")
+        for host in ("host00", "host02"):
+            if rcs[host] != 0:
+                problems.append(f"{host} exited {rcs[host]} — "
+                                f"survivors must complete the grid")
+        for host in HOSTS:
+            with open(os.path.join(log_dir, f"{host}.log"),
+                      encoding="utf-8") as fh:
+                log_text = fh.read()
+            if "Traceback" in log_text:
+                problems.append(f"{host} log carries an unhandled "
+                                f"exception:\n{log_text[-2000:]}")
+        killed_partial = os.path.join(fabric_dir, "partial",
+                                      "host01.json")
+        if os.path.exists(killed_partial):
+            problems.append("the SIGKILLed worker wrote a partial "
+                            "artifact — it did not die mid-grid")
+
+        # 3. merge + the claim-file ground truth
+        merged = run_role("merge", cache_fleet, fabric_dir, sizes)
+
+        if merged["rows"] != ref["rows"]:
+            diverged = sum(1 for a, b in zip(merged["rows"],
+                                             ref["rows"]) if a != b)
+            problems.append(
+                f"merged rows diverged from the single-host "
+                f"fault-free reference at {diverged}/"
+                f"{len(ref['rows'])} points — steals must be "
+                f"bit-exact by construction")
+        if merged["failures"]:
+            problems.append(f"structured failures in a fault-free "
+                            f"dispatch schedule: {merged['failures']}")
+
+        report = merged["fabric"]["report"]
+        for key, want in (("steals", 2), ("expires", 2),
+                          ("duplicates", 1)):
+            if report[key] != want:
+                problems.append(
+                    f"claim files record {key}={report[key]}, "
+                    f"expected {want} (one steal per dead/stalled "
+                    f"host, one duplicate from the stalled "
+                    f"survivor)")
+        if report["finished"] != report["units"]:
+            problems.append(f"{report['units'] - report['finished']} "
+                            f"units never finished")
+        # the registries must have COUNTED what the claim files
+        # record (the kill victim's counters died with it; steals /
+        # expiries / duplicates are all survivor-side events)
+        counted = {"steal": 0, "expire": 0, "duplicate": 0}
+        for host in merged["fabric"]["hosts"]:
+            for action in counted:
+                counted[action] += host["claims"].get(action, 0)
+        for action, want in (("steal", 2), ("expire", 2),
+                             ("duplicate", 1)):
+            if counted[action] != want:
+                problems.append(
+                    f"fabric_claims{{action={action}}} summed to "
+                    f"{counted[action]} across surviving workers, "
+                    f"expected {want} — every recovery must be "
+                    f"counted, not just survived")
+        # no row dispatched more than once per surviving claim: a
+        # unit's completions can never exceed its claim generations
+        for unit in merged["detail"]:
+            if len(unit["done"]) > len(unit["gens"]):
+                problems.append(
+                    f"{unit['unit']}: {len(unit['done'])} "
+                    f"completions vs {len(unit['gens'])} claim "
+                    f"generations")
+        if merged["fabric"]["recovered_rows"] <= 0:
+            problems.append(
+                "no rows were recovered from the row cache — the "
+                "killed host's finalized unit should only exist "
+                "there (its partial was never written)")
+        hosts_reported = {h["host"]
+                          for h in merged["fabric"]["hosts"]}
+        if hosts_reported != {"host00", "host02"}:
+            problems.append(f"expected partials from the two "
+                            f"survivors, got {sorted(hosts_reported)}")
+        print(f"fleet-gate: fleet of {len(HOSTS)} "
+              f"(1 SIGKILLed, 1 lease-expired) finished "
+              f"{report['finished']}/{report['units']} units with "
+              f"{report['steals']} steals, {report['expires']} "
+              f"expiries, {report['duplicates']} duplicate, "
+              f"{merged['fabric']['recovered_rows']} rows recovered "
+              f"from the row cache -> "
+              f"{'ok' if not problems else 'FAIL'}")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    for problem in problems:
+        print(f"fleet-gate: {problem}", file=sys.stderr)
+    print(f"# fleet-gate: {'PASS' if not problems else 'FAIL'} "
+          f"(VOD grid, 3 workers, {sizes['peers']} peers, chunk "
+          f"{sizes['chunk']}, lease {sizes['lease_s']}s)",
+          file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

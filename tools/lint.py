@@ -7,7 +7,7 @@ library code logs through ``logging`` or counts into the telemetry
 registry (engine/telemetry.py); tools/tests/examples, which OWN their
 stdout, are exempt.
 
-Three repo-specific rules:
+Four repo-specific rules:
 
 - every entry of ``STATIC_KNOBS`` in ``tools/sweep.py`` (the sweep's
   compile-group key) must carry an inline ``# static:``
@@ -30,6 +30,12 @@ Three repo-specific rules:
   into silent data loss at sweep scale — no recovery path may eat a
   fault invisibly.  (Bare ``except:`` stays banned outright,
   everywhere.)
+- no naked ``time.time()`` / ``time.sleep()`` calls in the fabric
+  work ledger or the dispatch path (``CLOCK_FILES``): lease expiry
+  and retry backoff must route through the injectable clock/sleep
+  callables (the ``FaultPolicy`` convention) or their tests need
+  real waits and start flaking; ``# clock-ok: <why>`` is the
+  escape.
 
 Run: ``python tools/lint.py`` (exit code 1 on findings).
 """
@@ -253,6 +259,55 @@ def check_broad_excepts(path):
     return findings
 
 
+#: files whose wall-clock reads and sleeps must route through the
+#: injectable clock/sleep callables (the FaultPolicy convention):
+#: the work ledger's lease arithmetic and the dispatch engine's
+#: backoff are exactly the code paths the fleet/fault tests pin with
+#: fake clocks — one naked call and a lease-expiry test needs real
+#: waits (slow) or starts flaking (worse)
+CLOCK_FILES = (
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "fabric.py"),
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "faults.py"),
+    os.path.join("hlsjs_p2p_wrapper_tpu", "ops", "swarm_sim.py"),
+)
+
+
+def check_clock_discipline(path):
+    """Injectable-clock discipline for the fabric and the dispatch
+    path: no naked ``time.time()`` / ``time.sleep()`` CALLS — both
+    must flow through the injectable ``clock``/``sleep`` callables
+    (default-argument REFERENCES like ``clock=time.time`` are the
+    injection points themselves and stay legal; ``perf_counter``
+    spans are measurement, not control flow, and are not flagged).
+    ``# clock-ok: <why>`` is the inline escape."""
+    findings = []
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []  # check_file already reports the syntax error
+    lines = source.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in ("time", "sleep")
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "time"):
+            continue
+        if "# clock-ok:" in lines[node.lineno - 1]:
+            continue
+        findings.append(
+            f"{path}:{node.lineno}: naked time.{func.attr}() on the "
+            f"fabric/dispatch path — route through the injectable "
+            f"clock/sleep (the FaultPolicy convention) so lease and "
+            f"backoff tests stay deterministic; '# clock-ok: <why>' "
+            f"if wall time is genuinely required")
+    return findings
+
+
 def check_static_knobs(sweep_path):
     """Compile-group discipline for ``tools/sweep.py``: the
     ``STATIC_KNOBS`` tuple must exist, and every element's source
@@ -306,6 +361,8 @@ def main():
             all_findings.extend(check_nocache(path))
         if path.startswith((tools_root, package_root)):
             all_findings.extend(check_broad_excepts(path))
+        if path.endswith(CLOCK_FILES):
+            all_findings.extend(check_clock_discipline(path))
     all_findings.extend(check_static_knobs(
         os.path.join(repo_root, "tools", "sweep.py")))
     for finding in sorted(all_findings):

@@ -102,6 +102,7 @@ import argparse
 import itertools
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -112,13 +113,16 @@ import jax.numpy as jnp  # noqa: E402
 
 from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
     SweepJournal, WarmStart, atomic_write_json, atomic_write_text,
-    enable_persistent_compilation_cache, journal_path)
+    enable_persistent_compilation_cache, journal_path, journal_shards)
+from hlsjs_p2p_wrapper_tpu.engine.fabric import (  # noqa: E402
+    FleetChaos, WorkLedger, barrier, fleet_report, run_units)
 from hlsjs_p2p_wrapper_tpu.engine.faults import (  # noqa: E402
     FaultPlan, FaultPolicy)
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
-    UNREACHABLE_BITRATE, SwarmConfig, init_swarm, make_scenario,
+    UNREACHABLE_BITRATE, SwarmConfig, autotune_chunk,
+    ensure_penalty_width_batch, init_swarm, make_scenario,
     offload_ratio, rebuffer_ratio, ring_offsets, run_groups_chunked,
-    run_swarm_scenario, stable_ranks, staggered_joins,
+    run_swarm_scenario, stable_ranks, stack_pytrees, staggered_joins,
     timeline_columns)
 
 LADDERS = {
@@ -280,6 +284,31 @@ def group_grid(grid, static_live_sync=False):
     return groups
 
 
+def build_groups(grid, *, peers, segments, watch_s, live, seed,
+                 stagger_s=60.0, static_live_sync=False):
+    """The compile-group decomposition every execution path shares
+    (batched engine, fabric workers, fabric merge): ``group_list``
+    is ``run_groups_chunked``'s ``(config, items, build)`` triples,
+    ``group_keys`` maps each group back to its grid indices, and
+    ``n_steps`` is the scan extent.  The decomposition is a pure
+    function of the grid + sizes, so every fabric host derives the
+    SAME groups (the work-unit manifest indexes into them)."""
+    groups_map = group_grid(grid, static_live_sync=static_live_sync)
+    group_list = []
+    group_keys = []
+    for key, idxs in groups_map.items():
+        sync = key[-1] if (static_live_sync and live) else None
+        config = build_config(peers, segments, live, key[0],
+                              live_sync_s=sync)
+        build = (lambda k, cfg=config:
+                 build_scenario(cfg, k, watch_s=watch_s,
+                                stagger_s=stagger_s, seed=seed))
+        group_list.append((config, [grid[i] for i in idxs], build))
+        group_keys.append((key, idxs))
+    n_steps = int(watch_s * 1000.0 / group_list[0][0].dt_ms)
+    return group_list, group_keys, n_steps
+
+
 def journal_meta(grid, *, peers, segments, watch_s, live, seed,
                  record_every):
     """The sweep-identity material the crash-safe journal is
@@ -328,19 +357,10 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
     if not grid:
         return [], {"compile_groups": 0, "chunk": None,
                     "chunk_autotuned": chunk is None, "groups": []}
-    groups_map = group_grid(grid, static_live_sync=static_live_sync)
-    group_list = []
-    group_keys = []
-    for key, idxs in groups_map.items():
-        sync = key[-1] if (static_live_sync and live) else None
-        config = build_config(peers, segments, live, key[0],
-                              live_sync_s=sync)
-        build = (lambda k, cfg=config:
-                 build_scenario(cfg, k, watch_s=watch_s,
-                                stagger_s=stagger_s, seed=seed))
-        group_list.append((config, [grid[i] for i in idxs], build))
-        group_keys.append((key, idxs))
-    n_steps = int(watch_s * 1000.0 / group_list[0][0].dt_ms)
+    group_list, group_keys, n_steps = build_groups(
+        grid, peers=peers, segments=segments, watch_s=watch_s,
+        live=live, seed=seed, stagger_s=stagger_s,
+        static_live_sync=static_live_sync)
     results, stats = run_groups_chunked(
         group_list, n_steps, watch_s=watch_s, chunk=chunk,
         record_every=record_every, tracer=tracer, pipeline=pipeline,
@@ -424,6 +444,235 @@ def run_grid_sequential(grid, *, peers, segments, watch_s, live, seed,
                   "chunk_autotuned": False, "groups": []}
 
 
+# -- the multi-host fabric (engine/fabric.py) ---------------------------
+
+def resolve_group_chunks(group_list, n_steps, chunk):
+    """Per-group canonical batch shapes for the fabric manifest: the
+    pinned ``--chunk`` (clamped to the group) or the autotuned fit.
+    Only the FIRST host's resolution matters — everyone else adopts
+    the published manifest — but the derivation is deterministic
+    given identical hardware, so a homogeneous fleet agrees anyway."""
+    chunks = []
+    for config, items, build in group_list:
+        if chunk is not None:
+            chunks.append(max(min(chunk, len(items)), 1))
+        else:
+            probe = build(items[0])[0] if items else None
+            chunks.append(autotune_chunk(config, len(items), n_steps,
+                                         scenario=probe))
+    return chunks
+
+
+def run_grid_fabric_worker(grid, *, peers, segments, watch_s, live,
+                           seed, chunk, fabric_dir, host_id, lease_s,
+                           warm_start, faults, chaos_spec=None,
+                           barrier_hosts=0, stagger_s=60.0):
+    """One fabric HOST process: join the work ledger, then
+    claim → dispatch → journal → finalize units until the whole grid
+    is done (stealing expired leases along the way), and write this
+    host's partial artifact to ``<fabric_dir>/partial/<host>.json``
+    atomically.  Rows are full-precision floats (JSON round-trips
+    them exactly); the merge step applies the table rounding.
+
+    ``chaos_spec`` (engine/fabric.py ``FleetChaos``) and
+    ``barrier_hosts`` (start-line barrier + executable pre-warm, so
+    claim-ordinal chaos schedules actually fire) are the fleet
+    gate's determinism hooks."""
+    group_list, group_keys, n_steps = build_groups(
+        grid, peers=peers, segments=segments, watch_s=watch_s,
+        live=live, seed=seed, stagger_s=stagger_s)
+    meta = journal_meta(grid, peers=peers, segments=segments,
+                        watch_s=watch_s, live=live, seed=seed,
+                        record_every=0)
+    ledger = WorkLedger(
+        fabric_dir, meta, host_id, lease_s=lease_s,
+        registry=warm_start.registry,
+        chaos=FleetChaos.parse(chaos_spec) if chaos_spec else None)
+    units, chunks = ledger.ensure_manifest(
+        [len(items) for _, items, _ in group_list],
+        resolve_group_chunks(group_list, n_steps, chunk))
+    if barrier_hosts:
+        # pre-warm each group's batched executable BEFORE the start
+        # line: the barrier exists so a chaos schedule keyed to claim
+        # ordinals fires deterministically, and a host still inside
+        # its first XLA compile while its peers drain the grid would
+        # defeat that
+        if warm_start.aot_enabled:
+            for (config, items, build), b in zip(group_list, chunks):
+                scenario, _join = build(items[0])
+                scenarios = stack_pytrees([scenario] * b)
+                states = stack_pytrees([init_swarm(config)] * b)
+                states = ensure_penalty_width_batch(config, scenarios,
+                                                    states)
+                warm_start.batch_runner(config, scenarios, states,
+                                        n_steps, record_every=0,
+                                        donate_scenarios=True)
+        barrier(fabric_dir, host_id, barrier_hosts)
+    jpath = journal_path(warm_start.cache_dir, meta, host_id)
+    journal = SweepJournal(jpath, meta,
+                          resume=os.path.exists(jpath))
+    try:
+        results, unit_log = run_units(
+            ledger, group_list, n_steps, watch_s=watch_s,
+            warm_start=warm_start, faults=faults, journal=journal)
+    finally:
+        journal.close()
+    rows = {}
+    for gi, (key, idxs) in enumerate(group_keys):
+        for local, metric in results[gi].items():
+            if metric is None:
+                rows[str(idxs[local])] = {"failed": True}
+            else:
+                rows[str(idxs[local])] = [metric[0], metric[1]]
+    partial = {
+        "host": host_id,
+        "rows": rows,
+        "claims": ledger.claim_counts(),
+        "faults": faults.fault_counts() if faults is not None else {},
+        "units": unit_log,
+        "lease_s": lease_s,
+    }
+    atomic_write_json(os.path.join(fabric_dir, "partial",
+                                   f"{host_id}.json"), partial)
+    return partial
+
+
+def merge_fabric(grid, *, peers, segments, watch_s, live, seed,
+                 fabric_dir, warm_start, chunk=None, raw=False,
+                 stagger_s=60.0):
+    """Merge the per-host partial artifacts into the final
+    ``(rows, info)`` pair — the fabric's end-of-grid barrier, run
+    once after the workers exit (spawn-local) or as the shared-FS
+    fleet's final ``--hosts 0`` invocation.
+
+    Rows merge by grid index, first partial wins — double-completed
+    units are bit-identical by construction (layer-2 row cache), so
+    the winner is a bookkeeping choice, not a numeric one.  Rows a
+    host FINALIZED but never exported (it was SIGKILL'd between
+    finalize and its partial write) are recovered from the row cache
+    by key (``recovered_rows`` in the meta).  A grid index missing
+    everywhere means unfinished units — the merge refuses, and
+    rerunning the workers against the same fabric dir completes
+    exactly the missing claims."""
+    group_list, group_keys, n_steps = build_groups(
+        grid, peers=peers, segments=segments, watch_s=watch_s,
+        live=live, seed=seed, stagger_s=stagger_s)
+    partial_dir = os.path.join(fabric_dir, "partial")
+    partials = []
+    for name in (sorted(os.listdir(partial_dir))
+                 if os.path.isdir(partial_dir) else []):
+        if name.endswith(".json"):
+            with open(os.path.join(partial_dir, name),
+                      encoding="utf-8") as fh:
+                partials.append(json.load(fh))
+    merged = [None] * len(grid)
+    for p in partials:
+        for key, value in p["rows"].items():
+            idx = int(key)
+            # successful rows beat failed placeholders: a point one
+            # host gave up on (retry budget) may have completed fine
+            # under another host's claim of the same stolen unit —
+            # successes are bit-identical across hosts, so among
+            # them first-partial-wins is a pure bookkeeping choice
+            current = merged[idx]
+            if current is None or (isinstance(current, dict)
+                                   and not isinstance(value, dict)):
+                merged[idx] = value
+    recovered = 0
+    if any(v is None or isinstance(v, dict) for v in merged):
+        # row-cache backfill: a host SIGKILL'd after finalizing a
+        # unit never wrote its partial, but every drained row is
+        # already durable in the content-addressed row cache (a
+        # failed placeholder is also worth one lookup — some other
+        # claim may have completed the row)
+        for (key, idxs), (config, items, build) in zip(group_keys,
+                                                       group_list):
+            for local, grid_idx in enumerate(idxs):
+                if not (merged[grid_idx] is None
+                        or isinstance(merged[grid_idx], dict)):
+                    continue
+                scenario, join = build(items[local])
+                rkey = warm_start.row_key(config, scenario, join,
+                                          n_steps, watch_s=watch_s,
+                                          record_every=0)
+                cached = warm_start.row_load(rkey)
+                if cached is not None:
+                    if merged[grid_idx] is None:
+                        recovered += 1
+                    merged[grid_idx] = [cached[0], cached[1]]
+    missing = [i for i, v in enumerate(merged) if v is None]
+    if missing:
+        raise RuntimeError(
+            f"fabric merge: {len(missing)} grid points have no "
+            f"completed row (indices {missing[:8]}…) — units are "
+            f"still unfinished; rerun workers against {fabric_dir} "
+            f"to complete the remaining claims")
+    rows = []
+    for knobs, value in zip(grid, merged):
+        if isinstance(value, dict):
+            rows.append({**knobs, "offload": None, "rebuffer": None,
+                         "failed": True})
+        else:
+            off, reb = value
+            rows.append({**knobs,
+                         "offload": off if raw else round(off, 4),
+                         "rebuffer": reb if raw else round(reb, 5)})
+    report = fleet_report(fabric_dir)
+    units_detail = report.pop("units_detail")
+    info = {
+        "compile_groups": len(group_list),
+        "chunk": None, "chunk_autotuned": chunk is None,
+        "row_hits": 0,
+        "failures": [
+            {"host": p["host"], "unit": u["unit"], **f}
+            for p in partials for u in p["units"]
+            for f in u["failures"]],
+        "groups": [],
+        "fabric": {
+            "hosts": [{"host": p["host"],
+                       "rows": len(p["rows"]),
+                       "claims": p["claims"],
+                       "units": len(p["units"])}
+                      for p in partials],
+            "report": report,
+            "recovered_rows": recovered,
+            "units": len(units_detail),
+        },
+    }
+    manifest_path = os.path.join(fabric_dir, "units.json")
+    if os.path.exists(manifest_path):
+        with open(manifest_path, encoding="utf-8") as fh:
+            info["chunk"] = max(json.load(fh)["chunks"])
+    return rows, info
+
+
+def spawn_local_fleet(args, hosts):
+    """Spawn-local mode: launch ``hosts`` worker copies of this tool
+    against the shared fabric dir and wait them out.  The claim
+    protocol is pure filesystem, so a real shared-FS fleet runs the
+    SAME worker code path — this launcher is the CPU-CI convenience."""
+    procs = []
+    for h in range(hosts):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--fabric", args.fabric, "--host-id", f"host{h:02d}",
+               "--fabric-lease-s", str(args.fabric_lease_s),
+               "--peers", str(args.peers),
+               "--segments", str(args.segments),
+               "--watch-s", str(args.watch_s),
+               "--seed", str(args.seed)]
+        if args.live:
+            cmd.append("--live")
+        if args.chunk is not None:
+            cmd.extend(["--chunk", str(args.chunk)])
+        procs.append(subprocess.Popen(cmd))
+    rcs = [proc.wait() for proc in procs]
+    if any(rcs):
+        raise SystemExit(
+            "fabric workers failed: "
+            + ", ".join(f"host{h:02d} rc={rc}"
+                        for h, rc in enumerate(rcs) if rc))
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--peers", type=int, default=1024)
@@ -465,6 +714,34 @@ def main():
                          "crash-safe journal against the layer-2 row "
                          "cache (zero recompute of completed rows) "
                          "and dispatch only the rest")
+    ap.add_argument("--fabric", metavar="DIR",
+                    help="multi-host work ledger directory "
+                         "(engine/fabric.py): shard the grid into "
+                         "lease-claimed work units that cooperating "
+                         "host processes compute, steal on host "
+                         "death, and merge")
+    ap.add_argument("--hosts", type=int, default=None, metavar="N",
+                    help="with --fabric: spawn N local worker "
+                         "processes, wait, and merge their partial "
+                         "artifacts (0 = merge-only, the shared-FS "
+                         "fleet's final step)")
+    ap.add_argument("--host-id", metavar="ID",
+                    help="with --fabric: join the ledger as this "
+                         "worker (each host of a shared-FS fleet "
+                         "runs one, with a unique id), write "
+                         "partial/<ID>.json, and exit")
+    ap.add_argument("--fabric-lease-s", type=float, default=30.0,
+                    metavar="S",
+                    help="work-unit claim TTL: a host that stops "
+                         "heartbeating for this long has its units "
+                         "stolen (size it to outlive one chunk's "
+                         "dispatch; default 30)")
+    ap.add_argument("--fabric-chaos", metavar="SPEC",
+                    help=argparse.SUPPRESS)  # fleet-gate hook:
+    # kill@N / stall@N:S on this worker's Nth claim
+    ap.add_argument("--fabric-barrier", type=int, default=0,
+                    metavar="N", help=argparse.SUPPRESS)  # fleet-gate
+    # hook: pre-warm the executable, then wait for N ready hosts
     ap.add_argument("--inject-faults", metavar="SPEC",
                     help="deterministic fault plane (chaos/test "
                          "hook): comma-separated kind@group:chunk"
@@ -485,6 +762,30 @@ def main():
     if args.sequential and (args.resume or args.inject_faults):
         ap.error("--resume/--inject-faults need the batched engine "
                  "(drop --sequential)")
+    if args.fabric:
+        if args.sequential:
+            ap.error("--fabric needs the batched engine "
+                     "(drop --sequential)")
+        if args.no_warm_start or args.no_row_cache:
+            ap.error("--fabric requires both warm-start layers: "
+                     "steals are safe precisely because every "
+                     "completion resolves to one content-addressed "
+                     "row (drop --no-warm-start/--no-row-cache)")
+        if args.record_every or args.timelines_out:
+            ap.error("--record-every/--timelines-out are single-host "
+                     "features (timelines do not ride the fabric's "
+                     "partial artifacts)")
+        if args.resume:
+            ap.error("--resume is implicit under --fabric: rerun the "
+                     "workers against the same fabric dir and they "
+                     "claim exactly the unfinished units")
+        if args.hosts is None and not args.host_id:
+            ap.error("--fabric needs --hosts N (spawn-local fleet), "
+                     "--host-id ID (join as one worker), or "
+                     "--hosts 0 (merge existing partials)")
+    elif (args.hosts is not None or args.host_id
+          or args.fabric_chaos or args.fabric_barrier):
+        ap.error("--hosts/--host-id/--fabric-* need --fabric DIR")
 
     grid = live_grid() if args.live else vod_grid()
     engine = run_grid_sequential if args.sequential else run_grid_batched
@@ -506,33 +807,68 @@ def main():
               if args.inject_faults else None),
         registry=(warm_start.registry if warm_start is not None
                   else None))
+    if args.fabric and args.host_id:
+        # fabric WORKER: claim/steal/compute units until the grid is
+        # done, export the partial artifact, exit (the launcher or a
+        # final --hosts 0 invocation merges)
+        partial = run_grid_fabric_worker(
+            grid, peers=args.peers, segments=args.segments,
+            watch_s=args.watch_s, live=args.live, seed=args.seed,
+            chunk=args.chunk, fabric_dir=args.fabric,
+            host_id=args.host_id, lease_s=args.fabric_lease_s,
+            warm_start=warm_start, faults=faults,
+            chaos_spec=args.fabric_chaos,
+            barrier_hosts=args.fabric_barrier)
+        print(f"# fabric worker {args.host_id}: "
+              f"{len(partial['rows'])} rows, "
+              f"claims {partial['claims'] or '{}'}, "
+              f"faults {partial['faults'] or '{}'}",
+              file=sys.stderr)
+        return
     journal = None
     if args.resume and (warm_start is None
                         or not warm_start.rows_enabled):
         ap.error("--resume replays the journal against the row "
                  "cache (drop --no-row-cache/--no-warm-start)")
-    if warm_start is not None and warm_start.rows_enabled:
+    if (warm_start is not None and warm_start.rows_enabled
+            and not args.fabric):
         meta = journal_meta(grid, peers=args.peers,
                             segments=args.segments,
                             watch_s=args.watch_s, live=args.live,
                             seed=args.seed,
                             record_every=args.record_every)
         jpath = journal_path(warm_start.cache_dir, meta)
-        if args.resume and not os.path.exists(jpath):
+        shards = journal_shards(warm_start.cache_dir, meta)
+        if args.resume and not (os.path.exists(jpath) or shards):
             ap.error(f"--resume: no journal for this sweep "
                      f"configuration ({jpath})")
-        journal = SweepJournal(jpath, meta, resume=args.resume)
+        # merge= folds any per-host fabric shards of the same sweep
+        # into the resumed completed-set, so a single-host --resume
+        # can finish a fleet's interrupted work
+        journal = SweepJournal(jpath, meta, resume=args.resume,
+                               merge=shards if args.resume else ())
         if args.resume:
             print(f"# resume: journal lists "
                   f"{len(journal.completed)} completed rows; "
                   f"replaying against the row cache",
                   file=sys.stderr)
     t0 = time.perf_counter()
-    rows, info = engine(
-        grid, peers=args.peers, segments=args.segments,
-        watch_s=args.watch_s, live=args.live, seed=args.seed,
-        chunk=args.chunk, record_every=args.record_every,
-        warm_start=warm_start, faults=faults, journal=journal)
+    if args.fabric:
+        # fabric LAUNCHER (spawn-local CI mode) and/or the merge of
+        # the per-host partial artifacts into the final rows
+        if args.hosts:
+            spawn_local_fleet(args, args.hosts)
+        rows, info = merge_fabric(
+            grid, peers=args.peers, segments=args.segments,
+            watch_s=args.watch_s, live=args.live, seed=args.seed,
+            fabric_dir=args.fabric, warm_start=warm_start,
+            chunk=args.chunk)
+    else:
+        rows, info = engine(
+            grid, peers=args.peers, segments=args.segments,
+            watch_s=args.watch_s, live=args.live, seed=args.seed,
+            chunk=args.chunk, record_every=args.record_every,
+            warm_start=warm_start, faults=faults, journal=journal)
     elapsed = time.perf_counter() - t0
     # with the warm-start engine active, the honest compile count is
     # the number of FRESH program compiles it performed (cache misses
@@ -598,7 +934,10 @@ def main():
         for row in rows:
             print(" | ".join(f"{row.get(k)!s:>15}" for k in knob_names
                              + ["offload", "rebuffer"]))
-    mode = "sequential" if args.sequential else "batched"
+    if args.fabric:
+        mode = f"fabric x{len(info['fabric']['hosts'])} hosts"
+    else:
+        mode = "sequential" if args.sequential else "batched"
     chunk_note = ("" if args.sequential else
                   f", chunk {info['chunk']}"
                   f"{' (autotuned)' if info['chunk_autotuned'] else ''}")
@@ -646,6 +985,10 @@ def main():
                 "dispatch_faults": fault_counts,
                 "failed_points": len(failed),
                 "failures": info.get("failures", []),
+                # per-host row counts, steals, lease expiries,
+                # duplicates — the fabric's merge accounting
+                **({"fabric": info["fabric"]}
+                   if "fabric" in info else {}),
             },
             "rows": rows,
         })
