@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
 	coverage soak scaling-artifact warmstart-gate chaos-gate \
-	fleet-gate
+	fleet-gate trace-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -34,11 +34,13 @@ sweep:
 # the one-compile-group live grid end to end: sweep with per-point
 # on-device timelines dumped to an UNCOMMITTED JSONL (the
 # SCALING_local.json pattern), then triage the trajectories for
-# ABR-ladder oscillation and offload-ramp stalls — the sweep's
-# output becomes a work list, not 144 plots
+# ABR-ladder oscillation and offload-ramp stalls — plus --grid, the
+# cross-point view: which knob AXIS flips a point from healthy to
+# pathological — so the sweep's output becomes a work list, not
+# 144 plots
 sweep-live:
 	$(PY) tools/sweep.py --live --timelines-out SWEEP_LIVE_TIMELINES_local.jsonl
-	$(PY) tools/triage_timelines.py SWEEP_LIVE_TIMELINES_local.jsonl
+	$(PY) tools/triage_timelines.py SWEEP_LIVE_TIMELINES_local.jsonl --grid
 
 # dryrun_multichip self-provisions the virtual 8-CPU mesh (subprocess
 # with JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count);
@@ -90,6 +92,18 @@ chaos-gate:
 fleet-gate:
 	$(PY) tools/fleet_gate.py
 
+# process-level completeness proof for the flight recorder
+# (engine/tracer.py): a 3-worker fleet with one SIGKILL and one
+# injected transient burst must leave an event stream whose replay
+# reproduces each surviving worker's dispatch_faults / fabric_claims
+# / aot_cache_events registries EXACTLY, and whose journaled rows
+# each map to exactly one finalize event (the killed host included)
+# — plus structurally valid Perfetto export and a console frame.
+# TRACE_GATE_PEERS etc. scale it up; TRACE_GATE_LEASE_S stretches
+# the lease on slow hosts.
+trace-gate:
+	$(PY) tools/trace_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -98,6 +112,6 @@ examples:
 	$(PY) examples/swarm_demo.py --live
 	$(PY) examples/production_demo.py
 
-check: lint test dryrun warmstart-gate chaos-gate fleet-gate
+check: lint test dryrun warmstart-gate chaos-gate fleet-gate trace-gate
 
 all: check bench

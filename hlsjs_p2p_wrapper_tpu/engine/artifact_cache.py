@@ -367,13 +367,16 @@ def _atomic_write(path: str, data: bytes) -> None:
     atomic_write_bytes(path, data, durable=False)
 
 
-def read_jsonl_records(path: str):
+def read_jsonl_tolerant(path: str):
     """Stream the parseable records of an append-only JSON-lines
     file, skipping blank lines and unparsable fragments — the ONE
-    torn-tail-tolerance protocol shared by the sweep journal and the
-    fabric's claim files (engine/fabric.py): every whole line was
-    fsync'd before its writer moved on, so a skipped fragment is at
-    most the record a crash interrupted, which recomputes."""
+    torn-tail-tolerance protocol shared by the sweep journal, the
+    fabric's claim files (engine/fabric.py), the flight recorder's
+    event shards (engine/tracer.py), and every JSONL artifact reader
+    (soak / console / trace export): every whole line was fsync'd (or
+    at least fully flushed) before its writer moved on, so a skipped
+    fragment is at most the record a crash interrupted — which
+    recomputes, re-exports, or simply drops one trace event."""
     with open(path, encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
@@ -383,6 +386,11 @@ def read_jsonl_records(path: str):
                 yield json.loads(line)
             except ValueError:
                 continue
+
+
+#: pre-0.9 name, kept as an alias (the journal/fabric rounds grew
+#: readers against it)
+read_jsonl_records = read_jsonl_tolerant
 
 
 # -- the crash-safe sweep journal --------------------------------------

@@ -85,13 +85,14 @@ or delayed stealing, proportionally.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import signal
 import time
 from typing import List, NamedTuple, Optional
 
-from .artifact_cache import _digest, read_jsonl_records
+from .artifact_cache import _digest, read_jsonl_tolerant
 from .telemetry import MetricsRegistry
 
 #: ``next_unit`` sentinel: units remain but none is claimable right
@@ -206,10 +207,10 @@ def _publish_exclusive(path: str, data: bytes) -> bool:
 def _read_records(path: str) -> list:
     """All parseable records of one claim file — the journal's
     torn-tail-tolerance protocol (one shared implementation,
-    :func:`~.artifact_cache.read_jsonl_records`); a missing file is
+    :func:`~.artifact_cache.read_jsonl_tolerant`); a missing file is
     an unclaimed unit, not an error."""
     try:
-        return list(read_jsonl_records(path))
+        return list(read_jsonl_tolerant(path))
     except OSError:
         return []
 
@@ -227,9 +228,14 @@ class WorkLedger:
                  lease_s: float = 30.0, clock=time.time,
                  sleep=time.sleep,
                  registry: Optional[MetricsRegistry] = None,
-                 chaos: Optional[FleetChaos] = None):
+                 chaos: Optional[FleetChaos] = None, trace=None):
         if lease_s <= 0:
             raise ValueError("lease_s must be > 0")
+        # flight recorder (engine/tracer.py, duck-typed): every
+        # claim/steal/beat/done/duplicate also emits a ``lease``
+        # event, so the one event plane carries the fabric protocol
+        # alongside the dispatch spans and fault counters
+        self.trace = trace
         self.fabric_dir = fabric_dir
         self.host_id = host_id
         self.lease_s = lease_s
@@ -378,10 +384,16 @@ class WorkLedger:
             # here (a dead host never reports its own), and a
             # takeover from ANOTHER host is a steal
             self._count("expire")
-            self._count("steal" if lease.get("host") != self.host_id
-                        else "claim")
+            stolen = lease.get("host") != self.host_id
+            self._count("steal" if stolen else "claim")
+            if self.trace is not None:
+                self.trace.lease("steal" if stolen else "reclaim",
+                                 unit=unit.unit, gen=gen,
+                                 prev_host=lease.get("host"))
         else:
             self._count("claim")
+            if self.trace is not None:
+                self.trace.lease("claim", unit=unit.unit, gen=gen)
         self.registry.gauge("fabric_heartbeat_s",
                             host=self.host_id).set(now)
         ordinal = self._claims_made
@@ -435,6 +447,9 @@ class WorkLedger:
                                  "expires_s": now + self.lease_s})
         self.registry.gauge("fabric_heartbeat_s",
                             host=self.host_id).set(now)
+        if self.trace is not None:
+            self.trace.lease("beat", unit=unit.unit, gen=gen,
+                             expires_s=now + self.lease_s)
 
     def finalize(self, unit: WorkUnit, rows: int) -> bool:
         """Append this unit's completion.  The FIRST ``done`` record
@@ -455,9 +470,17 @@ class WorkLedger:
         if (done is None or done.get("host") != self.host_id
                 or done.get("gen") != gen):
             self._count("duplicate")
+            if self.trace is not None:
+                self.trace.lease("duplicate", unit=unit.unit,
+                                 gen=gen if gen is not None else -1,
+                                 rows=int(rows))
             return False
         self.registry.counter("fabric_units_done",
                               host=self.host_id).inc()
+        if self.trace is not None:
+            self.trace.lease("done", unit=unit.unit,
+                             gen=gen if gen is not None else -1,
+                             rows=int(rows))
         return True
 
     def sleep(self, seconds: float) -> None:
@@ -468,7 +491,7 @@ class WorkLedger:
 
 def run_units(ledger: WorkLedger, groups, n_steps: int, *,
               watch_s: float, record_every: int = 0, warm_start=None,
-              faults=None, journal=None, tracer=None,
+              faults=None, journal=None, tracer=None, trace=None,
               poll_s: float = 0.25):
     """One host's fabric executor: claim → stream-dispatch → finalize
     until every unit in the ledger is done.
@@ -494,6 +517,10 @@ def run_units(ledger: WorkLedger, groups, n_steps: int, *,
             "the fabric requires the layer-2 row cache (steals are "
             "safe precisely because both completions resolve to one "
             "content-addressed row)")
+    if trace is None:
+        # the ledger's recorder (if any) also carries the dispatch
+        # events, so one shard tells a unit's whole story
+        trace = ledger.trace
     results = {gi: {} for gi in range(len(groups))}
     unit_log = []
     while True:
@@ -510,16 +537,24 @@ def run_units(ledger: WorkLedger, groups, n_steps: int, *,
         stats_out = []
         keys = []
         computed = {}
-        for event in stream_groups_chunked(
-                [(config, sub, build)], n_steps, watch_s=watch_s,
-                chunk=ledger.chunk(unit.group),
-                record_every=record_every, tracer=tracer,
-                pipeline=False, warm_start=warm_start, faults=faults,
-                journal=journal, stats_out=stats_out,
-                exact_chunk=True):
-            computed[unit.start + event.index] = event.metric
-            if event.key is not None and event.metric is not None:
-                keys.append(event.key)
+        # the unit context frame ties every dispatch span / fault
+        # counter / row event inside to the claim that scheduled it
+        # (each unit runs as its own single-group stream, so the
+        # inner group/chunk coordinates alone would all read (0, 0))
+        unit_ctx = (trace.context(unit=unit.unit)
+                    if trace is not None else contextlib.nullcontext())
+        with unit_ctx:
+            for event in stream_groups_chunked(
+                    [(config, sub, build)], n_steps, watch_s=watch_s,
+                    chunk=ledger.chunk(unit.group),
+                    record_every=record_every, tracer=tracer,
+                    pipeline=False, warm_start=warm_start,
+                    faults=faults, journal=journal,
+                    stats_out=stats_out, exact_chunk=True,
+                    trace=trace):
+                computed[unit.start + event.index] = event.metric
+                if event.key is not None and event.metric is not None:
+                    keys.append(event.key)
         ledger.heartbeat(unit)
         won = ledger.finalize(unit, rows=len(keys))
         results[unit.group].update(computed)

@@ -70,9 +70,20 @@ def _format_key(name: str, labels: _Labels) -> str:
 class Counter:
     """Monotonic counter; every bump takes the instrument's lock (the
     ``_count`` contract: bursts are exactly when unlocked ``+=``
-    drops increments)."""
+    drops increments).
+
+    ``_listeners`` is the registry's shared bump-listener list
+    (:meth:`MetricsRegistry.add_listener`) — a directly-constructed
+    Counter has none.  Listeners fire OUTSIDE the value lock (they
+    may buffer to disk) and only on ``inc``: ``set_value`` mirrors an
+    externally-accumulated total, which no event stream could replay
+    additively, so it stays invisible by design."""
 
     kind = "counter"
+
+    #: shared with the owning registry's listener list; the empty
+    #: tuple default keeps direct construction listener-free
+    _listeners: tuple = ()
 
     def __init__(self, name: str, labels: Optional[Dict] = None):
         self.name = name
@@ -83,6 +94,8 @@ class Counter:
     def inc(self, n=1) -> None:
         with self._lock:
             self._value += n
+        for listener in self._listeners:
+            listener(self.name, self.labels, n)
 
     def set_value(self, value) -> None:
         """Last-write-wins assignment — the attribute-migration form
@@ -201,6 +214,25 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._instruments: Dict[Tuple[str, _Labels], object] = {}
+        # counter-bump listeners, shared BY REFERENCE with every
+        # registry-owned Counter: add_listener after the fact reaches
+        # instruments created before it (the flight recorder attaches
+        # once and sees every later bump, whoever memoized the handle)
+        self._bump_listeners: list = []
+
+    def add_listener(self, listener) -> None:
+        """Subscribe ``listener(name, labels, n)`` to every counter
+        ``inc`` on this registry — the flight recorder's correlation
+        hook (engine/tracer.py): one bump, one causally-ordered
+        event.  Listeners run outside the instrument lock and must
+        not raise (a tracing failure must never fail the counted
+        operation — buffer, don't I/O, in the hot path)."""
+        if listener not in self._bump_listeners:
+            self._bump_listeners.append(listener)
+
+    def remove_listener(self, listener) -> None:
+        if listener in self._bump_listeners:
+            self._bump_listeners.remove(listener)
 
     def _get(self, cls, name: str, labels: Dict, **kwargs):
         key = (name, _label_key(labels))
@@ -212,6 +244,8 @@ class MetricsRegistry:
                     kwargs["buckets"] = (DEFAULT_BUCKETS
                                          if buckets is None else buckets)
                 inst = cls(name, labels, **kwargs)
+                if cls is Counter:
+                    inst._listeners = self._bump_listeners
                 self._instruments[key] = inst
             elif not isinstance(inst, cls):
                 raise ValueError(

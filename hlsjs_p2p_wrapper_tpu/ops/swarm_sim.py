@@ -1406,11 +1406,21 @@ def run_swarm_batch(config: SwarmConfig, scenarios: SwarmScenario,
 
 def _span(tracer, name: str, **attrs):
     """Span context for dispatch tracing — duck-typed (anything with
-    ``.span(name, **attrs)``, e.g. engine.telemetry.SpanRecorder) so
-    the device-side module never imports the host engine package."""
+    ``.span(name, **attrs)``, e.g. engine.telemetry.SpanRecorder or
+    engine.tracer.FlightRecorder) so the device-side module never
+    imports the host engine package."""
     if tracer is None:
         return contextlib.nullcontext()
     return tracer.span(name, **attrs)
+
+
+def _trace_ctx(trace, **fields):
+    """Trace-context frame for the flight recorder (duck-typed:
+    anything with ``.context(**fields)``); no-op when tracing is
+    off, so the hot path stays free of it by default."""
+    if trace is None:
+        return contextlib.nullcontext()
+    return trace.context(**fields)
 
 
 #: fraction of the device's free memory the chunk autotuner commits
@@ -1570,7 +1580,8 @@ def stream_groups_chunked(groups, n_steps: int, *, watch_s: float,
                           pipeline: bool = True,
                           interleave: bool = True,
                           warm_start=None, faults=None, journal=None,
-                          stats_out=None, exact_chunk: bool = False):
+                          stats_out=None, exact_chunk: bool = False,
+                          trace=None):
     """The chunked, pipelined dispatch engine as a ROW STREAM: a
     generator yielding one :class:`RowEvent` per grid row as its
     chunk drains (row-cache hits up front, dispatched rows one
@@ -1602,7 +1613,19 @@ def stream_groups_chunked(groups, n_steps: int, *, watch_s: float,
     Fault/journal/warm-start semantics are those documented on
     :func:`run_groups_chunked`: a failed row streams as a
     ``RowEvent`` with ``metric=None`` and the failure ``reason``, and
-    is also appended to its group's ``stats["failures"]``."""
+    is also appended to its group's ``stats["failures"]``.
+
+    ``trace`` (an ``engine.tracer.FlightRecorder``, duck-typed like
+    ``tracer``) arms the FLIGHT RECORDER — default off, zero hooks
+    on the hot path when None: build/dispatch/readback spans, a
+    (group, chunk, attempt) trace context wrapped around every
+    dispatch attempt (so the recorder's registry-counter correlation
+    tags retries/bisections/cache events with their coordinate), one
+    ``row`` event per streamed row, and — for rows about to be
+    journaled — a ``journaled=True`` finalize event FLUSHED to the
+    event shard before the journal fsyncs the chunk's keys, so a
+    journaled row's finalize event can never be lost to a crash the
+    journal survived (the trace gate's invariant)."""
     rows_on = warm_start is not None and warm_start.rows_enabled
     aot_on = warm_start is not None and warm_start.aot_enabled
     groups = [(config, list(items), build)
@@ -1662,6 +1685,9 @@ def stream_groups_chunked(groups, n_steps: int, *, watch_s: float,
     # hits stream before any dispatch: they are already durable in
     # the row cache, so consumers may act on them immediately
     for event in hit_events:
+        if trace is not None:
+            trace.row(event.key, group=event.group,
+                      index=event.index, cached=True)
         yield event
 
     starts = [list(range(0, len(keep), batch))
@@ -1735,6 +1761,21 @@ def stream_groups_chunked(groups, n_steps: int, *, watch_s: float,
         behavior."""
         attempt = 0
         while True:
+            result = _dispatch_attempt(gi, ci, config, built, batch,
+                                       start, block, attempt)
+            if result is not None:
+                return result
+            attempt += 1
+
+    def _dispatch_attempt(gi, ci, config, built, batch, start, block,
+                          attempt):
+        """One attempt of :func:`_dispatch_resilient`'s loop under a
+        (group, chunk, attempt) trace-context frame — dispatch,
+        classification, AND the recovery counters it bumps all sit
+        inside the frame, so every correlated counter event carries
+        the coordinate that suffered the fault.  Returns the
+        ``(segments, failures)`` result, or None to retry."""
+        with _trace_ctx(trace, group=gi, chunk=ci, attempt=attempt):
             try:
                 out = _dispatch_built(gi, ci, config, built, batch,
                                       block)
@@ -1778,7 +1819,7 @@ def stream_groups_chunked(groups, n_steps: int, *, watch_s: float,
                                  "reason": reason, "error": str(exc)}]
                 faults.record(reason, "retry")
                 faults.sleep_backoff(attempt)
-                attempt += 1
+                return None
 
     pending = None  # (gi, ci, kept, keys, segments, failures, ctx)
 
@@ -1790,7 +1831,8 @@ def stream_groups_chunked(groups, n_steps: int, *, watch_s: float,
         (gi, ci, kept, kept_keys, segments, failures, config, built,
          batch) = entry
         events = []
-        with _span(tracer, "readback", group=gi, chunk=ci):
+        with _span(tracer, "readback", group=gi, chunk=ci), \
+                _span(trace, "readback", group=gi, chunk=ci):
             journaled = []
             work = list(segments)
             while work:
@@ -1829,10 +1871,19 @@ def stream_groups_chunked(groups, n_steps: int, *, watch_s: float,
                 for pos, metric in enumerate(out):
                     key = (kept_keys[start + pos]
                            if kept_keys is not None else None)
+                    fresh = False
                     if key is not None:
                         warm_start.row_store(key, metric)
                         if journal is not None:
+                            fresh = key not in journal.completed
                             journaled.append(key)
+                    if trace is not None:
+                        # fresh == "record_rows below will journal
+                        # it": this event is the row's ONE finalize
+                        # record, mirrored 1:1 by the journal shard
+                        trace.row(key, group=gi,
+                                  index=kept[start + pos],
+                                  journaled=fresh)
                     events.append(RowEvent(gi, kept[start + pos],
                                            metric, key=key))
             if journal is not None and journaled:
@@ -1840,7 +1891,12 @@ def stream_groups_chunked(groups, n_steps: int, *, watch_s: float,
                 # under ONE fsync before the engine moves on — what
                 # --resume replays against the row cache (a
                 # mid-drain crash loses only this chunk, which
-                # recomputes)
+                # recomputes).  Finalize events flush FIRST: a
+                # journaled row whose trace event died with the
+                # process would break the event plane's ground-truth
+                # claim in the unrecoverable direction
+                if trace is not None:
+                    trace.flush()
                 journal.record_rows(journaled)
             for failure in failures:
                 stats[gi]["failures"].append({
@@ -1859,10 +1915,12 @@ def stream_groups_chunked(groups, n_steps: int, *, watch_s: float,
         config, items, build, batch, keep, keys = prepared[gi]
         kept = keep[off:off + batch]
         kept_keys = keys[off:off + batch] if keys is not None else None
-        with _span(tracer, "build", group=gi, chunk=ci):
+        with _span(tracer, "build", group=gi, chunk=ci), \
+                _span(trace, "build", group=gi, chunk=ci):
             built = [build(items[i]) for i in kept]
         t0 = time.perf_counter()
-        with _span(tracer, "dispatch", group=gi, chunk=ci):
+        with _span(tracer, "dispatch", group=gi, chunk=ci), \
+                _span(trace, "dispatch", group=gi, chunk=ci):
             segments, failures = _dispatch_resilient(
                 gi, ci, config, built, batch, 0, not pipeline)
         if stats[gi]["first_dispatch_s"] is None:
@@ -1881,6 +1939,8 @@ def stream_groups_chunked(groups, n_steps: int, *, watch_s: float,
     if pending is not None:
         for event in drain(pending):
             yield event
+    if trace is not None:
+        trace.flush()
     return stats
 
 
@@ -1888,7 +1948,8 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
                        chunk: Optional[int] = None,
                        record_every: int = 0, tracer=None,
                        pipeline: bool = True, interleave: bool = True,
-                       warm_start=None, faults=None, journal=None):
+                       warm_start=None, faults=None, journal=None,
+                       trace=None):
     """Chunked, pipelined dispatch over MULTIPLE compile groups — the
     engine under :func:`run_batch_chunked` (one group) and
     ``tools/sweep.py`` (one group per remaining static knob value).
@@ -1988,7 +2049,11 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
     ``--resume`` by replaying the journal against the row cache with
     zero recompute of completed rows.  Requires ``warm_start`` with
     the row cache enabled (the journal records keys, the cache holds
-    the values)."""
+    the values).
+
+    ``trace`` (an ``engine.tracer.FlightRecorder``) arms the flight
+    recorder — default OFF, no hooks on the hot path when None (see
+    :func:`stream_groups_chunked`)."""
     groups = [(config, list(items), build)
               for config, items, build in groups]
     results = [[None] * len(items) for _, items, _ in groups]
@@ -1998,7 +2063,7 @@ def run_groups_chunked(groups, n_steps: int, *, watch_s: float,
             record_every=record_every, tracer=tracer,
             pipeline=pipeline, interleave=interleave,
             warm_start=warm_start, faults=faults, journal=journal,
-            stats_out=stats):
+            stats_out=stats, trace=trace):
         if event.metric is not None:
             results[event.group][event.index] = event.metric
     return results, stats
@@ -2008,7 +2073,7 @@ def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
                       *, watch_s: float, chunk: Optional[int] = None,
                       record_every: int = 0, tracer=None,
                       pipeline: bool = True, warm_start=None,
-                      faults=None, journal=None):
+                      faults=None, journal=None, trace=None):
     """Single-group front-end for :func:`run_groups_chunked` — the
     dispatch engine shared by ``tools/sweep.py`` and
     ``tools/policy_ab.py``.  Returns per-item ``(offload, rebuffer)``
@@ -2019,7 +2084,9 @@ def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
     executable/row caches through the dispatch; ``faults`` arms the
     bounded retry/bisection recovery (items whose budget ran out come
     back as ``None``) and ``journal`` records completed rows
-    crash-safely.  See :func:`run_groups_chunked` for the
+    crash-safely.  ``trace`` arms the flight recorder
+    (engine/tracer.py) — tracing is DEFAULT-OFF unless a sink is
+    passed.  See :func:`run_groups_chunked` for the
     chunking/padding/pipelining and recovery contracts."""
     items = list(items)
     if not items:
@@ -2028,7 +2095,7 @@ def run_batch_chunked(config: SwarmConfig, items, build, n_steps: int,
         [(config, items, build)], n_steps, watch_s=watch_s,
         chunk=chunk, record_every=record_every, tracer=tracer,
         pipeline=pipeline, warm_start=warm_start, faults=faults,
-        journal=journal)
+        journal=journal, trace=trace)
     return results[0]
 
 

@@ -5,6 +5,8 @@ VirtualClock-stamped JSON-lines exporter, and the dispatch span
 recorder bench.py's overlap metric is built on."""
 
 import json
+import os
+import sys
 import threading
 
 import pytest
@@ -13,6 +15,9 @@ from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
 from hlsjs_p2p_wrapper_tpu.engine.telemetry import (
     Histogram, JsonlExporter, MetricsRegistry, SpanRecorder,
     overlap_efficiency)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
 
 
 # -- instruments -------------------------------------------------------
@@ -216,6 +221,55 @@ def test_jsonl_exporter_close_idempotent(tmp_path):
     exporter.close()
 
 
+def test_exporter_readers_tolerate_truncated_final_record(tmp_path):
+    """The registry JSONL export reads back through the journal's
+    torn-tail protocol (``read_jsonl_tolerant``,
+    engine/artifact_cache.py): a crash mid-export leaves a parseable
+    prefix, not a consumer traceback — the soak/console/trace paths
+    all read through this one helper."""
+    from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (
+        read_jsonl_tolerant)
+    reg = MetricsRegistry()
+    clock = VirtualClock()
+    path = tmp_path / "metrics.jsonl"
+    with JsonlExporter(reg, clock, str(path)) as exporter:
+        reg.counter("c").inc()
+        exporter.export(round=0)
+        clock.advance(10.0)
+        exporter.export(round=1)
+    whole = path.read_text()
+    # tear the FINAL record mid-line — the one artifact a SIGKILL
+    # mid-export can leave
+    path.write_text(whole[:whole.rindex('"metrics"') + 12])
+    records = list(read_jsonl_tolerant(str(path)))
+    assert [r["round"] for r in records] == [0]
+    assert records[0]["metrics"]["c"] == 1
+
+
+def test_counter_bump_listener_fires_on_inc_only():
+    """``add_listener`` sees every counter ``inc`` (name, labels, n)
+    — including on instruments memoized BEFORE attaching — and
+    never gauge writes or ``set_value`` mirrors; ``remove_listener``
+    detaches."""
+    reg = MetricsRegistry()
+    pre = reg.counter("dispatch_faults", reason="oom",
+                      action="retry")
+    seen = []
+    reg.add_listener(lambda name, labels, n:
+                     seen.append((name, dict(labels), n)))
+    pre.inc()
+    reg.counter("fabric_claims", action="claim").inc(3)
+    reg.gauge("g").set(5)
+    pre.set_value(99)
+    assert seen == [
+        ("dispatch_faults", {"action": "retry", "reason": "oom"}, 1),
+        ("fabric_claims", {"action": "claim"}, 3),
+    ]
+    reg.remove_listener(reg._bump_listeners[0])
+    pre.inc()
+    assert len(seen) == 2
+
+
 # -- span tracing ------------------------------------------------------
 
 def test_span_recorder_records_attrs_and_totals():
@@ -253,3 +307,33 @@ def test_overlap_efficiency_clamps():
     assert overlap_efficiency(3.0, 2.0, 1.0) == 0.0  # clamped low
     assert overlap_efficiency(1.0, 2.0, 0.0) == 0.0  # no readback
     assert overlap_efficiency(1.5, 2.0, 1.0) == pytest.approx(0.5)
+
+
+# -- the generated metrics reference (tools/lint.py) --------------------
+
+def test_metrics_reference_collector_and_sync(tmp_path):
+    """The AST collector sees the canonical families with their
+    label signatures (dynamic ``**labels`` included), and the
+    committed METRICS.md matches what the code emits — the same
+    check ``make lint`` gates on."""
+    import lint as lint_tool
+    families = lint_tool.collect_metric_families(_REPO)
+    assert families[("dispatch_faults", "counter")]["labels"] == \
+        {("action", "reason")}
+    assert families[("fabric_claims", "counter")]["labels"] == \
+        {("action",)}
+    assert ("**",) in \
+        families[("agent.cdn_bytes", "counter")]["labels"]
+    assert ("aot_cache_events", "counter") in families
+    # drift gate: committed file == rendered reference
+    assert lint_tool.check_metrics_reference(_REPO) == []
+    # a stale or missing file is a finding with the regeneration hint
+    rendered = lint_tool.render_metrics_md(families)
+    (tmp_path / "METRICS.md").write_text(rendered + "drift\n")
+    import shutil
+    fake_repo = tmp_path / "repo"
+    os.makedirs(fake_repo / "tools")
+    os.makedirs(fake_repo / "hlsjs_p2p_wrapper_tpu")
+    shutil.copy(tmp_path / "METRICS.md", fake_repo / "METRICS.md")
+    (findings,) = [lint_tool.check_metrics_reference(str(fake_repo))]
+    assert findings and "--write-metrics" in findings[0]

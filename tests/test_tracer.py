@@ -1,0 +1,456 @@
+"""The flight recorder (engine/tracer.py): the one event plane must
+be COMPLETE (replaying counter events reproduces the registry
+exactly; every journaled row has exactly one finalize event), CAUSAL
+(context frames tag every event emitted inside, merge order is
+(clock, host, seq) with per-host order = file order), CRASH-SAFE
+(torn tails skipped; a reader merging mid-write — or after a
+SIGKILLed writer — sees a prefix-consistent stream and never
+crashes), and FREE when off (``trace=None`` changes nothing,
+bit-exactly).  The process-level half lives in tools/trace_gate.py;
+these tests pin the mechanism."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax.numpy as jnp
+
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (
+    SweepJournal, WarmStart, journal_path, read_jsonl_tolerant)
+from hlsjs_p2p_wrapper_tpu.engine.fabric import WorkLedger, plan_units
+from hlsjs_p2p_wrapper_tpu.engine.faults import FaultPlan, FaultPolicy
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+from hlsjs_p2p_wrapper_tpu.engine.tracer import (
+    FlightRecorder, counter_families, finalize_keys, merge_trace,
+    read_shard, replay_counter_families, run_id_for, shard_paths)
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (
+    SwarmConfig, make_scenario, ring_offsets, run_batch_chunked)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+PEERS = 16
+BITRATES = jnp.array([300_000.0, 800_000.0])
+N_STEPS = 40
+WATCH_S = 10.0
+
+
+def small_config():
+    return SwarmConfig(n_peers=PEERS, n_segments=8, n_levels=2,
+                       neighbor_offsets=ring_offsets(4))
+
+
+def chunked_fixture(config):
+    cdn = jnp.full((PEERS,), 8_000_000.0)
+
+    def build(margin):
+        return (make_scenario(config, BITRATES, None, cdn,
+                              urgent_margin_s=margin),
+                jnp.zeros((PEERS,)))
+
+    return [0.5, 2.0, 4.0, 8.0, 16.0], build
+
+
+# -- the recorder itself ------------------------------------------------
+
+def test_recorder_round_trip(tmp_path):
+    """Events round-trip through the shard with clock stamp,
+    sequence, context, and the meta header."""
+    clock_t = [100.0]
+    rec = FlightRecorder(str(tmp_path), "hostA", run_id="r1",
+                         clock=lambda: clock_t[0])
+    with rec.context(group=1, chunk=2):
+        rec.emit("mark", name="x")
+        with rec.context(attempt=3):
+            rec.emit("mark", name="y")
+    clock_t[0] = 101.0
+    rec.row("k0", group=0, index=4, journaled=True)
+    rec.close()
+    meta, events = read_shard(str(tmp_path / "hostA.jsonl"))
+    assert meta == {"kind": "meta", "run_id": "r1", "host": "hostA"}
+    assert [e["seq"] for e in events] == [0, 1, 2]
+    assert events[0]["ctx"] == {"group": 1, "chunk": 2}
+    assert events[1]["ctx"] == {"group": 1, "chunk": 2, "attempt": 3}
+    assert "ctx" not in events[2]  # stack fully popped
+    assert events[2] == {"t": 101.0, "host": "hostA", "kind": "row",
+                         "key": "k0", "group": 0, "index": 4,
+                         "cached": False, "journaled": True,
+                         "seq": 2}
+
+
+def test_counter_listener_correlates_and_replays(tmp_path):
+    """A registry counter bump inside a context frame becomes one
+    correlated event, and replaying the stream reproduces the
+    registry families EXACTLY — including late-registered
+    instruments (the listener list is shared by reference)."""
+    registry = MetricsRegistry()
+    early = registry.counter("dispatch_faults", reason="oom",
+                             action="bisect")
+    rec = FlightRecorder(str(tmp_path), "h", registry=registry)
+    with rec.context(group=0, chunk=7, attempt=1):
+        early.inc()
+        registry.counter("fabric_claims", action="steal").inc(2)
+    registry.counter("aot_cache_events", layer="row",
+                     result="hit").inc()
+    rec.close()
+    events = merge_trace(str(tmp_path))
+    counters = [e for e in events if e["kind"] == "counter"]
+    assert counters[0]["ctx"] == {"group": 0, "chunk": 7,
+                                  "attempt": 1}
+    assert counters[0]["labels"] == "action=bisect,reason=oom"
+    assert replay_counter_families(events) == \
+        counter_families(registry)
+    # detached recorders stop listening (no events after close)
+    registry.counter("fabric_claims", action="steal").inc()
+    assert replay_counter_families(merge_trace(str(tmp_path))) != \
+        counter_families(registry)
+
+
+def test_gauge_writes_do_not_emit_events(tmp_path):
+    """Only counter ``inc`` correlates: gauges (and counter
+    ``set_value`` mirrors) are point-in-time state no additive
+    replay could reproduce."""
+    registry = MetricsRegistry()
+    rec = FlightRecorder(str(tmp_path), "h", registry=registry)
+    registry.gauge("fabric_heartbeat_s", host="h").set(12.0)
+    registry.counter("agent.cdn_bytes", peer="p").set_value(1000)
+    rec.close()
+    assert merge_trace(str(tmp_path)) == []
+
+
+def test_torn_tail_skipped_and_prefix_kept(tmp_path):
+    """A shard SIGKILLed mid-append (torn trailing fragment) yields
+    its durable prefix — no crash, no partial record."""
+    rec = FlightRecorder(str(tmp_path), "h")
+    rec.emit("mark", name="a")
+    rec.emit("mark", name="b")
+    rec.flush()
+    rec.close()
+    path = tmp_path / "h.jsonl"
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"t": 1.0, "kind": "mark", "na')  # torn tail
+    events = merge_trace(str(tmp_path))
+    assert [e["name"] for e in events] == ["a", "b"]
+
+
+def test_merge_orders_by_clock_then_host_then_seq(tmp_path):
+    """Cross-host merge is (virtual-clock, host, seq); per-host
+    relative order is exactly file order."""
+    t_a, t_b = [10.0], [10.0]
+    rec_a = FlightRecorder(str(tmp_path), "a",
+                           clock=lambda: t_a[0])
+    rec_b = FlightRecorder(str(tmp_path), "b",
+                           clock=lambda: t_b[0])
+    rec_a.emit("mark", name="a0")
+    t_b[0] = 5.0
+    rec_b.emit("mark", name="b0")   # earlier clock, later write
+    t_a[0] = 10.0
+    rec_a.emit("mark", name="a1")   # same stamp as a0 -> seq breaks
+    rec_a.close()
+    rec_b.close()
+    events = merge_trace(str(tmp_path))
+    assert [e["name"] for e in events] == ["b0", "a0", "a1"]
+    assert len(shard_paths(str(tmp_path))) == 2
+
+
+def test_run_id_for_is_deterministic():
+    meta = {"tool": "sweep", "grid": [1, 2, 3]}
+    assert run_id_for(dict(meta)) == run_id_for(dict(meta))
+    assert run_id_for(meta) != run_id_for({**meta, "grid": [1]})
+
+
+# -- the dispatch engine under trace ------------------------------------
+
+def test_engine_trace_is_pure_and_complete(tmp_path):
+    """``run_batch_chunked(trace=...)``: rows bit-identical to the
+    untraced engine; spans cover build/dispatch/readback; every
+    journaled row key has exactly ONE finalize event; fault retries
+    and cache events replay to the registry exactly."""
+    config = small_config()
+    items, build = chunked_fixture(config)
+    baseline = run_batch_chunked(config, items, build, N_STEPS,
+                                 watch_s=WATCH_S, chunk=2)
+    cache = tmp_path / "cache"
+    ws = WarmStart(cache_dir=str(cache))
+    meta = {"t": "trace-test"}
+    jpath = journal_path(str(cache), meta)
+    journal = SweepJournal(jpath, meta)
+    policy = FaultPolicy(plan=FaultPlan.parse("transient@0:1x2"),
+                         registry=ws.registry, sleep=lambda s: None)
+    rec = FlightRecorder(str(tmp_path / "trace"), "h0",
+                         registry=ws.registry)
+    traced = run_batch_chunked(config, items, build, N_STEPS,
+                               watch_s=WATCH_S, chunk=2,
+                               warm_start=ws, faults=policy,
+                               journal=journal, trace=rec)
+    rec.close()
+    journal.close()
+    assert [m[:2] for m in traced] == [m[:2] for m in baseline]
+    events = merge_trace(str(tmp_path / "trace"))
+    span_names = {e["name"] for e in events if e["kind"] == "span"}
+    assert span_names == {"build", "dispatch", "readback"}
+    # the injected transients were recorded WITH their coordinate
+    retries = [e for e in events if e["kind"] == "counter"
+               and e["name"] == "dispatch_faults"]
+    assert len(retries) == 2
+    assert all(e["ctx"]["group"] == 0 and e["ctx"]["chunk"] == 1
+               for e in retries)
+    assert {e["ctx"]["attempt"] for e in retries} == {0, 1}
+    # completeness: replay == registry, journal == finalize
+    assert replay_counter_families(events) == \
+        counter_families(ws.registry)
+    journaled = [r["key"] for r in read_jsonl_tolerant(jpath)
+                 if r.get("kind") == "row"]
+    finals = finalize_keys(events)
+    assert sorted(journaled) == sorted(finals)
+    assert all(count == 1 for count in finals.values())
+
+
+def test_cached_rows_stream_as_cached_events(tmp_path):
+    """A warm rerun's row-cache hits emit ``cached=True`` row events
+    and no journaled finalizes (hits were never re-journaled)."""
+    config = small_config()
+    items, build = chunked_fixture(config)
+    ws = WarmStart(cache_dir=str(tmp_path / "cache"))
+    run_batch_chunked(config, items, build, N_STEPS,
+                      watch_s=WATCH_S, chunk=2, warm_start=ws)
+    rec = FlightRecorder(str(tmp_path / "trace"), "h0")
+    warm = run_batch_chunked(config, items, build, N_STEPS,
+                             watch_s=WATCH_S, chunk=2,
+                             warm_start=ws, trace=rec)
+    rec.close()
+    assert len(warm) == len(items)
+    events = merge_trace(str(tmp_path / "trace"))
+    rows = [e for e in events if e["kind"] == "row"]
+    assert len(rows) == len(items)
+    assert all(e["cached"] for e in rows)
+    assert finalize_keys(events) == {}
+
+
+def test_trace_off_means_no_shard(tmp_path):
+    """``trace=None`` (the default) writes nothing anywhere."""
+    config = small_config()
+    items, build = chunked_fixture(config)
+    run_batch_chunked(config, items, build, N_STEPS,
+                      watch_s=WATCH_S, chunk=2)
+    assert shard_paths(str(tmp_path)) == []
+
+
+# -- the fabric under trace ---------------------------------------------
+
+def test_ledger_lease_events(tmp_path):
+    """Claim / beat / steal / done / duplicate all land in the event
+    shard with unit + generation."""
+    meta = {"grid": "x"}
+    clock = [1000.0]
+    rec = FlightRecorder(str(tmp_path / "trace"), "h1",
+                         clock=lambda: clock[0])
+    ledger = WorkLedger(str(tmp_path / "fab"), meta, "h1",
+                        lease_s=5.0, clock=lambda: clock[0],
+                        sleep=lambda s: None, trace=rec)
+    units = plan_units([4], [2])
+    assert ledger.try_claim(units[0]) == "claimed"
+    ledger.heartbeat(units[0])
+    ledger.finalize(units[0], rows=2)
+    # a second host claims unit 1, dies (stops renewing); h1 steals
+    other = WorkLedger(str(tmp_path / "fab"), meta, "h2",
+                       lease_s=5.0, clock=lambda: clock[0],
+                       sleep=lambda s: None)
+    assert other.try_claim(units[1]) == "claimed"
+    clock[0] += 10.0  # past h2's lease
+    assert ledger.try_claim(units[1]) == "claimed"
+    # h2 finishes anyway: the counted-duplicate path
+    ledger.finalize(units[1], rows=2)
+    other.finalize(units[1], rows=2)
+    rec.close()
+    events = merge_trace(str(tmp_path / "trace"))
+    lease = [(e["action"], e["unit"]) for e in events
+             if e["kind"] == "lease"]
+    assert lease == [("claim", 0), ("beat", 0), ("done", 0),
+                     ("steal", 1), ("done", 1)]
+    # the loser records its duplicate in ITS shard if traced; here
+    # h2 is untraced, so only the claim-file record exists — which
+    # is exactly why fleet_report stays the claim-file ground truth
+
+
+# -- concurrency: two writers + a mid-write reader ----------------------
+
+_WRITER_SCRIPT = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from hlsjs_p2p_wrapper_tpu.engine.tracer import FlightRecorder
+rec = FlightRecorder({trace_dir!r}, {host!r})
+for i in range({n}):
+    rec.emit("mark", name="e%d" % i, i=i)
+    if i % 5 == 4:
+        rec.flush()
+        time.sleep(0.002)
+rec.close()
+print("done")
+"""
+
+
+def _assert_prefix_consistent(events):
+    """Per host: seq values are 0..k contiguous (a durable PREFIX of
+    that host's stream) and (t, seq) is monotone."""
+    per_host = {}
+    for event in events:
+        per_host.setdefault(event["host"], []).append(event)
+    for host, evs in per_host.items():
+        seqs = [e["seq"] for e in evs]
+        assert seqs == list(range(len(seqs))), \
+            f"{host}: merged seqs not a contiguous prefix: {seqs[:10]}"
+        stamps = [(e["t"], e["seq"]) for e in evs]
+        assert stamps == sorted(stamps), f"{host}: not monotone"
+
+
+def test_two_writers_reader_merges_mid_write_with_sigkill(tmp_path):
+    """Two processes append their own shards; a reader merges
+    MID-WRITE (prefix-consistent, per-host monotone, no crash); one
+    writer is SIGKILLed at flush time and its durable prefix still
+    merges cleanly."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trace_dir = str(tmp_path)
+    procs = {}
+    for host, n in (("w0", 400), ("w1", 4000)):
+        script = _WRITER_SCRIPT.format(repo=repo, trace_dir=trace_dir,
+                                       host=host, n=n)
+        procs[host] = subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    w1_shard = os.path.join(trace_dir, "w1.jsonl")
+    deadline = time.time() + 60.0
+    mid_write_merges = 0
+    killed = False
+    while time.time() < deadline:
+        if os.path.exists(w1_shard):
+            events = merge_trace(trace_dir)  # mid-write read
+            _assert_prefix_consistent(events)
+            mid_write_merges += 1
+            if (not killed
+                    and os.path.getsize(w1_shard) > 4096):
+                # SIGKILL w1 while it is actively appending/flushing
+                os.kill(procs["w1"].pid, signal.SIGKILL)
+                killed = True
+        if procs["w0"].poll() is not None and killed:
+            break
+        time.sleep(0.01)
+    assert killed, "w1 never grew a shard to kill"
+    assert procs["w0"].wait(timeout=60) == 0
+    assert procs["w1"].wait(timeout=60) == -signal.SIGKILL
+    assert mid_write_merges >= 2, "reader never merged mid-write"
+    final = merge_trace(trace_dir)
+    _assert_prefix_consistent(final)
+    w0 = [e for e in final if e["host"] == "w0"]
+    w1 = [e for e in final if e["host"] == "w1"]
+    assert len(w0) == 400               # clean writer: complete
+    assert 0 < len(w1) < 4000           # killed writer: a prefix
+    # and the shard metas survived both fates
+    for host in ("w0", "w1"):
+        meta, _ = read_shard(os.path.join(trace_dir,
+                                          f"{host}.jsonl"))
+        assert meta["host"] == host
+
+
+# -- the Perfetto exporter ----------------------------------------------
+
+def test_trace_export_structure(tmp_path):
+    """Chrome trace-event JSON: per-host pid + process_name
+    metadata, X span events with microsecond durations, instant
+    lease/fault events, counter tracks for retries and cache
+    hits."""
+    import trace_export
+    registry = MetricsRegistry()
+    for host in ("hA", "hB"):
+        rec = FlightRecorder(str(tmp_path), host, registry=registry)
+        with rec.span("dispatch", group=0, chunk=1):
+            pass
+        with rec.context(group=0, chunk=1, attempt=0):
+            registry.counter("dispatch_faults", reason="transient",
+                             action="retry").inc()
+        registry.counter("aot_cache_events", layer="row",
+                         result="hit").inc()
+        rec.row("k", group=0, index=0, journaled=True)
+        rec.lease("claim", unit=3, gen=0)
+        rec.close()
+        registry.remove_listener(rec._on_bump)
+
+    trace = trace_export.export_dir(str(tmp_path))
+    text = json.dumps(trace)            # must be JSON-serializable
+    assert "traceEvents" in json.loads(text)
+    events = trace["traceEvents"]
+    by_ph = {}
+    for e in events:
+        by_ph.setdefault(e["ph"], []).append(e)
+    # every event carries pid; data events carry ts
+    assert all("pid" in e for e in events)
+    assert all("ts" in e for e in events if e["ph"] != "M")
+    # one process per host, named
+    names = {e["args"]["name"] for e in by_ph["M"]
+             if e["name"] == "process_name"}
+    assert names == {"host hA", "host hB"}
+    pids = {e["pid"] for e in events if e["ph"] != "M"}
+    assert len(pids) == 2
+    # complete span events with durations
+    spans = by_ph["X"]
+    assert {e["name"] for e in spans} == {"dispatch"}
+    assert all(e["dur"] >= 0 for e in spans)
+    # counter tracks for retries and cache hits, cumulative
+    counter_names = {e["name"] for e in by_ph["C"]}
+    assert {"retries", "cache_hits", "rows_done"} <= counter_names
+    # instant events for faults and lease steps
+    instant_names = {e["name"] for e in by_ph["i"]}
+    assert "lease:claim" in instant_names
+    assert any(name.startswith("fault:") for name in instant_names)
+
+
+# -- the fleet console --------------------------------------------------
+
+def test_console_frame_renders_fabric_and_trace(tmp_path):
+    """One post-mortem frame over a handcrafted fabric dir + event
+    shard: unit progress, lease runway (expired holder flagged),
+    per-host activity."""
+    import fleet_console
+    claims = tmp_path / "fab" / "claims"
+    os.makedirs(claims)
+    now = time.time()
+
+    def write_claims(name, records):
+        with open(claims / name, "w", encoding="utf-8") as fh:
+            for record in records:
+                fh.write(json.dumps(record) + "\n")
+
+    write_claims("unit-00000.jsonl", [
+        {"kind": "claim", "host": "hA", "gen": 0,
+         "expires_s": now + 100},
+        {"kind": "done", "host": "hA", "gen": 0, "rows": 6}])
+    write_claims("unit-00001.jsonl", [
+        {"kind": "claim", "host": "hB", "gen": 0,
+         "expires_s": now - 5}])       # expired, steal candidate
+    rec = FlightRecorder(str(tmp_path / "trace"), "hA")
+    rec.row("k", group=0, index=0, journaled=True)
+    rec.close()
+    frame = fleet_console.render_frame(str(tmp_path / "fab"),
+                                       str(tmp_path / "trace"),
+                                       now=now)
+    assert "1/2 units done" in frame
+    assert "lease hB" in frame and "EXPIRED" in frame
+    assert "hA" in frame and "rows" in frame
+
+
+def test_console_tolerates_live_torn_tail(tmp_path):
+    """Tailing a shard whose last line is mid-write must render the
+    durable prefix, not crash."""
+    import fleet_console
+    rec = FlightRecorder(str(tmp_path / "trace"), "h")
+    rec.row("k", group=0, index=0)
+    rec.close()
+    with open(tmp_path / "trace" / "h.jsonl", "a",
+              encoding="utf-8") as fh:
+        fh.write('{"t": 1, "kind": "row", "ke')
+    frame = fleet_console.render_frame(None, str(tmp_path / "trace"))
+    assert "h" in frame

@@ -288,6 +288,75 @@ def test_json_output_round_trips(tmp_path, capsys):
     assert entry["findings"][0]["reason"] == "offload_stall"
 
 
+# -- grid-level triage (the --grid mode) --------------------------------
+
+def synthetic_grid_records():
+    """A 3×2 synthetic knob grid (axis ``uplink_mbps`` × axis
+    ``wave``, every other knob held fixed): every uplink=1.2 point
+    stalls BY CONSTRUCTION, every other point is healthy — so the
+    uplink axis flips 1.2↔2.4 neighbors on both wave lines and the
+    wave axis flips nothing."""
+    records = []
+    for up in (1.2, 2.4, 4.0):
+        for wave in ("steady", "crowd"):
+            base = stalled_record() if up == 1.2 else healthy_record()
+            records.append({**base, "uplink_mbps": up, "wave": wave,
+                            "urgent_margin_s": 4.0})
+    return records
+
+
+def test_grid_axes_need_two_values():
+    records = synthetic_grid_records()
+    assert sorted(triage.grid_axes(records)) == \
+        ["uplink_mbps", "wave"]
+    records[0]["urgent_margin_s"] = 99.0  # now a second value
+    assert "urgent_margin_s" in triage.grid_axes(records)
+
+
+def test_grid_triage_finds_the_flipping_axis():
+    """1-D neighbor diffs: the pathology lives on the uplink axis
+    (1.2 stalls, 2.4 does not, everything else held fixed); the
+    wave axis never flips a point."""
+    records = synthetic_grid_records()
+    triaged = triage.triage_records(records)
+    flagged = {entry["point"] for entry in triaged}
+    assert flagged == {0, 1}  # exactly the uplink=1.2 points
+    grid = triage.grid_triage(records, triaged)
+    assert set(grid["axes"]) == {"uplink_mbps"}
+    assert grid["axes"]["uplink_mbps"]["flips"] == 2
+    for flip in grid["flips"]:
+        assert flip["axis"] == "uplink_mbps"
+        assert flip["flagged_value"] == 1.2
+        assert flip["healthy_value"] == 2.4
+        assert flip["reasons"] == ["offload_stall"]
+    # a fully-healthy grid reports no flips at all
+    healthy = [{**healthy_record(), "uplink_mbps": up, "wave": w,
+                "urgent_margin_s": 4.0}
+               for up in (1.2, 2.4) for w in ("steady", "crowd")]
+    assert triage.grid_triage(healthy,
+                              triage.triage_records(healthy)) == \
+        {"axes": {}, "flips": []}
+
+
+def test_grid_mode_emits_into_triage_json(tmp_path, capsys):
+    """--grid --json appends one {"grid": ...} line after the
+    per-point findings; text mode prints the axis summary."""
+    records = synthetic_grid_records()
+    path = tmp_path / "grid.jsonl"
+    with open(path, "w", encoding="utf-8") as f:
+        for record in records:
+            f.write(json.dumps(record) + "\n")
+    triage.main([str(path), "--grid", "--json"])
+    lines = [json.loads(line) for line in
+             capsys.readouterr().out.strip().splitlines()]
+    assert "grid" in lines[-1]
+    assert "uplink_mbps" in lines[-1]["grid"]["axes"]
+    assert all("point" in line for line in lines[:-1])
+    triage.main([str(path), "--grid"])
+    out = capsys.readouterr().out
+    assert "grid axis uplink_mbps" in out
+
+
 def test_end_to_end_on_a_real_sweep_dump(tmp_path):
     """The real pipeline at test scale: sweep a live slice with
     --timelines-out, then triage the file (schema compatibility —

@@ -1,0 +1,234 @@
+"""Live / post-mortem fleet console over claim files + event shards.
+
+``fleet_report()`` (engine/fabric.py) derives a fleet's ground truth
+from the claim files once, after the fact; this console makes the
+same derivation CONTINUOUS: it tails the fabric directory's claim
+files and the flight recorder's per-host event shards
+(``tools/sweep.py --fabric DIR --trace-dir TRACE``) and renders, per
+refresh:
+
+- **unit progress** — done / leased / unclaimed counts and the
+  grid's completion fraction (claim files alone: a SIGKILL'd host's
+  records survive it);
+- **lease health** — which host holds which units, seconds of lease
+  runway left, and holders already past expiry (steal candidates);
+- **per-host activity** (event shards) — rows completed and row
+  throughput over the trailing window, retry/backoff and bisection
+  counts (``dispatch_faults``), row-cache hit rate
+  (``aot_cache_events``), and the age of each host's last event
+  (a heartbeat: a silent shard is a dead or wedged host).
+
+Both sources are append-only and torn-tail tolerant
+(``read_jsonl_tolerant``), so tailing a LIVE fleet mid-write is safe
+by construction — the console sees each shard's durable prefix.
+One frame prints by default (the post-mortem read); ``--follow``
+refreshes every ``--interval`` seconds until interrupted or — with
+``--max-frames`` — a frame budget runs out.
+
+Usage::
+
+    python tools/sweep.py --fabric FAB --hosts 3 --trace-dir TR &
+    python tools/fleet_console.py --fabric FAB --trace TR --follow
+
+    # post-mortem, after the run (or a crash):
+    python tools/fleet_console.py --fabric FAB --trace TR
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
+    read_jsonl_tolerant)
+from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
+    merge_trace)
+
+#: trailing window for the rows/s throughput read
+RATE_WINDOW_S = 30.0
+
+
+def read_units(fabric_dir):
+    """Per-unit lease/completion state from the claim files (the
+    ledger's ``_view`` rule: last claim holds the lease, first done
+    wins): ``{unit: {"done", "holder", "gen", "expires_s",
+    "claims", "dones"}}``."""
+    claims_dir = os.path.join(fabric_dir, "claims")
+    units = {}
+    names = (sorted(os.listdir(claims_dir))
+             if os.path.isdir(claims_dir) else [])
+    for name in names:
+        if not name.endswith(".jsonl"):
+            continue
+        try:
+            records = list(read_jsonl_tolerant(
+                os.path.join(claims_dir, name)))
+        except OSError:
+            continue  # fault-ok: a claim file vanishing mid-scan is
+            # a racing cleanup; the next frame re-reads the directory
+        done = next((r for r in records if r.get("kind") == "done"),
+                    None)
+        lease, expires = None, 0.0
+        for r in records:
+            if r.get("kind") == "claim":
+                lease, expires = r, float(r.get("expires_s", 0.0))
+            elif (r.get("kind") == "beat" and lease is not None
+                  and r.get("host") == lease.get("host")
+                  and r.get("gen") == lease.get("gen")):
+                expires = max(expires, float(r.get("expires_s", 0.0)))
+        units[name] = {
+            "done": done is not None,
+            "winner": done.get("host") if done else None,
+            "holder": lease.get("host") if lease else None,
+            "gen": lease.get("gen") if lease else None,
+            "expires_s": expires,
+            "claims": sum(1 for r in records
+                          if r.get("kind") == "claim"),
+            "dones": sum(1 for r in records
+                         if r.get("kind") == "done"),
+        }
+    return units
+
+
+def host_activity(events, now):
+    """Per-host derived activity from a merged event stream:
+    rows / rows-per-second (trailing window) / retries / bisections /
+    cache hit rate / last-event age."""
+    hosts = {}
+    for event in events:
+        host = hosts.setdefault(event.get("host", "?"), {
+            "rows": 0, "recent_rows": [], "retries": 0,
+            "bisections": 0, "giveups": 0, "cache_hits": 0,
+            "cache_misses": 0, "leases": 0, "last_t": 0.0})
+        host["last_t"] = max(host["last_t"], event.get("t", 0.0))
+        kind = event.get("kind")
+        if kind == "row":
+            host["rows"] += 1
+            host["recent_rows"].append(event.get("t", 0.0))
+        elif kind == "lease":
+            host["leases"] += 1
+        elif kind == "counter":
+            labels = event.get("labels", "")
+            n = int(event.get("n", 1))
+            if event.get("name") == "dispatch_faults":
+                if "action=retry" in labels:
+                    host["retries"] += n
+                elif "action=bisect" in labels:
+                    host["bisections"] += n
+                elif "action=giveup" in labels:
+                    host["giveups"] += n
+            elif event.get("name") == "aot_cache_events":
+                if "layer=row,result=hit" in labels:
+                    host["cache_hits"] += n
+                elif "layer=row,result=miss" in labels:
+                    host["cache_misses"] += n
+    for host in hosts.values():
+        recent = [t for t in host.pop("recent_rows")
+                  if t >= now - RATE_WINDOW_S]
+        host["rows_per_s"] = round(len(recent) / RATE_WINDOW_S, 3)
+        looked = host["cache_hits"] + host["cache_misses"]
+        host["hit_rate"] = (round(host["cache_hits"] / looked, 3)
+                            if looked else None)
+        host["age_s"] = round(max(now - host["last_t"], 0.0), 1)
+    return hosts
+
+
+def render_frame(fabric_dir=None, trace_dir=None, now=None) -> str:
+    """One console frame as text (the testable surface)."""
+    now = time.time() if now is None else now
+    lines = []
+    if fabric_dir:
+        units = read_units(fabric_dir)
+        done = sum(1 for u in units.values() if u["done"])
+        leased = {}
+        for unit in units.values():
+            if unit["done"] or unit["holder"] is None:
+                continue
+            leased.setdefault(unit["holder"], []).append(
+                unit["expires_s"] - now)
+        total = len(units)
+        frac = done / total if total else 0.0
+        lines.append(f"fabric {fabric_dir}: {done}/{total} units "
+                     f"done ({frac:.0%}), "
+                     f"{sum(len(v) for v in leased.values())} "
+                     f"leased, "
+                     f"{total - done - sum(len(v) for v in leased.values())} "
+                     f"unclaimed")
+        for host in sorted(leased):
+            runways = leased[host]
+            lines.append(
+                f"  lease {host}: {len(runways)} unit(s), min "
+                f"runway {min(runways):+.1f}s"
+                + ("  ** EXPIRED — steal candidate **"
+                   if min(runways) <= 0 else ""))
+        duplicates = sum(max(u["dones"] - 1, 0)
+                         for u in units.values())
+        takeovers = sum(max(u["claims"] - 1, 0)
+                        for u in units.values())
+        if takeovers or duplicates:
+            lines.append(f"  takeovers {takeovers}, duplicate "
+                         f"completions {duplicates}")
+    if trace_dir:
+        hosts = host_activity(merge_trace(trace_dir), now)
+        if hosts:
+            lines.append(f"trace {trace_dir}: "
+                         f"{len(hosts)} host shard(s)")
+            header = (f"  {'host':<10} {'rows':>6} {'rows/s':>7} "
+                      f"{'retry':>6} {'bisect':>6} {'giveup':>6} "
+                      f"{'hit%':>6} {'last evt':>9}")
+            lines.append(header)
+            for name in sorted(hosts):
+                h = hosts[name]
+                hit = (f"{h['hit_rate']:.0%}"
+                       if h["hit_rate"] is not None else "-")
+                lines.append(
+                    f"  {name:<10} {h['rows']:>6} "
+                    f"{h['rows_per_s']:>7} {h['retries']:>6} "
+                    f"{h['bisections']:>6} {h['giveups']:>6} "
+                    f"{hit:>6} {h['age_s']:>8.1f}s")
+        else:
+            lines.append(f"trace {trace_dir}: no event shards yet")
+    if not lines:
+        lines.append("nothing to watch (pass --fabric and/or --trace)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fabric", metavar="DIR",
+                    help="fabric directory (claim files) to tail")
+    ap.add_argument("--trace", metavar="DIR",
+                    help="flight-recorder trace directory to tail")
+    ap.add_argument("--follow", action="store_true",
+                    help="refresh continuously (default: one "
+                         "post-mortem frame)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    metavar="S", help="refresh period under "
+                    "--follow (default 2s)")
+    ap.add_argument("--max-frames", type=int, default=0, metavar="N",
+                    help="stop after N frames under --follow "
+                         "(0 = until interrupted; test hook)")
+    args = ap.parse_args(argv)
+    if not (args.fabric or args.trace):
+        ap.error("nothing to watch: pass --fabric DIR and/or "
+                 "--trace DIR")
+    frames = 0
+    while True:
+        print(render_frame(args.fabric, args.trace))
+        frames += 1
+        if not args.follow or (args.max_frames
+                               and frames >= args.max_frames):
+            return 0
+        print(f"--- refresh in {args.interval:g}s "
+              f"(ctrl-c to stop) ---")
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -268,6 +268,7 @@ def check_broad_excepts(path):
 CLOCK_FILES = (
     os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "fabric.py"),
     os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "faults.py"),
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "tracer.py"),
     os.path.join("hlsjs_p2p_wrapper_tpu", "ops", "swarm_sim.py"),
 )
 
@@ -308,6 +309,111 @@ def check_clock_discipline(path):
     return findings
 
 
+#: roots the metrics reference is collected from: the package (what
+#: the engine emits) plus tools/ (soak's invariant gauges).  Tests
+#: mint throwaway families and must not pollute the reference.
+METRIC_ROOTS = ("hlsjs_p2p_wrapper_tpu", "tools")
+
+#: the registry's instrument constructors (engine/telemetry.py)
+_INSTRUMENT_KINDS = ("counter", "gauge", "histogram")
+
+
+def collect_metric_families(repo_root):
+    """Every registry instrument family the code actually emits:
+    AST scan for ``<anything>.counter/gauge/histogram("name", k=v)``
+    calls with a LITERAL name (the registry's only call shape), each
+    recorded as (family name, kind, label-key signature, file).
+    Label keywords give the signature; a ``**labels`` splat records
+    as ``**`` (dynamic labels, e.g. the per-peer ``agent.*``
+    series).  Keyed by (name, kind) with the union of signatures —
+    the committed ``METRICS.md`` is rendered from exactly this."""
+    families = {}
+    for root in METRIC_ROOTS:
+        base = os.path.join(repo_root, root)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fname in filenames:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, repo_root).replace(
+                    os.sep, "/")
+                with open(path, encoding="utf-8") as fh:
+                    try:
+                        tree = ast.parse(fh.read(), filename=path)
+                    except SyntaxError:
+                        continue  # check_file reports it
+                for node in ast.walk(tree):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in _INSTRUMENT_KINDS
+                            and node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and isinstance(node.args[0].value, str)):
+                        continue
+                    labels = []
+                    for kw in node.keywords:
+                        if kw.arg is None:
+                            labels.append("**")
+                        elif kw.arg != "buckets":
+                            labels.append(kw.arg)
+                    key = (node.args[0].value, node.func.attr)
+                    entry = families.setdefault(
+                        key, {"labels": set(), "files": set()})
+                    entry["labels"].add(tuple(sorted(labels)))
+                    entry["files"].add(rel)
+    return families
+
+
+def render_metrics_md(families) -> str:
+    """The committed metrics reference, rendered deterministically
+    from :func:`collect_metric_families`."""
+    lines = [
+        "# METRICS — registry instrument families",
+        "",
+        "Every `MetricsRegistry` family the package and tools emit",
+        "(engine/telemetry.py), with label-key signatures, collected",
+        "by AST scan.  GENERATED — regenerate with",
+        "`python -m tools.lint --write-metrics`; `make lint` fails",
+        "when this file drifts from the code.",
+        "",
+        "Label sets are the KEYWORD signatures at the emit sites;",
+        "`**` marks dynamic labels (a splat like the per-peer",
+        "`agent.*{peer=…}` series).  The flight recorder",
+        "(engine/tracer.py) correlates `dispatch_faults`,",
+        "`fabric_claims`, and `aot_cache_events` bumps into its",
+        "event stream, and `make trace-gate` asserts that stream",
+        "replays back to these families exactly.",
+        "",
+        "| family | kind | labels | emitted from |",
+        "|---|---|---|---|",
+    ]
+    for (name, kind) in sorted(families):
+        entry = families[(name, kind)]
+        sigs = sorted(", ".join(sig) if sig else "—"
+                      for sig in entry["labels"])
+        lines.append(
+            f"| `{name}` | {kind} | {' / '.join(sigs)} | "
+            f"{', '.join(sorted(entry['files']))} |")
+    return "\n".join(lines) + "\n"
+
+
+def check_metrics_reference(repo_root):
+    """Drift check: ``METRICS.md`` must match what the code emits."""
+    expected = render_metrics_md(collect_metric_families(repo_root))
+    path = os.path.join(repo_root, "METRICS.md")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            committed = fh.read()
+    except OSError:
+        return [f"{path}:1: METRICS.md is missing — generate it "
+                f"with 'python -m tools.lint --write-metrics'"]
+    if committed != expected:
+        return [f"{path}:1: METRICS.md is out of date with the "
+                f"registry families the code emits — regenerate "
+                f"with 'python -m tools.lint --write-metrics'"]
+    return []
+
+
 def check_static_knobs(sweep_path):
     """Compile-group discipline for ``tools/sweep.py``: the
     ``STATIC_KNOBS`` tuple must exist, and every element's source
@@ -346,8 +452,16 @@ def check_static_knobs(sweep_path):
     return findings
 
 
-def main():
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if "--write-metrics" in argv:
+        path = os.path.join(repo_root, "METRICS.md")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(render_metrics_md(
+                collect_metric_families(repo_root)))
+        print(f"wrote {path}", file=sys.stderr)
+        return 0
     all_findings = []
     count = 0
     tools_root = os.path.join(repo_root, "tools") + os.sep
@@ -365,6 +479,7 @@ def main():
             all_findings.extend(check_clock_discipline(path))
     all_findings.extend(check_static_knobs(
         os.path.join(repo_root, "tools", "sweep.py")))
+    all_findings.extend(check_metrics_reference(repo_root))
     for finding in sorted(all_findings):
         print(finding)
     print(f"lint: {count} files, {len(all_findings)} findings",

@@ -54,6 +54,8 @@ from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
     enable_persistent_compilation_cache, journal_path)
 from hlsjs_p2p_wrapper_tpu.engine.faults import (  # noqa: E402
     FaultPlan, FaultPolicy)
+from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
+    FlightRecorder)
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
     SwarmConfig, make_scenario, random_neighbors, ring_offsets,
     run_groups_chunked, stable_ranks, staggered_joins,
@@ -124,7 +126,7 @@ def build_cell_scenario(config, neighbors, audience, *, uplink_bps,
 
 def run_cells_batched(config, neighbors, audience, cells, *, watch_s,
                       chunk, record_every=0, warm_start=None,
-                      faults=None, journal=None):
+                      faults=None, journal=None, trace=None):
     """All regime cells of one (topology, policy) compile group
     through the shared chunked/pipelined dispatch engine
     (``run_groups_chunked``); returns ``(metrics, resolved_chunk)``
@@ -149,7 +151,7 @@ def run_cells_batched(config, neighbors, audience, cells, *, watch_s,
               pattern=cell[0], wave=cell[1], watch_s=watch_s))],
         n_steps, watch_s=watch_s, chunk=chunk,
         record_every=record_every, warm_start=warm_start,
-        faults=faults, journal=journal)
+        faults=faults, journal=journal, trace=trace)
     metrics = results[0]
     if record_every:
         rounded = [m if m is None else (round(m[0], 4),
@@ -200,6 +202,12 @@ def main():
                     help="deterministic fault plane (chaos/test "
                          "hook): kind@group:chunk[xN] coordinates "
                          "(engine/faults.py FaultPlan)")
+    ap.add_argument("--trace-dir", metavar="DIR",
+                    help="arm the flight recorder (engine/tracer.py)"
+                         ": append-only event shard under DIR with "
+                         "dispatch spans + correlated fault/cache "
+                         "counter events + row finalizes (export "
+                         "with tools/trace_export.py)")
     args = ap.parse_args()
     if args.timelines_out and not args.record_every:
         args.record_every = 20
@@ -226,6 +234,14 @@ def main():
               if args.inject_faults else None),
         registry=(warm_start.registry if warm_start is not None
                   else None))
+    trace = None
+    if args.trace_dir:
+        # attach before any engine work so every counter bump of the
+        # run lands in the event shard (tools/sweep.py's wiring)
+        trace = FlightRecorder(
+            args.trace_dir, "policy_ab",
+            registry=(warm_start.registry if warm_start is not None
+                      else faults.registry))
     journal = None
     if args.resume and (warm_start is None
                         or not warm_start.rows_enabled):
@@ -281,7 +297,7 @@ def main():
                 watch_s=args.watch_s, chunk=args.chunk,
                 record_every=args.record_every,
                 warm_start=warm_start, faults=faults,
-                journal=journal)
+                journal=journal, trace=trace)
             resolved_chunks[f"{topology}/{policy}"] = resolved
             if args.record_every:
                 # strip the timeline blocks back off the metric pairs
@@ -497,6 +513,11 @@ def main():
         if not failed_cells:
             journal.finalize()
         journal.close()
+    if trace is not None:
+        trace.close()
+        print(f"# trace: event shard {trace.path} (export: python "
+              f"tools/trace_export.py {args.trace_dir})",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -29,7 +29,6 @@ Usage: ``python tools/soak.py [--rounds N] [--seed S]
 """
 
 import argparse
-import json
 import os
 import random
 import sys
@@ -37,6 +36,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
+    read_jsonl_tolerant)
 from hlsjs_p2p_wrapper_tpu.testing import SwarmHarness  # noqa: E402
 
 
@@ -124,8 +125,12 @@ def main() -> int:
           f"penalties={len(mesh._holder_penalty)}")
 
     # ---- invariants, checked from the EXPORTED artifact ------------
-    with open(args.metrics_out, encoding="utf-8") as fh:
-        records = [json.loads(line) for line in fh]
+    # torn-tail-tolerant read (the journal/claim-file/event-shard
+    # protocol, engine/artifact_cache.py): a crash mid-export leaves
+    # a parseable prefix instead of a JSONDecodeError, and the
+    # line-count invariant below still fails LOUDLY on the missing
+    # record rather than on a parse traceback
+    records = list(read_jsonl_tolerant(args.metrics_out))
     print(f"metrics artifact: {args.metrics_out} "
           f"({len(records)} lines, "
           f"{len(records[-1]['metrics'])} series in the final line)")

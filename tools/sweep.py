@@ -118,6 +118,8 @@ from hlsjs_p2p_wrapper_tpu.engine.fabric import (  # noqa: E402
     FleetChaos, WorkLedger, barrier, fleet_report, run_units)
 from hlsjs_p2p_wrapper_tpu.engine.faults import (  # noqa: E402
     FaultPlan, FaultPolicy)
+from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
+    FlightRecorder, counter_families, run_id_for)
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
     UNREACHABLE_BITRATE, SwarmConfig, autotune_chunk,
     ensure_penalty_width_batch, init_swarm, make_scenario,
@@ -324,7 +326,7 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
                      record_every=0, tracer=None, pipeline=True,
                      static_live_sync=False, interleave=True,
                      warm_start=None, raw=False, faults=None,
-                     journal=None):
+                     journal=None, trace=None):
     """The batched engine: one ``run_swarm_batch`` dispatch per
     padded chunk per compile group, host readback pipelined one chunk
     behind the device, chunks round-robined across groups when more
@@ -353,7 +355,9 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
     ``rebuffer`` None) and ``info["failures"]`` carries the
     structured report.  ``journal``
     (engine/artifact_cache.py ``SweepJournal``) records each
-    completed row crash-safely for ``--resume``."""
+    completed row crash-safely for ``--resume``.  ``trace``
+    (engine/tracer.py ``FlightRecorder``) arms the flight recorder
+    (default off — the ``--trace-dir`` surface)."""
     if not grid:
         return [], {"compile_groups": 0, "chunk": None,
                     "chunk_autotuned": chunk is None, "groups": []}
@@ -365,7 +369,7 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
         group_list, n_steps, watch_s=watch_s, chunk=chunk,
         record_every=record_every, tracer=tracer, pipeline=pipeline,
         interleave=interleave, warm_start=warm_start, faults=faults,
-        journal=journal)
+        journal=journal, trace=trace)
 
     rows = [None] * len(grid)
     for (key, idxs), metrics in zip(group_keys, results):
@@ -466,7 +470,8 @@ def resolve_group_chunks(group_list, n_steps, chunk):
 def run_grid_fabric_worker(grid, *, peers, segments, watch_s, live,
                            seed, chunk, fabric_dir, host_id, lease_s,
                            warm_start, faults, chaos_spec=None,
-                           barrier_hosts=0, stagger_s=60.0):
+                           barrier_hosts=0, stagger_s=60.0,
+                           trace=None):
     """One fabric HOST process: join the work ledger, then
     claim → dispatch → journal → finalize units until the whole grid
     is done (stealing expired leases along the way), and write this
@@ -487,7 +492,8 @@ def run_grid_fabric_worker(grid, *, peers, segments, watch_s, live,
     ledger = WorkLedger(
         fabric_dir, meta, host_id, lease_s=lease_s,
         registry=warm_start.registry,
-        chaos=FleetChaos.parse(chaos_spec) if chaos_spec else None)
+        chaos=FleetChaos.parse(chaos_spec) if chaos_spec else None,
+        trace=trace)
     units, chunks = ledger.ensure_manifest(
         [len(items) for _, items, _ in group_list],
         resolve_group_chunks(group_list, n_steps, chunk))
@@ -529,9 +535,19 @@ def run_grid_fabric_worker(grid, *, peers, segments, watch_s, live,
         "rows": rows,
         "claims": ledger.claim_counts(),
         "faults": faults.fault_counts() if faults is not None else {},
+        # the registry's live view of the replayed families, in the
+        # flight recorder's canonical labels form: the trace gate
+        # folds this host's event shard back into counters and
+        # compares EXACTLY against this export
+        "counters": counter_families(warm_start.registry),
         "units": unit_log,
         "lease_s": lease_s,
     }
+    if trace is not None:
+        # every buffered event durable BEFORE the partial exists: a
+        # partial whose counters outran its event shard would read
+        # as an incomplete event plane
+        trace.flush()
     atomic_write_json(os.path.join(fabric_dir, "partial",
                                    f"{host_id}.json"), partial)
     return partial
@@ -664,6 +680,8 @@ def spawn_local_fleet(args, hosts):
             cmd.append("--live")
         if args.chunk is not None:
             cmd.extend(["--chunk", str(args.chunk)])
+        if args.trace_dir:
+            cmd.extend(["--trace-dir", args.trace_dir])
         procs.append(subprocess.Popen(cmd))
     rcs = [proc.wait() for proc in procs]
     if any(rcs):
@@ -748,6 +766,14 @@ def main():
                          "[xN] coordinates, kind one of oom/"
                          "transient/timeout/kill "
                          "(engine/faults.py FaultPlan)")
+    ap.add_argument("--trace-dir", metavar="DIR",
+                    help="arm the flight recorder (engine/tracer.py)"
+                         ": one append-only event shard per host "
+                         "under DIR — dispatch spans, correlated "
+                         "fault/cache/fabric counter events, row "
+                         "finalizes, lease steps.  Export with "
+                         "tools/trace_export.py, watch with "
+                         "tools/fleet_console.py")
     args = ap.parse_args()
 
     if args.timelines_out and not args.record_every:
@@ -761,6 +787,9 @@ def main():
                  "name an output file")
     if args.sequential and (args.resume or args.inject_faults):
         ap.error("--resume/--inject-faults need the batched engine "
+                 "(drop --sequential)")
+    if args.trace_dir and args.sequential:
+        ap.error("--trace-dir needs the batched engine "
                  "(drop --sequential)")
     if args.fabric:
         if args.sequential:
@@ -807,6 +836,26 @@ def main():
               if args.inject_faults else None),
         registry=(warm_start.registry if warm_start is not None
                   else None))
+    trace = None
+    if args.trace_dir and not (args.fabric and not args.host_id):
+        # the flight recorder attaches to the SHARED registry before
+        # any engine work, so every later dispatch_faults /
+        # fabric_claims / aot_cache_events bump gains its correlated
+        # event; the run id is content-addressed from the sweep
+        # identity so all hosts of one fleet stamp the same id.
+        # The fabric LAUNCHER/MERGE process records nothing: the
+        # workers own the per-host shards, and a second writer on
+        # a worker's shard would violate the one-writer-per-shard
+        # rule the whole torn-tail story rests on
+        trace_meta = journal_meta(
+            grid, peers=args.peers, segments=args.segments,
+            watch_s=args.watch_s, live=args.live, seed=args.seed,
+            record_every=args.record_every)
+        trace = FlightRecorder(
+            args.trace_dir, args.host_id or "host00",
+            run_id=run_id_for(trace_meta),
+            registry=(warm_start.registry if warm_start is not None
+                      else faults.registry))
     if args.fabric and args.host_id:
         # fabric WORKER: claim/steal/compute units until the grid is
         # done, export the partial artifact, exit (the launcher or a
@@ -818,12 +867,14 @@ def main():
             host_id=args.host_id, lease_s=args.fabric_lease_s,
             warm_start=warm_start, faults=faults,
             chaos_spec=args.fabric_chaos,
-            barrier_hosts=args.fabric_barrier)
+            barrier_hosts=args.fabric_barrier, trace=trace)
         print(f"# fabric worker {args.host_id}: "
               f"{len(partial['rows'])} rows, "
               f"claims {partial['claims'] or '{}'}, "
               f"faults {partial['faults'] or '{}'}",
               file=sys.stderr)
+        if trace is not None:
+            trace.close()
         return
     journal = None
     if args.resume and (warm_start is None
@@ -868,7 +919,8 @@ def main():
             grid, peers=args.peers, segments=args.segments,
             watch_s=args.watch_s, live=args.live, seed=args.seed,
             chunk=args.chunk, record_every=args.record_every,
-            warm_start=warm_start, faults=faults, journal=journal)
+            warm_start=warm_start, faults=faults, journal=journal,
+            trace=trace)
     elapsed = time.perf_counter() - t0
     # with the warm-start engine active, the honest compile count is
     # the number of FRESH program compiles it performed (cache misses
@@ -1000,6 +1052,12 @@ def main():
         if not failed:
             journal.finalize()
         journal.close()
+    if trace is not None:
+        trace.close()
+        print(f"# trace: event shard {trace.path} (export: python "
+              f"tools/trace_export.py {args.trace_dir}; console: "
+              f"python tools/fleet_console.py --trace "
+              f"{args.trace_dir})", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,204 @@
+"""Chrome-trace / Perfetto exporter for flight-recorder shards.
+
+Converts a trace directory of per-host event shards
+(engine/tracer.py ``FlightRecorder``; ``tools/sweep.py --trace-dir``)
+into Chrome trace-event JSON openable directly in ``ui.perfetto.dev``
+or ``chrome://tracing`` — the first time this repo's dispatch
+pipeline, fault recovery, and fabric lease protocol render on one
+causally-ordered timeline:
+
+- one PROCESS per host (``pid`` = host ordinal, named via
+  ``process_name`` metadata), so a fleet renders as parallel tracks;
+- the dispatch pipeline as complete (``ph="X"``) span events on the
+  ``dispatch`` thread — build / dispatch / readback per chunk, with
+  the trace context (group / chunk / attempt / unit) in ``args``;
+- faults and recovery as instant events on the same thread (every
+  ``dispatch_faults`` counter bump: retries, bisections, give-ups),
+  plus lease protocol steps (claim / steal / beat / done /
+  duplicate) on the ``lease`` thread;
+- counter TRACKS (``ph="C"``) per host: cumulative retries,
+  row-cache hits/misses, and rows completed — the at-a-glance
+  "is recovery or the cache doing the work" view.
+
+Timestamps are microseconds relative to the earliest event across
+all shards; span events use their recorded start stamp + measured
+duration, so overlap (the pipelined readback hiding under the next
+chunk's compute) is visible rather than inferred.
+
+Usage::
+
+    python tools/sweep.py --trace-dir TRACE/ ...
+    python tools/trace_export.py TRACE/ --out trace.json
+    # then open trace.json in ui.perfetto.dev
+
+Pure host-side work: reads shards torn-tail-tolerantly
+(engine/artifact_cache.py ``read_jsonl_tolerant``), so exporting a
+live run's directory mid-write is safe.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
+    atomic_write_text)
+from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
+    read_shard, shard_paths)
+
+#: thread ids within each host's process (named via thread_name
+#: metadata): spans + fault instants on DISPATCH, lease steps on
+#: LEASE; counter tracks attach to the process, not a thread
+TID_DISPATCH = 1
+TID_LEASE = 2
+
+
+def _micros(t, t0) -> float:
+    return round((t - t0) * 1e6, 3)
+
+
+def _span_event(event, pid, t0) -> dict:
+    args = dict(event.get("ctx", {}))
+    for key in ("group", "chunk"):
+        if key in event:
+            args[key] = event[key]
+    return {"ph": "X", "pid": pid, "tid": TID_DISPATCH,
+            "name": event.get("name", "span"),
+            "cat": "dispatch",
+            "ts": _micros(event.get("t_start", event["t"]), t0),
+            "dur": round(event.get("dur_s", 0.0) * 1e6, 3),
+            "args": args}
+
+
+def _counter_instant(event, pid, t0) -> dict:
+    """A ``dispatch_faults`` bump as an instant marker on the
+    dispatch thread: ``fault:transient|retry`` at the exact moment
+    recovery acted, context attached."""
+    return {"ph": "i", "s": "t", "pid": pid, "tid": TID_DISPATCH,
+            "name": f"fault:{event.get('labels', '')}",
+            "cat": "faults",
+            "ts": _micros(event["t"], t0),
+            "args": dict(event.get("ctx", {}))}
+
+
+def _lease_instant(event, pid, t0) -> dict:
+    args = {k: event[k] for k in ("unit", "gen", "rows", "prev_host",
+                                  "expires_s") if k in event}
+    return {"ph": "i", "s": "t", "pid": pid, "tid": TID_LEASE,
+            "name": f"lease:{event.get('action', '?')}",
+            "cat": "fabric",
+            "ts": _micros(event["t"], t0), "args": args}
+
+
+def export_trace(events, host_meta=None) -> dict:
+    """The Chrome trace-event object for a merged event stream.
+
+    ``host_meta`` optionally maps host id → its shard's meta record
+    (run id surfacing in ``otherData``)."""
+    hosts = sorted({e.get("host", "?") for e in events})
+    pids = {host: i + 1 for i, host in enumerate(hosts)}
+    t0 = min((e.get("t_start", e.get("t", 0.0)) for e in events),
+             default=0.0)
+    out = []
+    for host in hosts:
+        pid = pids[host]
+        out.append({"ph": "M", "name": "process_name", "pid": pid,
+                    "tid": 0, "args": {"name": f"host {host}"}})
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": TID_DISPATCH,
+                    "args": {"name": "dispatch"}})
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": TID_LEASE, "args": {"name": "lease"}})
+    # cumulative per-host counter tracks
+    counts = {host: {"retries": 0, "cache_hits": 0, "cache_misses": 0,
+                     "rows": 0} for host in hosts}
+    for event in events:
+        host = event.get("host", "?")
+        pid = pids[host]
+        kind = event.get("kind")
+        if kind == "span":
+            out.append(_span_event(event, pid, t0))
+        elif kind == "lease":
+            out.append(_lease_instant(event, pid, t0))
+        elif kind == "row":
+            counts[host]["rows"] += 1
+            out.append({"ph": "C", "pid": pid, "name": "rows_done",
+                        "ts": _micros(event["t"], t0),
+                        "args": {"rows": counts[host]["rows"]}})
+        elif kind == "counter":
+            name = event.get("name")
+            labels = event.get("labels", "")
+            if name == "dispatch_faults":
+                out.append(_counter_instant(event, pid, t0))
+                if "action=retry" in labels:
+                    counts[host]["retries"] += int(event.get("n", 1))
+                    out.append({"ph": "C", "pid": pid,
+                                "name": "retries",
+                                "ts": _micros(event["t"], t0),
+                                "args": {"retries":
+                                         counts[host]["retries"]}})
+            elif name == "aot_cache_events":
+                bucket = ("cache_hits" if "result=hit" in labels
+                          else "cache_misses"
+                          if "result=miss" in labels else None)
+                if bucket:
+                    counts[host][bucket] += int(event.get("n", 1))
+                    out.append({"ph": "C", "pid": pid,
+                                "name": bucket,
+                                "ts": _micros(event["t"], t0),
+                                "args": {bucket:
+                                         counts[host][bucket]}})
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {
+                "source": "hlsjs_p2p_wrapper_tpu flight recorder",
+                "hosts": hosts,
+                **({"runs": host_meta} if host_meta else {})}}
+
+
+def export_dir(trace_dir: str) -> dict:
+    """Merge + export one trace directory — one read per shard
+    (events and metas collected in the same pass, then merged in
+    ``merge_trace``'s (clock, host, seq) order)."""
+    metas = {}
+    events = []
+    for path in shard_paths(trace_dir):
+        try:
+            meta, shard_events = read_shard(path)
+        except OSError:
+            continue
+        if meta:
+            metas[meta.get("host", os.path.basename(path))] = \
+                meta.get("run_id")
+        events.extend(shard_events)
+    events.sort(key=lambda e: (e.get("t", 0.0), str(e.get("host")),
+                               e.get("seq", 0)))
+    return export_trace(events, host_meta=metas)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("trace_dir", metavar="DIR",
+                    help="flight-recorder trace directory "
+                         "(per-host *.jsonl event shards)")
+    ap.add_argument("--out", metavar="FILE",
+                    help="output path (default: DIR/trace.json)")
+    args = ap.parse_args(argv)
+    out_path = args.out or os.path.join(args.trace_dir, "trace.json")
+    trace = export_dir(args.trace_dir)
+    n = len(trace["traceEvents"])
+    if not n:
+        print(f"trace_export: no events under {args.trace_dir}",
+              file=sys.stderr)
+        return 1
+    atomic_write_text(out_path, json.dumps(trace) + "\n")
+    print(f"# wrote {n} trace events for "
+          f"{len(trace['otherData']['hosts'])} host(s) to {out_path} "
+          f"— open in ui.perfetto.dev", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,294 @@
+"""Trace gate: the flight recorder's event stream is COMPLETE ground
+truth, at process granularity.
+
+A unified event plane (engine/tracer.py) is only worth reading if
+nothing escapes it: a retry that bumped a counter but left no event
+— or a journaled row with no finalize event — would make every
+downstream consumer (Perfetto export, fleet console, the ROADMAP's
+control plane) silently wrong.  This gate runs a 3-worker fleet of
+``tools/sweep.py --fabric --trace-dir`` on the shipped VOD grid with
+one injected SIGKILL and one injected transient burst, then asserts
+the stream IS the registries:
+
+1. **fleet** — three workers behind a start barrier (pre-warmed
+   executables, so chaos schedules fire deterministically):
+
+   - ``host01`` carries ``kill@1``: SIGKILLed claiming its second
+     unit (lease held, nothing flushed voluntarily — only what the
+     per-chunk flush discipline already made durable survives);
+   - ``host02`` carries ``--inject-faults transient@0:0x2``: its
+     first unit's first two dispatch attempts fail and recover
+     under bounded backoff — exactly 2 counted retries;
+   - ``host00`` is clean; the dead host's unit is stolen on lease
+     expiry so the grid completes.
+
+2. **replay == registry**, exactly: for each SURVIVING worker, its
+   partial artifact exports the live registry's
+   ``dispatch_faults`` / ``fabric_claims`` / ``aot_cache_events``
+   families (the flight recorder's canonical label form) and
+   replaying that host's event shard
+   (``tracer.replay_counter_families``) must reproduce all three
+   families EXACTLY — not approximately, not a superset.
+3. **journal ↔ finalize**, per host (the killed host included): every
+   row key in a host's journal shard maps to EXACTLY ONE
+   ``journaled=True`` row event in that host's event shard — the
+   engine flushes finalize events before the journal fsyncs, so
+   this holds even through the SIGKILL.
+4. **merge completes** (the survivors + one steal finish the grid)
+   and the burst shows up as exactly 2 transient retries in both
+   the replayed events and the exported registry.
+5. **the consumers hold**: ``tools/trace_export.py`` produces
+   structurally valid Chrome trace JSON for the run (per-host pids,
+   ``X`` span events with durations, ``C`` counter tracks) and
+   ``tools/fleet_console.py`` renders a post-mortem frame.
+
+Gate-sized swarms by default; ``TRACE_GATE_PEERS`` etc. scale it up,
+``TRACE_GATE_LEASE_S`` stretches the lease on slow hosts.
+
+Run: ``python tools/trace_gate.py`` (exit 1 on any violation);
+``make trace-gate`` wires it into ``make check``.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+HOSTS = ("host00", "host01", "host02")
+#: injected chaos: host01 dies claiming its SECOND unit; host02's
+#: first unit absorbs a 2-transient burst (recovered, 2 retries)
+KILL_CHAOS = {"host01": "kill@1"}
+FAULT_BURST = {"host02": "transient@0:0x2"}
+
+
+def _sizes_from_env():
+    return {
+        "peers": int(os.environ.get("TRACE_GATE_PEERS", 48)),
+        "segments": int(os.environ.get("TRACE_GATE_SEGMENTS", 12)),
+        "watch_s": float(os.environ.get("TRACE_GATE_WATCH_S", 8.0)),
+        "chunk": int(os.environ.get("TRACE_GATE_CHUNK", 6)),
+        "lease_s": float(os.environ.get("TRACE_GATE_LEASE_S", 2.0)),
+    }
+
+
+def spawn_worker(host, root, sizes):
+    cmd = [sys.executable,
+           os.path.join(_REPO, "tools", "sweep.py"),
+           "--fabric", os.path.join(root, "fabric"),
+           "--host-id", host,
+           "--fabric-lease-s", str(sizes["lease_s"]),
+           "--fabric-barrier", str(len(HOSTS)),
+           "--trace-dir", os.path.join(root, "trace"),
+           "--peers", str(sizes["peers"]),
+           "--segments", str(sizes["segments"]),
+           "--watch-s", str(sizes["watch_s"]),
+           "--chunk", str(sizes["chunk"])]
+    if KILL_CHAOS.get(host):
+        cmd.extend(["--fabric-chaos", KILL_CHAOS[host]])
+    if FAULT_BURST.get(host):
+        cmd.extend(["--inject-faults", FAULT_BURST[host]])
+    env = {**os.environ,
+           "HLSJS_P2P_TPU_CACHE_DIR": os.path.join(root, "cache")}
+    log_path = os.path.join(root, "logs", f"{host}.log")
+    log = open(log_path, "w", encoding="utf-8")
+    return subprocess.Popen(cmd, stdout=log, stderr=log, cwd=_REPO,
+                            env=env), log_path, log
+
+
+def run_merge(root, sizes):
+    out = os.path.join(root, "merged.json")
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "sweep.py"),
+           "--fabric", os.path.join(root, "fabric"), "--hosts", "0",
+           "--peers", str(sizes["peers"]),
+           "--segments", str(sizes["segments"]),
+           "--watch-s", str(sizes["watch_s"]),
+           "--chunk", str(sizes["chunk"]),
+           "--json", "--out", out]
+    env = {**os.environ,
+           "HLSJS_P2P_TPU_CACHE_DIR": os.path.join(root, "cache")}
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=_REPO, env=env)
+    if proc.returncode != 0:
+        raise SystemExit(f"trace-gate merge failed:\n{proc.stdout}\n"
+                         f"{proc.stderr}")
+    with open(out, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def main() -> int:
+    sizes = _sizes_from_env()
+    root = tempfile.mkdtemp(prefix="trace-gate-")
+    os.makedirs(os.path.join(root, "logs"))
+    problems = []
+    try:
+        # 1. the fleet: one SIGKILL, one transient burst
+        procs = [spawn_worker(host, root, sizes) for host in HOSTS]
+        rcs = {}
+        for host, (proc, _log_path, log) in zip(HOSTS, procs):
+            rcs[host] = proc.wait()
+            log.close()
+        if rcs["host01"] != -signal.SIGKILL:
+            problems.append(
+                f"kill worker exited {rcs['host01']}, expected "
+                f"SIGKILL ({-signal.SIGKILL})")
+        for host in ("host00", "host02"):
+            if rcs[host] != 0:
+                problems.append(f"{host} exited {rcs[host]} — "
+                                f"survivors must complete the grid")
+        for host in HOSTS:
+            with open(os.path.join(root, "logs", f"{host}.log"),
+                      encoding="utf-8") as fh:
+                text = fh.read()
+            if "Traceback" in text:
+                problems.append(f"{host} log carries an unhandled "
+                                f"exception:\n{text[-2000:]}")
+
+        # 2. merge must complete (the steal finished the grid)
+        merged = run_merge(root, sizes)
+        rows = merged["rows"]
+        failed = [r for r in rows if r.get("failed")]
+        if len(rows) != 48:  # the shipped VOD grid
+            problems.append(f"merged artifact has {len(rows)} rows, "
+                            f"expected the 48-point VOD grid")
+        if failed:
+            problems.append(f"{len(failed)} failed rows in a "
+                            f"recoverable chaos schedule")
+
+        # jax-importing analysis only AFTER the workers are done:
+        # the parent never touches a device, but keeping the heavy
+        # imports out of the spawn window keeps the gate honest on
+        # busy CI hosts
+        import sweep as sweep_tool
+        from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (
+            default_cache_dir, journal_path, read_jsonl_tolerant)
+        from hlsjs_p2p_wrapper_tpu.engine.tracer import (
+            REPLAYED_FAMILIES, finalize_keys, read_shard,
+            replay_counter_families)
+        os.environ["HLSJS_P2P_TPU_CACHE_DIR"] = \
+            os.path.join(root, "cache")
+
+        shards = {}
+        for host in HOSTS:
+            path = os.path.join(root, "trace", f"{host}.jsonl")
+            if not os.path.exists(path):
+                problems.append(f"{host} wrote no event shard")
+                continue
+            meta, events = read_shard(path)
+            shards[host] = (meta, events)
+        run_ids = {meta.get("run_id")
+                   for meta, _ in shards.values() if meta}
+        if len(run_ids) > 1:
+            problems.append(f"hosts disagree on the run id: "
+                            f"{sorted(run_ids)} — the trace context "
+                            f"must be fleet-wide")
+
+        # 3. replay == registry, exactly, per surviving worker
+        for host in ("host00", "host02"):
+            partial_path = os.path.join(root, "fabric", "partial",
+                                        f"{host}.json")
+            if not os.path.exists(partial_path) or host not in shards:
+                problems.append(f"{host}: missing partial or shard")
+                continue
+            with open(partial_path, encoding="utf-8") as fh:
+                partial = json.load(fh)
+            exported = partial.get("counters")
+            if exported is None:
+                problems.append(f"{host}: partial artifact exports "
+                                f"no counter families")
+                continue
+            replayed = replay_counter_families(shards[host][1])
+            for family in REPLAYED_FAMILIES:
+                if replayed.get(family) != exported.get(family):
+                    problems.append(
+                        f"{host}: replayed {family} diverged from "
+                        f"the exported registry —\n  replayed: "
+                        f"{replayed.get(family)}\n  exported: "
+                        f"{exported.get(family)}")
+
+        # 4. the burst is visible and exact: 2 transient retries on
+        # host02, in the events AND the registry export
+        if "host02" in shards:
+            replayed = replay_counter_families(shards["host02"][1])
+            retries = replayed["dispatch_faults"].get(
+                "action=retry,reason=transient", 0)
+            if retries != 2:
+                problems.append(
+                    f"host02 replayed {retries} transient retries, "
+                    f"expected exactly 2 (the injected burst)")
+
+        # 5. journal <-> finalize, per host, killed host included
+        grid = sweep_tool.vod_grid()
+        meta = sweep_tool.journal_meta(
+            grid, peers=sizes["peers"], segments=sizes["segments"],
+            watch_s=sizes["watch_s"], live=False, seed=0,
+            record_every=0)
+        for host in HOSTS:
+            jpath = journal_path(default_cache_dir(), meta, host)
+            if not os.path.exists(jpath):
+                problems.append(f"{host}: no journal shard "
+                                f"({jpath})")
+                continue
+            journaled = [r["key"]
+                         for r in read_jsonl_tolerant(jpath)
+                         if r.get("kind") == "row"]
+            if host not in shards:
+                continue
+            finals = finalize_keys(shards[host][1])
+            missing = [k for k in journaled if finals.get(k, 0) != 1]
+            if missing:
+                problems.append(
+                    f"{host}: {len(missing)}/{len(journaled)} "
+                    f"journaled rows lack exactly one finalize "
+                    f"event (first: {missing[0][:16]}…)")
+            extra = [k for k in finals if k not in set(journaled)]
+            if extra:
+                problems.append(
+                    f"{host}: {len(extra)} finalize events for "
+                    f"rows the journal never recorded")
+
+        # 6. the consumers hold on this run's artifacts
+        from fleet_console import render_frame
+        from trace_export import export_dir
+        trace = export_dir(os.path.join(root, "trace"))
+        events = trace["traceEvents"]
+        pids = {e["pid"] for e in events if e.get("ph") != "M"}
+        if len(pids) != len(shards):
+            problems.append(f"exporter produced {len(pids)} host "
+                            f"pids for {len(shards)} shards")
+        if not any(e.get("ph") == "X" and e.get("dur", 0) >= 0
+                   for e in events):
+            problems.append("exporter produced no X span events")
+        if not any(e.get("ph") == "C" and e.get("name") == "retries"
+                   for e in events):
+            problems.append("exporter produced no retry counter "
+                            "track despite the injected burst")
+        frame = render_frame(os.path.join(root, "fabric"),
+                             os.path.join(root, "trace"))
+        if "host02" not in frame or "units done" not in frame:
+            problems.append(f"console frame incomplete:\n{frame}")
+
+        n_events = sum(len(ev) for _m, ev in shards.values())
+        print(f"trace-gate: {len(shards)} shards, {n_events} events"
+              f" (1 SIGKILL, 1 transient burst) — replay == "
+              f"registry, journal == finalize -> "
+              f"{'ok' if not problems else 'FAIL'}")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    for problem in problems:
+        print(f"trace-gate: {problem}", file=sys.stderr)
+    print(f"# trace-gate: {'PASS' if not problems else 'FAIL'} "
+          f"(VOD grid, 3 workers, {sizes['peers']} peers, chunk "
+          f"{sizes['chunk']}, lease {sizes['lease_s']}s)",
+          file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

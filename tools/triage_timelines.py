@@ -218,6 +218,86 @@ def knob_label(record):
                     if k not in _RESERVED)
 
 
+# -- grid-level triage (the cross-point ROADMAP item) -------------------
+
+def grid_axes(records):
+    """The sweep's knob AXES: keys present in every record (beyond
+    the reserved structure keys) with at least two distinct values —
+    a knob the whole grid shares at one value cannot flip
+    anything."""
+    if not records:
+        return []
+    keys = [k for k in records[0]
+            if k not in _RESERVED
+            and all(k in r for r in records)]
+    return [k for k in keys
+            if len({repr(r[k]) for r in records}) >= 2]
+
+
+def grid_triage(records, triaged):
+    """Which knob axis flips a point from healthy to pathological:
+    1-D NEIGHBOR DIFFS along each axis.
+
+    For each axis, records are grouped by every OTHER knob's value
+    (so a group is a 1-D line through the grid along that axis) and
+    sorted by the axis value; each ADJACENT pair where exactly one
+    point is flagged is a FLIP — the axis step that turned a healthy
+    point pathological, holding everything else fixed.  That is the
+    grid-level question per-point detectors cannot answer: not
+    "which points are sick" but "which knob makes them sick".
+
+    Returns ``{"axes": {axis: {"flips", "examples"}}, "flips":
+    [...]}`` with one entry per flip (axis, healthy/flagged values
+    and point indices, the flagged point's reasons), sorted
+    most-flipping axis first in ``axes``."""
+    flagged = {entry["point"]: [f["reason"]
+                                for f in entry["findings"]]
+               for entry in triaged}
+    axes = grid_axes(records)
+    flips = []
+    for axis in axes:
+        lines = {}
+        for idx, record in enumerate(records):
+            rest = tuple(sorted(
+                (k, repr(record[k])) for k in axes if k != axis))
+            lines.setdefault(rest, []).append(idx)
+        for idxs in lines.values():
+            # sort the 1-D line by the axis value (mixed/str knob
+            # values order by repr — adjacency just needs a stable,
+            # deterministic walk)
+            idxs = sorted(idxs, key=lambda i: (
+                (0, records[i][axis])
+                if isinstance(records[i][axis], (int, float))
+                else (1, repr(records[i][axis]))))
+            for a, b in zip(idxs, idxs[1:]):
+                a_bad, b_bad = a in flagged, b in flagged
+                if a_bad == b_bad:
+                    continue
+                healthy, sick = (a, b) if b_bad else (b, a)
+                flips.append({
+                    "axis": axis,
+                    "healthy_point": healthy,
+                    "flagged_point": sick,
+                    "healthy_value": records[healthy][axis],
+                    "flagged_value": records[sick][axis],
+                    "reasons": flagged[sick],
+                })
+    summary = {}
+    for flip in flips:
+        entry = summary.setdefault(flip["axis"],
+                                   {"flips": 0, "examples": []})
+        entry["flips"] += 1
+        if len(entry["examples"]) < 4:
+            entry["examples"].append(
+                f"{flip['healthy_value']}→{flip['flagged_value']} "
+                f"(point {flip['healthy_point']}→"
+                f"{flip['flagged_point']}: "
+                f"{','.join(flip['reasons'])})")
+    ordered = dict(sorted(summary.items(),
+                          key=lambda kv: -kv[1]["flips"]))
+    return {"axes": ordered, "flips": flips}
+
+
 def triage_records(records, *, min_flips=4, osc_frac=0.25,
                    stall_offload=0.2, stall_gain=0.02,
                    burst_frac=0.25, wave_frac=0.1,
@@ -279,6 +359,13 @@ def main(argv=None):
                     help="exit nonzero when any point is flagged")
     ap.add_argument("--json", action="store_true",
                     help="emit findings as JSON lines")
+    ap.add_argument("--grid", action="store_true",
+                    help="grid-level triage: join per-point verdicts "
+                         "against the sweep's knob axes and report "
+                         "which axis flips a point from healthy to "
+                         "pathological (1-D neighbor diffs along "
+                         "each knob); emitted as a final "
+                         "{\"grid\": ...} JSON line under --json")
     ap.add_argument("--min-flips", type=int, default=4,
                     help="dominant-level changes before a point "
                          "counts as oscillating (default 4)")
@@ -319,14 +406,25 @@ def main(argv=None):
         overshoot_share=args.overshoot_share,
         overshoot_frac=args.overshoot_frac)
 
+    grid = (grid_triage(records, triaged) if args.grid else None)
     if args.json:
         for entry in triaged:
             print(json.dumps(entry))
+        if grid is not None:
+            print(json.dumps({"grid": grid}))
     else:
         for entry in triaged:
             reasons = "; ".join(_describe(f) for f in entry["findings"])
             print(f"point {entry['point']:>3} [{entry['knobs']}]: "
                   f"{reasons}")
+        if grid is not None:
+            for axis, entry in grid["axes"].items():
+                examples = "; ".join(entry["examples"])
+                print(f"grid axis {axis}: {entry['flips']} "
+                      f"healthy→pathological flip(s) [{examples}]")
+            if not grid["axes"]:
+                print("grid: no single-axis flips (pathologies are "
+                      "uniform along every knob line)")
     reasons = [f["reason"] for e in triaged for f in e["findings"]]
     print(f"# triaged {len(records)} timelines: {len(triaged)} "
           f"flagged ({reasons.count('ladder_oscillation')} "
