@@ -87,6 +87,17 @@ process-level compile/recompute tax the warm-start engine removes,
 measured rather than claimed (``make warmstart-gate`` asserts the
 zero-compile half at process granularity).
 
+The one-pass-stencil round adds ``detail.step_traffic``: the
+1,048,576-peer circulant step A/B'd between the shipped one-pass
+eligibility stencil and the retained K-pass reference
+(``SwarmConfig.eligibility``) — warm walls, peer-steps/s, the
+analytic model bytes/step for both formulations
+(``step_hbm_breakdown``; the dominant term drops ~7.5× at the 1M
+shape), and the roofline position against peak HBM where known.
+Final states are asserted bit-identical and a VOD grid slice re-runs
+raw under both with float.hex row equality: the stencil is a pure
+traffic transform, measured as such.
+
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
@@ -335,6 +346,116 @@ def numpy_baseline_throughput(config, n_steps, join):
 #: timeline sampling interval the overhead number is measured at —
 #: the same default the sweep tools use for ``--timelines-out``
 TIMELINE_RECORD_EVERY = 20
+
+
+def step_traffic_benchmark():
+    """The one-pass eligibility stencil's A/B (round 8): the
+    1,048,576-peer circulant shape (K=8, C=1) stepped under
+    ``eligibility="stencil"`` vs the retained ``"kpass"`` reference
+    — warm walls and peer-steps/s for both (best-of-2, interleaved),
+    the analytic model bytes/step before/after
+    (``step_hbm_breakdown``), and the roofline position: achieved
+    model bytes/s against the chip's peak HBM bandwidth where known.
+    Final states are asserted BIT-identical across formulations, and
+    a 6-point VOD grid slice re-runs raw under both with float.hex
+    row equality — the stencil must be a pure traffic transform.
+
+    Both backends step the committed artifact 1M shape (S=256 — the
+    SWEEP_1M grid's program); on CPU the scan is short, so the CPU
+    number is the no-regression A/B on identical programs, not
+    absolute throughput."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import sweep as sweep_tool
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import step_hbm_breakdown
+
+    on_accelerator = jax.devices()[0].platform in ("tpu", "gpu")
+    P = 1 << 20
+    S = 256
+    T = 600 if on_accelerator else 4
+    reps = 2
+    bitrates = jnp.array(BITRATES)
+    cdn = jnp.full((P,), 8_000_000.0)
+    join = staggered_joins(P, 60.0)
+
+    configs = {
+        impl: SwarmConfig(n_peers=P, n_segments=S, n_levels=3,
+                          neighbor_offsets=ring_offsets(DEGREE),
+                          eligibility=impl)
+        for impl in ("stencil", "kpass")}
+    finals, walls = {}, {impl: [] for impl in configs}
+    for impl, config in configs.items():  # compile + warm up
+        finals[impl], _ = run_swarm(config, bitrates, None, cdn,
+                                    init_swarm(config), T, join)
+        materialize(finals[impl])
+    # the whole point: identical trajectories, cheaper traffic
+    for a, b in zip(jax.tree_util.tree_leaves(finals["stencil"]),
+                    jax.tree_util.tree_leaves(finals["kpass"])):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "stencil final state diverged from the kpass reference"
+    del finals  # ~2 × 200 MB of 1M-peer state: free before timing
+    for _ in range(reps):  # interleaved best-of: noise lands evenly
+        for impl, config in configs.items():
+            start = time.perf_counter()
+            final, _ = run_swarm(config, bitrates, None, cdn,
+                                 init_swarm(config), T, join)
+            materialize(final)
+            walls[impl].append(time.perf_counter() - start)
+
+    # model bytes/step at the artifact shape the walls above stepped
+    model = {impl: step_hbm_breakdown(config)
+             for impl, config in configs.items()}
+
+    # rows: a VOD slice re-run raw under both formulations
+    grid = sweep_tool.sample_grid(sweep_tool.vod_grid(), 6)
+    sizes = grid_bench_sizes()
+    rows = {}
+    for impl in configs:
+        rows[impl], _ = sweep_tool.run_grid_batched(
+            grid, live=False, seed=0, chunk=3, raw=True,
+            eligibility=impl, **sizes)
+    for a, b in zip(rows["stencil"], rows["kpass"]):
+        assert (float.hex(a["offload"]) == float.hex(b["offload"])
+                and float.hex(a["rebuffer"])
+                == float.hex(b["rebuffer"])), \
+            f"stencil grid row diverged from kpass: {a} vs {b}"
+
+    stencil_s, kpass_s = min(walls["stencil"]), min(walls["kpass"])
+    _peak_flops, peak_hbm = chip_peaks(jax.devices()[0])
+    out = {
+        "what": "1,048,576-peer circulant step (K=8, C=1): one-pass "
+                "stencil vs the K-pass reference — final states "
+                "bit-identical, 6 VOD rows float.hex-identical, "
+                f"warm best-of-{reps}",
+        "peers": P, "segments": S, "steps": T,
+        "stencil_wall_s": round(stencil_s, 3),
+        "kpass_wall_s": round(kpass_s, 3),
+        "stencil_peer_steps_per_sec": round(P * T / stencil_s, 1),
+        "kpass_peer_steps_per_sec": round(P * T / kpass_s, 1),
+        "speedup_vs_kpass": round(kpass_s / stencil_s, 3),
+        # model bytes/step at the committed 1M artifact shape (S=256)
+        "model_bytes_per_step": {
+            impl: {k: round(v, 1) for k, v in parts.items()}
+            | {"total": round(sum(parts.values()), 1)}
+            for impl, parts in model.items()},
+        "eligibility_term_reduction": round(
+            model["kpass"]["eligibility"]
+            / model["stencil"]["eligibility"], 2),
+        "rows_bit_identical": True,
+    }
+    # roofline position: model bytes/step over the measured wall,
+    # against the chip's peak HBM bandwidth where known
+    out["achieved_model_hbm_gbps"] = {
+        impl: round(sum(model[impl].values()) * T
+                    / (stencil_s if impl == "stencil" else kpass_s)
+                    / 1e9, 2)
+        for impl in configs}
+    if peak_hbm is not None:
+        out["hbm_util"] = {
+            impl: round(out["achieved_model_hbm_gbps"][impl] * 1e9
+                        / peak_hbm, 4)
+            for impl in configs}
+    return out
 
 
 def grid_bench_sizes():
@@ -909,9 +1030,11 @@ def main():
         "platform": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
         "peers": P, "segments": S, "steps": T, "degree": DEGREE,
-        "formulation": "circulant roll/stencil over bit-packed "
-                       "availability, O(P·K), shipped agent config "
-                       "(admission cap + frictions + holder pinning; rounds 4-5)",
+        "formulation": "one-pass eligibility stencil over the "
+                       "bit-packed availability map (round 8: ONE "
+                       "map stream/step instead of K·C), shipped "
+                       "agent config (admission cap + frictions + "
+                       "holder pinning; rounds 4-5)",
         "host_model": "same sparse model, vectorized NumPy",
         "final_offload": round(float(offload_ratio(final)), 4),
         "host_peer_steps_per_sec": round(host_throughput, 1),
@@ -927,6 +1050,10 @@ def main():
     # rows), not a property of the grid comparison it rode along
     detail["trace_overhead"] = sweep_grid.pop("trace_overhead")
     detail["warm_start"] = warm_start
+    # the one-pass stencil A/B runs LAST of the in-process
+    # measurements: its 1M-peer buffers would fragment the heap
+    # under everything above
+    detail["step_traffic"] = step_traffic_benchmark()
 
     line = json.dumps({
         "metric": "swarm_sim_peer_steps_per_sec",

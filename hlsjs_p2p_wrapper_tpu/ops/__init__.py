@@ -3,24 +3,27 @@ device-resident swarm simulator."""
 
 from .ewma import EwmaState, get_estimate, init_state, scan_samples, update
 from .swarm_sim import (SwarmConfig, SwarmScenario, SwarmState,
-                        ensure_penalty_width,
+                        circulant_eligibility, ensure_penalty_width,
                         full_neighbors, full_offsets, init_swarm,
                         invert_neighbors, isolated_neighbors,
                         make_scenario, neighbors_from_adjacency,
-                        offload_ratio, packed_words, random_neighbors,
-                        rebuffer_ratio,
-                        ring_neighbors, ring_offsets, run_swarm,
+                        offload_ratio, pack_dl_flags, packed_words,
+                        random_neighbors, rebuffer_ratio,
+                        resolve_eligibility, ring_neighbors, ring_offsets, run_swarm,
                         stable_ranks, staggered_joins, step_flops,
-                        step_hbm_bytes, swarm_step, unpack_avail)
+                        step_hbm_breakdown, step_hbm_bytes,
+                        swarm_step, unpack_avail, unpack_dl_flags)
 
 __all__ = ["EwmaState", "get_estimate", "init_state", "scan_samples",
            "update", "SwarmConfig", "SwarmScenario", "SwarmState",
-           "ensure_penalty_width",
+           "circulant_eligibility", "ensure_penalty_width",
            "full_neighbors", "full_offsets", "init_swarm",
            "invert_neighbors", "isolated_neighbors", "make_scenario",
            "neighbors_from_adjacency", "offload_ratio",
-           "random_neighbors",
-           "packed_words", "rebuffer_ratio", "ring_neighbors",
+           "pack_dl_flags", "random_neighbors",
+           "packed_words", "rebuffer_ratio", "resolve_eligibility",
+           "ring_neighbors",
            "ring_offsets", "run_swarm", "stable_ranks",
-           "staggered_joins", "step_flops", "step_hbm_bytes",
-           "swarm_step", "unpack_avail"]
+           "staggered_joins", "step_flops", "step_hbm_breakdown",
+           "step_hbm_bytes", "swarm_step", "unpack_avail",
+           "unpack_dl_flags"]

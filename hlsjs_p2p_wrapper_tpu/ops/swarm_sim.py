@@ -25,10 +25,14 @@ per-(level, segment) cache map.  Per step (``dt_ms``):
    through HBM — O(P²) memory, 17 GB of adjacency at 65k peers; real
    overlays are degree-K sparse, which is what unlocks 100k+-peer
    sweeps.)  Two representations: circulant offsets (ring-style
-   overlays), where every cross-peer op is a static roll/stencil
-   over the bit-packed map — zero gathers, ~50× faster per edge on
-   TPU, and ICI halo exchanges under sharding — or general
-   ``[P, K]`` neighbor lists via XLA gathers.  Transfers are
+   overlays), where eligibility is the ONE-PASS stencil — a single
+   shared extraction of every slot's wanted u32 words from the
+   bit-packed map (:func:`circulant_eligibility`; the map streams
+   through HBM once per step, not K·C times), finished with static
+   ``[P]``-vector rolls and bit tests — zero gathers on
+   accelerators, ~50× faster per edge on TPU, and ICI halo
+   exchanges under sharding — or general ``[P, K]`` neighbor lists
+   via XLA gathers.  Transfers are
    SINGLE-HOLDER like the agent's: ``holder_selection`` picks the
    rendezvous-hash "spread" holder (the shipped policy) or the
    shared announce-order "ranked" head (the herding behavior the
@@ -196,6 +200,17 @@ class SwarmConfig(NamedTuple):
     # never executes.  Revisit only if pallas-in-scan compile cost
     # drops by an order of magnitude (retrieve the code from git
     # history, tag r3).
+    #
+    # Round 8 shipped what the kernel was after at the jnp level
+    # instead: the ONE-PASS eligibility stencil
+    # (``eligibility="stencil"``, :func:`circulant_eligibility`).
+    # Each eligibility pass only ever consumed one u32 word per
+    # peer, so a single shared one-hot extraction of the [P, K·C]
+    # wanted words replaces the K·C full-map roll+AND re-streams —
+    # the same ~1 algorithmic map stream the Pallas kernel bought,
+    # with zero pallas-in-scan compile risk, bit-identical results,
+    # and a clean A/B against the retained "kpass" reference
+    # (bench.py ``detail.step_traffic``).
     seg_duration_s: float = 4.0
     dt_ms: float = 250.0
     max_buffer_s: float = 30.0
@@ -256,6 +271,32 @@ class SwarmConfig(NamedTuple):
     #: adaptive previously carried only the failure re-roll, not the
     #: penalty WINDOW that remembers across segments.
     holder_penalty_ms: float = 3_000.0
+    #: circulant eligibility formulation (no effect on the general
+    #: ``[P, K]`` gather path, which stays the reference semantics).
+    #: All choices are BIT-IDENTICAL — 0/1 eligibility either way,
+    #: pinned by tests/test_eligibility_stencil.py — so this knob
+    #: can only change speed, never a result:
+    #: - "stencil": the ONE-PASS extraction — each peer's wanted u32
+    #:   word per (slot, offset) is pulled out of the bit-packed map
+    #:   by a single shared pass, then finished with cheap
+    #:   ``[P]``-vector rolls and bit tests.  The map streams
+    #:   through HBM ONCE per step instead of K·C times
+    #:   (:func:`step_hbm_breakdown`: the dominant term drops ~7.5×
+    #:   at the 1M artifact shape) — the formulation for
+    #:   memory-bandwidth-bound accelerators.
+    #: - "kpass": the pre-0.10 reference — K full-map roll+AND
+    #:   passes per transfer slot.  Kept selectable for A/B
+    #:   measurement (bench.py ``detail.step_traffic``) and as the
+    #:   in-tree twin of the ``testing/elig_oracle.py`` oracle.
+    #: - "auto" (default): resolved per backend at TRACE time
+    #:   (:func:`resolve_eligibility`): "stencil" on TPU/GPU, where
+    #:   the step runs at the HBM roofline and removed bytes are
+    #:   removed wall-clock; "kpass" on CPU, where XLA fuses the
+    #:   roll chain better than the extraction's gather and the
+    #:   measured full step is ~1.25× faster that way (the A/B
+    #:   bench.py records) — CPU is a correctness/test surface, not
+    #:   the bandwidth-bound production path.
+    eligibility: str = "auto"
 
 
 class SwarmScenario(NamedTuple):
@@ -397,10 +438,18 @@ class SwarmState(NamedTuple):
     avail: jax.Array
     cdn_bytes: jax.Array       # [P] f32
     p2p_bytes: jax.Array       # [P] f32
-    # transfer slots, all [P, C] (C = config.max_concurrency; slot 0
+    # transfer slots, [P, C] (C = config.max_concurrency; slot 0
     # = foreground, slots 1.. = P2P prefetches):
-    dl_active: jax.Array       # [P, C] bool
-    dl_is_p2p: jax.Array       # [P, C] bool
+    #: BIT-PACKED transfer-slot flag planes: [P] u32, bit ``2c`` =
+    #: slot c active, bit ``2c + 1`` = slot c is_p2p (the pre-0.10
+    #: ``dl_active``/``dl_is_p2p`` [P, C] bool planes, packed one
+    #: word per peer so the scan carry stops hauling 2·C flag bytes
+    #: per peer per direction).  Same unpack-on-read discipline as
+    #: ``avail``: read through :func:`unpack_dl_flags`, written by
+    #: :func:`pack_dl_flags` — values are bit-exact vs the bool
+    #: planes.  Caps ``max_concurrency`` at 16 slots (u32 = 2 bits
+    #: per slot), far above any modeled agent.
+    dl_flags: jax.Array
     dl_seg: jax.Array          # [P, C] i32
     dl_level: jax.Array        # [P, C] i32
     dl_done_bytes: jax.Array   # [P, C] f32
@@ -446,6 +495,32 @@ def packed_words(config: SwarmConfig) -> int:
     return -(-(config.n_levels * config.n_segments) // 32)
 
 
+def pack_dl_flags(active_cols, is_p2p_cols) -> jax.Array:
+    """Pack per-slot ``[P]`` bool columns into the ``[P]`` u32
+    transfer-flag word (``SwarmState.dl_flags``): bit ``2c`` = slot c
+    active, bit ``2c + 1`` = slot c is_p2p."""
+    flags = None
+    for c, (act, p2p) in enumerate(zip(active_cols, is_p2p_cols)):
+        word = (act.astype(jnp.uint32) << (2 * c)) \
+            | (p2p.astype(jnp.uint32) << (2 * c + 1))
+        flags = word if flags is None else flags | word
+    if flags is None:
+        raise ValueError("cannot pack zero transfer slots")
+    return flags
+
+
+def unpack_dl_flags(flags: jax.Array, n_slots: int):
+    """Expand the packed ``[P]`` u32 flag word back into
+    (``active``, ``is_p2p``) lists of per-slot ``[P]`` bool columns —
+    the unpack-on-read twin of :func:`pack_dl_flags` (bit-exact vs
+    the pre-0.10 ``[P, C]`` bool planes)."""
+    active = [((flags >> (2 * c)) & jnp.uint32(1)) != 0
+              for c in range(n_slots)]
+    is_p2p = [((flags >> (2 * c + 1)) & jnp.uint32(1)) != 0
+              for c in range(n_slots)]
+    return active, is_p2p
+
+
 def unpack_avail(state: SwarmState, config: SwarmConfig) -> jax.Array:
     """Expand the bit-packed cache map to a ``[P, L, S]`` u8 0/1
     array (analysis/test convenience; the step never materializes
@@ -476,17 +551,21 @@ def init_swarm(config: SwarmConfig,
         n_neighbors = (len(_normalized_offsets(config.neighbor_offsets,
                                                P))
                        if config.neighbor_offsets is not None else 0)
+    if C > 16:
+        raise ValueError(f"max_concurrency={C} exceeds the 16 slots "
+                         f"the packed dl_flags word carries (2 bits "
+                         f"per slot in one u32)")
     f0 = jnp.zeros((P,), jnp.float32)
     i0 = jnp.zeros((P,), jnp.int32)
     fc = jnp.zeros((P, C), jnp.float32)
     ic = jnp.zeros((P, C), jnp.int32)
-    bc = jnp.zeros((P, C), bool)
     return SwarmState(
         t_s=jnp.zeros((), jnp.float32),
         playhead_s=f0, buffer_s=f0, rebuffer_s=f0, level=i0,
         ewma=init_state(P),
         avail=jnp.zeros((P, packed_words(config)), jnp.uint32),
-        cdn_bytes=f0, p2p_bytes=f0, dl_active=bc, dl_is_p2p=bc,
+        cdn_bytes=f0, p2p_bytes=f0,
+        dl_flags=jnp.zeros((P,), jnp.uint32),
         dl_seg=ic, dl_level=ic, dl_done_bytes=fc, dl_total_bytes=fc,
         dl_elapsed_ms=fc, dl_budget_ms=fc, dl_cooldown_ms=fc,
         dl_attempts=ic, fg_wait_ms=f0,
@@ -502,6 +581,132 @@ def _abr_pick(estimate_bps: jax.Array, bitrates: jax.Array) -> jax.Array:
     return jnp.max(jnp.where(fits, idx[None, :], 0), axis=1)
 
 
+def resolve_eligibility(config: SwarmConfig) -> str:
+    """The concrete circulant formulation this process will trace:
+    ``config.eligibility``, with ``"auto"`` resolved by backend —
+    "stencil" on accelerators (one HBM stream of the packed map),
+    "kpass" on CPU (the roll chain fuses better there; measured in
+    bench.py ``detail.step_traffic``).  Resolution happens at trace
+    time and both formulations are bit-identical, so the choice can
+    never change a result — and the AOT cache already keys on the
+    platform, so it can never serve a cross-backend executable.
+    Unknown values raise here, so every consumer of the resolution —
+    the step, the cost models, the halo gate — shares one "a typo
+    must not silently pick a formulation" contract."""
+    if config.eligibility in ("stencil", "kpass"):
+        return config.eligibility
+    if config.eligibility != "auto":
+        raise ValueError(f"unknown eligibility "
+                         f"{config.eligibility!r}")
+    return ("stencil" if jax.default_backend() in ("tpu", "gpu")
+            else "kpass")
+
+
+def bit_mask_words(gi_flat: jax.Array, n_words: int) -> jax.Array:
+    """One-hot ``[P, W]`` u32 mask selecting each peer's flat
+    (level, seg) bit in the packed cache map — the cache-insert
+    position (and the "kpass" reference's AND operand)."""
+    wcol = jnp.arange(n_words, dtype=jnp.int32)
+    word_idx = gi_flat >> 5                              # [P] i32
+    bitmask = jnp.uint32(1) << (gi_flat & 31).astype(jnp.uint32)
+    return jnp.where(wcol[None, :] == word_idx[:, None],
+                     bitmask[:, None], jnp.uint32(0))    # [P, W]
+
+
+def circulant_eligibility(avail_p: jax.Array, present: jax.Array,
+                          offs, gi_flats, *, impl: str = "stencil"):
+    """Circulant-path eligibility for every transfer slot at once.
+
+    ``gi_flats`` lists each slot's ``[P]`` flat (level·S + seg)
+    target bit; returns one ``(elig, n_holders, own)`` triple per
+    slot: ``elig`` = K × ``[P]`` 0/1 f32 per-offset eligibility
+    ("does my k-th neighbor hold my bit, and is it present"),
+    ``n_holders`` their sum, ``own`` the peer's own-cache bit test.
+
+    Two formulations, bit-identical by construction and pinned
+    against each other (and the ``testing/elig_oracle`` oracle) by
+    tests/test_eligibility_stencil.py:
+
+    - ``impl="stencil"`` — the ONE-PASS extraction.  Each (slot c,
+      offset o) pass of the old formulation consumed exactly ONE u32
+      word per peer: holder j serves requester i = j − o, whose word
+      index is ``roll(word_idx_c, o)[j]``.  So instead of K·C
+      full-map re-streams, build the ``[P, C·(K+1)]`` matrix of
+      wanted word indices (one self column per slot for the
+      own-cache test, then one column per offset), pull the words
+      out of the packed map with ONE shared one-hot contraction —
+      the module's standard gather replacement (see
+      ``invert_neighbors``) — and finish with cheap ``[P]``-vector
+      rolls and bit tests.  The ``[P, W]`` map streams through HBM
+      once per step instead of K·C+ times (``step_hbm_bytes``).
+      Presence masks AFTER extraction (holder-side ``[P]`` bool),
+      which equals the old pre-masked-map formulation bit-for-bit.
+    - ``impl="kpass"`` — the pre-0.10 reference: K roll+AND+reduce
+      passes over the presence-masked map per slot, kept for A/B
+      measurement (bench.py ``detail.step_traffic``)."""
+    P, W = avail_p.shape
+    zeros = jnp.zeros((P,), jnp.float32)
+    bitmasks = [jnp.uint32(1) << (gf & 31).astype(jnp.uint32)
+                for gf in gi_flats]
+    if impl == "kpass":
+        AP = jnp.where(present[:, None], avail_p, jnp.uint32(0))
+        out = []
+        for gf in gi_flats:
+            Wm = bit_mask_words(gf, W)
+            ap_ro = [jnp.roll(AP, -o, axis=0) for o in offs]  # traffic-ok: kpass A/B reference
+            elig = [jnp.sum((r & Wm) != 0, axis=1,
+                            dtype=jnp.int32).astype(jnp.float32)
+                    for r in ap_ro]                      # K × [P]
+            n = sum(elig) if elig else zeros
+            own = jnp.any((avail_p & Wm) != 0, axis=1)
+            out.append((elig, n, own))
+        return out
+    if impl != "stencil":
+        raise ValueError(f"unknown eligibility {impl!r}")
+    word_idx = [(gf >> 5).astype(jnp.int32) for gf in gi_flats]
+    # the shared extraction: column base + 0 is slot c's SELF word
+    # (own-cache bit), base + 1 + k its k-th neighbor's wanted word
+    # presented holder-side
+    cols = []
+    for wi in word_idx:
+        cols.append(wi)
+        cols.extend(jnp.roll(wi, o) for o in offs)
+    wanted = jnp.stack(cols, axis=1)                     # [P, M] i32
+    if jax.default_backend() == "cpu":
+        # per-row gather: one map stream, and CPU gathers run at
+        # memcpy speed (the ~50×-slower-gather doctrine is a TPU
+        # property) — measured vs the select chain below at 1M
+        # peers/W=24 in-scan: 132 vs 190 ms/step, with the K-pass
+        # re-stream at 149
+        ext = jnp.take_along_axis(avail_p, wanted, axis=1)
+    else:
+        # accelerators: the one-hot contraction as a fused SELECT
+        # CHAIN — W selects over the [P, M] word matrix, each
+        # consuming one map column; a linear elementwise chain XLA
+        # fuses into a single pass over the [P, W] map, zero
+        # gathers (the module's TPU doctrine, see neighbor_offsets).
+        # Identical u32 words either way: the backend branch can
+        # never change a result, only its speed.
+        ext = jnp.zeros(wanted.shape, jnp.uint32)        # [P, M] u32
+        for w in range(W):
+            ext = jnp.where(wanted == w, avail_p[:, w][:, None],
+                            ext)
+    pres_ro = {o: jnp.roll(present, -o) for o in dict.fromkeys(offs)}
+    stride = 1 + len(offs)
+    out = []
+    for c, bm in enumerate(bitmasks):
+        base = c * stride
+        own = (ext[:, base] & bm) != 0
+        elig = []
+        for k, o in enumerate(offs):
+            word = jnp.roll(ext[:, base + 1 + k], -o)    # [P] u32
+            have = (word & bm) != 0
+            elig.append((have & pres_ro[o]).astype(jnp.float32))
+        n = sum(elig) if elig else zeros
+        out.append((elig, n, own))
+    return out
+
+
 def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                state: SwarmState) -> SwarmState:
     """One ``dt_ms`` tick for every peer at once.  Transfer slots
@@ -513,6 +718,10 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         # simulate the ranked pile-on and fake a zero-gain A/B
         raise ValueError(f"unknown holder_selection "
                          f"{config.holder_selection!r}")
+    if config.eligibility not in ("auto", "stencil", "kpass"):
+        # same contract: a typo must not silently pick a formulation
+        raise ValueError(f"unknown eligibility "
+                         f"{config.eligibility!r}")
     dt_s = config.dt_ms / 1000.0
     seg = config.seg_duration_s
     P, S, L = config.n_peers, config.n_segments, config.n_levels
@@ -523,6 +732,9 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     zeros = jnp.zeros((P,), jnp.float32)
     never = jnp.zeros((P,), bool)
     peer_idx32 = jnp.arange(P, dtype=jnp.uint32)
+    # unpack-on-read of the bit-packed transfer-slot flag planes
+    # (bit-exact vs the pre-0.10 [P, C] bool planes — see dl_flags)
+    dl_active, dl_is_p2p = unpack_dl_flags(state.dl_flags, C)
 
     playhead = state.playhead_s
     if config.live:
@@ -542,7 +754,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     next_seg = jnp.minimum(
         ((playhead + state.buffer_s) / seg).astype(jnp.int32), S - 1)
     timeline_left = (playhead + state.buffer_s) < end_s
-    fg_idle = ~state.dl_active[:, 0]
+    fg_idle = ~dl_active[0]
     fg_wants = (present & fg_idle & timeline_left
                 & (state.buffer_s < config.max_buffer_s))
     if config.live:
@@ -553,16 +765,18 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     # ---- 2. eligibility machinery -----------------------------------
     avail_p = state.avail                       # [P, W] u32 bit-packed
     circulant = config.neighbor_offsets is not None
-    wcol = jnp.arange(packed_words(config), dtype=jnp.int32)
+    n_words = packed_words(config)
     if circulant:
         # circulant fast path: neighbor k of peer i is (i + off_k) %
-        # P, so "what does my k-th neighbor have" is a static ROW
-        # SHIFT of the (availability · presence) bitmap, ANDed with
-        # the one-hot BIT of each peer's segment of interest — K
-        # stencil passes over 1 bit/cell, zero gathers (see
-        # neighbor_offsets doc)
+        # P, so "what does my k-th neighbor have" is a static word
+        # EXTRACTION from the bit-packed map — on accelerators the
+        # one-pass stencil: ONE shared pass pulls every slot's
+        # wanted u32 words out of the map, then [P]-vector rolls +
+        # bit tests finish each (slot, offset) pass; "kpass" keeps
+        # the pre-0.10 K·C full-map roll+AND reference (and is the
+        # CPU resolution of the "auto" default — see
+        # resolve_eligibility and circulant_eligibility docs).
         offs = _normalized_offsets(config.neighbor_offsets, P)
-        AP = jnp.where(present[:, None], avail_p, jnp.uint32(0))
     else:
         # general [P, K] neighbor-list path (arbitrary topologies):
         # XLA gathers — correct everywhere, ~50× slower per edge on
@@ -583,24 +797,31 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
             f"state with init_swarm(config, n_neighbors=K), or let "
             f"run_swarm resize a pristine state")
 
-    def bit_mask(gi_flat):
-        """One-hot [P, W] u32 mask selecting each peer's flat
-        (level, seg) bit in the packed cache map."""
-        word_idx = gi_flat >> 5                              # [P] i32
-        bitmask = jnp.uint32(1) << (gi_flat & 31).astype(jnp.uint32)
-        return jnp.where(wcol[None, :] == word_idx[:, None],
-                         bitmask[:, None], jnp.uint32(0))    # [P, W]
+    # per-slot (level, seg) targets are pure pre-state arithmetic —
+    # which is what lets the circulant path extract EVERY slot's
+    # wanted words in one shared pass over the packed map instead of
+    # re-streaming it per (slot, offset)
+    gi_flats, gi_segs = [], []
+    for c in range(C):
+        t_seg = (next_seg if c == 0
+                 else jnp.minimum(next_seg + c, S - 1))
+        gi_seg_c = jnp.where(dl_active[c], state.dl_seg[:, c], t_seg)
+        gi_level_c = jnp.where(dl_active[c], state.dl_level[:, c],
+                               want_level)
+        gi_segs.append(gi_seg_c)
+        gi_flats.append(gi_level_c * S + gi_seg_c)
+    if circulant:
+        elig_slots = circulant_eligibility(
+            avail_p, present, offs, gi_flats,
+            impl=resolve_eligibility(config))
 
-    def eligibility(gi_flat):
-        """(one-hot bit mask, per-edge eligibility, holder count) for
-        each peer's [P] flat (level, seg) target."""
-        Wm = bit_mask(gi_flat)
+    def eligibility(c):
+        """(one-hot bit mask, per-edge eligibility, holder count,
+        own-cache bit) for slot c's [P] flat (level, seg) target."""
+        gi_flat = gi_flats[c]
+        Wm = bit_mask_words(gi_flat, n_words)
         if circulant:
-            elig = [jnp.sum((jnp.roll(AP, -o, axis=0) & Wm) != 0,
-                            axis=1,
-                            dtype=jnp.int32).astype(jnp.float32)
-                    for o in offs]                           # K × [P]
-            n = sum(elig) if elig else zeros
+            elig, n, own = elig_slots[c]
         else:
             word_idx = gi_flat >> 5
             bitmask = jnp.uint32(1) << (gi_flat & 31).astype(jnp.uint32)
@@ -608,7 +829,9 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
             have = (got & bitmask[:, None]) != 0
             elig = nbr_valid * have.astype(jnp.float32) * present_nbr
             n = jnp.sum(elig, axis=1)
-        return Wm, elig, n
+            # local cache-hit check for absorb/prefetch (bit test)
+            own = jnp.any((avail_p & Wm) != 0, axis=1)
+        return Wm, elig, n, own
 
     def nth_holder_only(elig, skip: int):
         """Restrict eligibility to the single (skip+1)-th-lowest-id
@@ -744,11 +967,6 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         # mode exists to study.
         return nth_holder_only(elig, c - 1 if c > 0 else C - 1)
 
-    def own_cache(Wm):
-        """Does each peer already hold its own target? (bit test —
-        the local cache-hit check for absorb/prefetch)"""
-        return jnp.any((avail_p & Wm) != 0, axis=1)
-
     # ---- start decisions (engine/scheduler.py decide()) -------------
     # margin = playback slack until the wanted segment is needed
     # (segment start time minus playhead, the agent's
@@ -778,16 +996,16 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     # processed ones — the prefetch dedup guard (`key in
     # self._prefetches`, p2p_agent.py:453) reads the first two, the
     # holders_of load key (select_holder's own_used) the rest
-    pre_flight = [(state.dl_active[:, c],
+    pre_flight = [(dl_active[c],
                    state.dl_level[:, c] * S + state.dl_seg[:, c],
                    state.dl_holder_off[:, c],
-                   state.dl_is_p2p[:, c])
+                   dl_is_p2p[c])
                   for c in range(C)]
     post_flight = []
     absorb = never
     level = state.level
     for c in range(C):
-        a0 = state.dl_active[:, c]
+        a0 = dl_active[c]
         if c == 0:
             target_seg = next_seg
             wants_c = fg_wants
@@ -823,10 +1041,8 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                            * seg + scenario.announce_delay_s)
         else:
             p2p_visible = jnp.ones((P,), bool)
-        gi_seg = jnp.where(a0, state.dl_seg[:, c], target_seg)
-        gi_level = jnp.where(a0, state.dl_level[:, c], want_level)
-        gi_flat = gi_level * S + gi_seg
-        W_c, elig_c, n_holders_c = eligibility(gi_flat)
+        gi_seg = gi_segs[c]
+        W_c, elig_c, n_holders_c, own_c = eligibility(c)
         have_n = n_holders_c > 0.0
         if c == 0:
             if C > 1:
@@ -834,7 +1050,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                 # served from the local cache instantly (the agent's
                 # cache-hit path) — buffer advances, no transfer, no
                 # new bytes (they were counted at prefetch time)
-                absorb = fg_wants & own_cache(W_c)
+                absorb = fg_wants & own_c
                 wants_dl = fg_wants & ~absorb
             else:
                 wants_dl = fg_wants
@@ -864,7 +1080,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
                 fg_wait = jnp.where(wants_dl & ~may, waited, 0.0)
             else:
                 fg_wait = state.fg_wait_ms
-            is_p2p = jnp.where(may, start_p2p, state.dl_is_p2p[:, c])
+            is_p2p = jnp.where(may, start_p2p, dl_is_p2p[c])
             # a P2P download whose holders all departed flips to the
             # CDN — the aggregate analogue of the agent's
             # holders-exhausted failover
@@ -876,9 +1092,9 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
             # known (and announced, in live mode), not already in
             # flight on another slot
             start_p2p = (wants_c & have_n & ~conflict & p2p_visible
-                         & ~own_cache(W_c))
+                         & ~own_c)
             may = start_p2p
-            is_p2p = state.dl_is_p2p[:, c] | may
+            is_p2p = dl_is_p2p[c] | may
             active = a0 | may
         # the holders_of load key: offsets my OTHER active P2P
         # transfers currently ride (post-update for processed slots,
@@ -1196,8 +1412,9 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         t_s=t + dt_s,
         playhead_s=playhead, buffer_s=buffer_s, rebuffer_s=rebuffer,
         level=level, ewma=ewma, avail=avail, cdn_bytes=cdn_bytes,
-        p2p_bytes=p2p_bytes, dl_active=stack("active"),
-        dl_is_p2p=stack("is_p2p"), dl_seg=stack("seg"),
+        p2p_bytes=p2p_bytes,
+        dl_flags=pack_dl_flags(new_cols["active"], new_cols["is_p2p"]),
+        dl_seg=stack("seg"),
         dl_level=stack("level"), dl_done_bytes=stack("done"),
         dl_total_bytes=stack("total"), dl_elapsed_ms=stack("elapsed"),
         dl_budget_ms=stack("budget"), dl_cooldown_ms=stack("cooldown"),
@@ -2257,67 +2474,100 @@ def step_flops(config: SwarmConfig, n_neighbors: int = 8) -> float:
     ops, and the O(P·L) ABR fit.  Used by bench.py for achieved-FLOPs
     reporting — honestly tiny relative to the MXU peak: the sparse
     step is memory-bound, not FLOPs-bound.  On the circulant fast
-    path the eligibility term is the K stencil passes' AND +
-    zero-test over the PACKED [P, ⌈L·S/32⌉] bitmap (2·P·W·K word
-    ops) rather than 7·P·K — and both run once per transfer slot
-    (C = max_concurrency), matching :func:`step_hbm_bytes`."""
+    path the eligibility term depends on the formulation
+    (``config.eligibility``): the one-pass "stencil" pays one
+    compare+select per (word, wanted column) of the shared
+    extraction — 2·P·W·M for M = C·(K+1) columns — plus ~4 vector
+    ops per column for the rolls/bit tests; the "kpass" reference
+    pays the K·C AND + zero-test passes over the packed
+    [P, ⌈L·S/32⌉] bitmap (2·P·W·K·C).  The stencil deliberately
+    spends MORE arithmetic to stream ~K·C× less HBM — the right side
+    of the trade for a memory-bound step (:func:`step_hbm_bytes`)."""
     P, L = config.n_peers, config.n_levels
     W = packed_words(config)
     C = config.max_concurrency
     K = n_neighbors
     if config.neighbor_offsets is not None:
         K = len(_normalized_offsets(config.neighbor_offsets, P))
-        elig = 2.0 * P * W * K * C
+        if resolve_eligibility(config) == "kpass":
+            elig = 2.0 * P * W * K * C
+        else:
+            M = C * (K + 1.0)
+            elig = 2.0 * P * W * M + 4.0 * P * M
     else:
         elig = 7.0 * P * K * C
     return elig + 2.0 * P * W + 60.0 * P + 2.0 * P * L
 
 
-def step_hbm_bytes(config: SwarmConfig, n_neighbors: int = 8) -> float:
-    """Analytic main-memory traffic per step.
+def step_hbm_breakdown(config: SwarmConfig,
+                       n_neighbors: int = 8) -> dict:
+    """Per-term analytic main-memory traffic of one step (bytes):
 
-    Circulant fast path (``neighbor_offsets`` set): each of the K
-    eligibility stencil passes streams the BIT-PACKED
-    (availability·presence) map and the one-hot bit mask (4 bytes per
-    u32 word each over [P, ⌈L·S/32⌉]), and the cache insert reads +
-    rewrites the packed map — 8·P·W·(K·C + 1) total (C =
-    max_concurrency transfer slots, each running its own eligibility
-    pass), 8× less than the u8 formulation and deliberately traded for
-    TPU-friendliness over per-element gather/scatter (which measure
-    ~50× slower per edge, tools/profile_kernels.py).  General path:
-    the O(P·K) edge gathers dominate instead.  Both add per-peer
-    state (14 f32/i32 [P] fields incl. the 4 EWMA leaves and
-    fg_wait_ms, plus 11 [P, C] transfer-slot columns incl. the
-    round-4 cooldown/attempt fields and the round-5 holder-slot
-    pin, plus the [P, K] penalty carry under "adaptive", read and
-    written each step as the scan carry) and scenario reads.
+    - ``carry_rw`` — the scan carry, read + written every step,
+      derived from the REAL state layout via ``jax.eval_shape`` over
+      :func:`init_swarm` (new or re-packed fields — the bit-packed
+      ``avail`` map's insert read+rewrite, the packed ``dl_flags``
+      word — are counted automatically at their true dtype widths
+      instead of drifting from a hand-kept census);
+    - ``scenario_reads`` — the per-peer scenario arrays the step
+      consumes (cdn/uplink/join/leave/edge_rank f32);
+    - ``eligibility`` — the formulation-dependent dominant term
+      (``"auto"`` resolved per backend, :func:`resolve_eligibility`,
+      so the model prices the program that actually runs).
+      Circulant "stencil" (the accelerator resolution): ONE stream
+      of the packed
+      ``[P, W]`` map for the shared word extraction plus the small
+      ``[P, M]`` wanted/extracted/rolled word columns (3 u32/i32
+      vectors per column, M = C·(K+1)).  Circulant "kpass" (the
+      pre-0.10 reference): K·C × (map + one-hot bit mask) full
+      re-streams — ``8·P·W·K·C``.  General path: the O(P·K·C) u32
+      word gathers;
+    - ``edge_gathers`` — the general path's [P, K] contention
+      gathers (0 on the circulant path).
 
     This model counts only algorithmically-required traffic (perfect
     fusion); fusion-boundary spills make the REAL traffic higher, so
-    the reported ``hbm_util`` is a lower bound on how hard the
-    memory system is actually working."""
+    the reported ``hbm_util`` is a lower bound — and
+    tests/test_eligibility_stencil.py holds the model against XLA's
+    own ``compiled.cost_analysis()`` bytes-accessed so a toolchain
+    fusion regression (the r05 1M story) fails a test instead of
+    silently eating throughput."""
     P = config.n_peers
     W = packed_words(config)
     C = config.max_concurrency
-    # 14 [P] f32/i32 fields (incl. fg_wait_ms) + 11 [P, C] transfer-
-    # slot columns (incl. the round-4 cooldown/attempts and round-5
-    # dl_holder_off), each read and written as scan carry; "adaptive"
-    # additionally carries the [P, K] penalty field (zero-width for
-    # other policies — see init_swarm)
-    state_rw = 2.0 * (14.0 + 11.0 * C) * 4.0 * P
-    scenario_reads = 5.0 * 4.0 * P
-    cache_insert = 2.0 * 4.0 * P * W        # packed map read + rewritten
-    if config.neighbor_offsets is not None:
+    circulant = config.neighbor_offsets is not None
+    if circulant:
         K = len(_normalized_offsets(config.neighbor_offsets, P))
-        elig = 2.0 * 4.0 * P * W * K * C    # K × (AP + bit mask) u32
-        edges = 0.0
     else:
         K = n_neighbors
+    state = jax.eval_shape(lambda: init_swarm(
+        config, None if circulant else K))
+    carry_rw = 2.0 * sum(
+        float(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(state))
+    scenario_reads = 5.0 * 4.0 * P
+    if circulant:
+        if resolve_eligibility(config) == "kpass":
+            elig = 2.0 * 4.0 * P * W * K * C  # K·C × (AP + bit mask)
+        else:
+            M = C * (K + 1.0)
+            elig = 4.0 * P * W + 3.0 * 4.0 * P * M
+        edges = 0.0
+    else:
         elig = 4.0 * P * K * C              # u32 word gather
         edges = (2.0 * 4.0 * P * K + 3.0 * 4.0 * P * K) * C
-    if config.holder_selection == "adaptive":
-        state_rw += 2.0 * 4.0 * P * K       # holder_penalty_ms carry
-    return cache_insert + elig + edges + state_rw + scenario_reads
+    return {"carry_rw": carry_rw, "scenario_reads": scenario_reads,
+            "eligibility": elig, "edge_gathers": edges}
+
+
+def step_hbm_bytes(config: SwarmConfig, n_neighbors: int = 8) -> float:
+    """Analytic main-memory traffic per step — the sum of
+    :func:`step_hbm_breakdown`'s terms (see there for what each
+    counts and for the formulation dependence: the one-pass stencil
+    streams the bit-packed map ONCE per step where the "kpass"
+    reference re-streamed it K·C times — ~6× less total traffic at
+    the shipped K=8/C=1, ~18× at C=3)."""
+    return float(sum(step_hbm_breakdown(config, n_neighbors).values()))
 
 
 def invert_neighbors(neighbors) -> jnp.ndarray:

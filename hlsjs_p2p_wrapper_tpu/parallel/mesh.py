@@ -163,7 +163,7 @@ def state_shardings(mesh: Mesh) -> SwarmState:
         level=peer_vec,
         ewma=EwmaState(peer_vec, peer_vec, peer_vec, peer_vec),
         avail=avail, cdn_bytes=peer_vec, p2p_bytes=peer_vec,
-        dl_active=peer_vec, dl_is_p2p=peer_vec, dl_seg=peer_vec,
+        dl_flags=peer_vec, dl_seg=peer_vec,
         dl_level=peer_vec, dl_done_bytes=peer_vec,
         dl_total_bytes=peer_vec, dl_elapsed_ms=peer_vec,
         dl_budget_ms=peer_vec, dl_cooldown_ms=peer_vec,

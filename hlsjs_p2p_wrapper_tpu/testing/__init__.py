@@ -1,6 +1,7 @@
 """First-class test fakes (the reference's mocks, promoted) and the
 executable media-engine contract."""
 
+from .elig_oracle import kpass_eligibility
 from .fixtures import (DEFAULT_CONFIG, FakePlayer, make_fragments,
                        wait_for)
 from .mock_cdn import MockCdnTransport, serve_manifest, synthetic_payload
@@ -9,4 +10,5 @@ from .swarm import SwarmHarness, SwarmPeer
 
 __all__ = ["DEFAULT_CONFIG", "FakePlayer", "make_fragments", "wait_for",
            "MockCdnTransport", "serve_manifest", "synthetic_payload",
-           "SwarmHarness", "SwarmPeer", "run_player_contract"]
+           "SwarmHarness", "SwarmPeer", "kpass_eligibility",
+           "run_player_contract"]

@@ -1,0 +1,58 @@
+"""The pre-0.10 K-pass circulant eligibility, kept as the TEST ORACLE.
+
+``ops/swarm_sim.py`` shipped the one-pass eligibility stencil in
+round 8 (``SwarmConfig.eligibility="stencil"``): the bit-packed
+``[P, W]`` availability·presence map streams through HBM once per
+step instead of K·C+ times.  The optimization's correctness claim is
+*bit-identity*, and a claim needs a referee that cannot drift with
+the thing it referees — so the original K-pass formulation lives
+here, written against NumPy in the most obviously-correct shape
+(one explicit roll+AND+reduce pass per offset), for the randomized
+equivalence suite (tests/test_eligibility_stencil.py) to hold both
+of ``circulant_eligibility``'s jnp formulations to.
+
+This module is test infrastructure: nothing under ``ops/`` or
+``engine/`` may import it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def kpass_eligibility(avail_packed, present, offsets, gi_flat):
+    """One slot's circulant eligibility, the pre-stencil way.
+
+    ``avail_packed`` is the ``[P, W]`` u32 bit-packed cache map
+    (bit ``g`` of row ``i`` set ⇔ peer i holds flat (level, seg)
+    cell ``g``), ``present`` the ``[P]`` bool presence mask,
+    ``offsets`` the normalized circulant offsets (no 0 / duplicate
+    entries — ``ops.swarm_sim._normalized_offsets``), ``gi_flat``
+    each requester's ``[P]`` flat target bit.
+
+    Returns ``(elig, n_holders, own)`` exactly as the step consumes
+    them: ``elig`` = K × ``[P]`` float32 0/1 ("my k-th neighbor
+    ``(i + off_k) % P`` is present and holds my bit"), ``n_holders``
+    their float32 sum, ``own`` the requester's own-cache bit test
+    (presence-independent, like the step's absorb check)."""
+    avail = np.asarray(avail_packed, np.uint32)
+    present = np.asarray(present, bool)
+    gi_flat = np.asarray(gi_flat)
+    P, _W = avail.shape
+    word_idx = gi_flat >> 5
+    bitmask = (np.uint32(1) << (gi_flat & 31).astype(np.uint32))
+    rows = np.arange(P)
+    # presence-masked map, as the pre-0.10 step built it (AP)
+    masked = np.where(present[:, None], avail, np.uint32(0))
+    elig = []
+    for off in offsets:
+        # neighbor k of requester i is (i + off) % P; one explicit
+        # pass: roll the masked map rows by -off, test each
+        # requester's own bit in the rolled row
+        rolled = np.roll(masked, -off, axis=0)
+        have = (rolled[rows, word_idx] & bitmask) != 0
+        elig.append(have.astype(np.float32))
+    n_holders = (np.sum(elig, axis=0, dtype=np.float32)
+                 if elig else np.zeros((P,), np.float32))
+    own = (avail[rows, word_idx] & bitmask) != 0
+    return elig, n_holders, own

@@ -7,7 +7,7 @@ library code logs through ``logging`` or counts into the telemetry
 registry (engine/telemetry.py); tools/tests/examples, which OWN their
 stdout, are exempt.
 
-Four repo-specific rules:
+Five repo-specific rules:
 
 - every entry of ``STATIC_KNOBS`` in ``tools/sweep.py`` (the sweep's
   compile-group key) must carry an inline ``# static:``
@@ -36,6 +36,13 @@ Four repo-specific rules:
   callables (the ``FaultPolicy`` convention) or their tests need
   real waits and start flaking; ``# clock-ok: <why>`` is the
   escape.
+- any ``jnp.roll`` whose operand is the bit-packed ``[P, W]``
+  availability map inside ``ops/swarm_sim.py`` must carry an inline
+  ``# traffic-ok: <why>`` justification: the one-pass eligibility
+  stencil exists so the packed map streams through HBM ONCE per
+  step — a full-map roll is a whole extra stream, and the K·C
+  re-stream pattern the stencil replaced must not regrow silently
+  (``[P]``-vector rolls are fine and not flagged).
 
 Run: ``python tools/lint.py`` (exit code 1 on findings).
 """
@@ -309,6 +316,64 @@ def check_clock_discipline(path):
     return findings
 
 
+#: the step-kernel file the packed-map traffic rule guards, and the
+#: identifier spellings the bit-packed availability map goes by
+#: there (the state field, the step's local aliases, and the
+#: presence-masked copy the kpass reference builds)
+TRAFFIC_FILE = os.path.join("hlsjs_p2p_wrapper_tpu", "ops",
+                            "swarm_sim.py")
+_PACKED_MAP_NAMES = {"AP", "avail", "avail_p", "avail_packed"}
+
+
+def check_traffic_discipline(path):
+    """Packed-map traffic discipline for the step kernel: the
+    one-pass eligibility stencil (round 8) cut the step's dominant
+    HBM term from K·C+ full streams of the bit-packed ``[P, W]``
+    availability map to ONE — a ``jnp.roll`` whose operand is that
+    map is a whole extra map stream, which is exactly how the
+    re-stream pattern would regrow.  Any such roll needs an inline
+    ``# traffic-ok: <why>`` (the retained "kpass" A/B reference is
+    the one legitimate site today); rolls of ``[P]`` vectors —
+    word columns, presence, demand, service — are the stencil's
+    cheap finishing ops and are not flagged."""
+    findings = []
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []  # check_file already reports the syntax error
+    lines = source.splitlines()
+
+    def touches_packed_map(node):
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Name)
+                    and sub.id in _PACKED_MAP_NAMES):
+                return True
+            if (isinstance(sub, ast.Attribute)
+                    and sub.attr == "avail"):
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "roll" and node.args):
+            continue
+        if not touches_packed_map(node.args[0]):
+            continue
+        if "# traffic-ok:" in lines[node.lineno - 1]:
+            continue
+        findings.append(
+            f"{path}:{node.lineno}: jnp.roll over the bit-packed "
+            f"availability map — a whole extra [P, W] HBM stream "
+            f"per (slot, offset); extract the wanted words through "
+            f"the one-pass stencil (circulant_eligibility) instead, "
+            f"or annotate '# traffic-ok: <why>' if the full-map "
+            f"roll is genuinely required")
+    return findings
+
+
 #: roots the metrics reference is collected from: the package (what
 #: the engine emits) plus tools/ (soak's invariant gauges).  Tests
 #: mint throwaway families and must not pollute the reference.
@@ -477,6 +542,8 @@ def main(argv=None):
             all_findings.extend(check_broad_excepts(path))
         if path.endswith(CLOCK_FILES):
             all_findings.extend(check_clock_discipline(path))
+        if path.endswith(TRAFFIC_FILE):
+            all_findings.extend(check_traffic_discipline(path))
     all_findings.extend(check_static_knobs(
         os.path.join(repo_root, "tools", "sweep.py")))
     all_findings.extend(check_metrics_reference(repo_root))

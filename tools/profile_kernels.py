@@ -90,6 +90,62 @@ def main():
     bench(f"have: circulant roll+onehot x{T}", scanned(have_onehot),
           (v0,), base_dt, T)
 
+    # ---- packed-map eligibility: K-pass re-stream vs one-pass -------
+    # the round-8 tentpole's two circulant formulations over the
+    # BIT-PACKED [P, W] map (ops/swarm_sim.py circulant_eligibility),
+    # carry-dependent target bits: "kpass" rolls the whole map K
+    # times per step (K+1 map streams incl. the AND operand);
+    # "stencil" extracts each peer's one wanted u32 word per offset
+    # with a single shared one-hot contraction, then finishes with
+    # [P]-vector rolls + bit tests (ONE map stream)
+    Wp = max(args.cols // 32, 1)
+    availp = jax.random.bits(key, (P, Wp), jnp.uint32)
+    wcolp = jnp.arange(Wp, dtype=jnp.int32)
+
+    def bit_of(c):
+        return (jnp.abs(c * 1e4).astype(jnp.int32)) % (Wp * 32)
+
+    def elig_kpass(c):
+        gi = bit_of(c)
+        bm = jnp.uint32(1) << (gi & 31).astype(jnp.uint32)
+        Wm = jnp.where(wcolp[None, :] == (gi >> 5)[:, None],
+                       bm[:, None], jnp.uint32(0))
+        h = sum(jnp.sum((jnp.roll(availp, -o, axis=0) & Wm) != 0,
+                        axis=1, dtype=jnp.int32) for o in offs)
+        return c + h.astype(jnp.float32) * 1e-9
+    bench(f"elig: packed K-pass roll+AND x{T}", scanned(elig_kpass),
+          (v0,), base_dt, T)
+
+    def elig_stencil(c):
+        gi = bit_of(c)
+        wi = gi >> 5
+        bm = jnp.uint32(1) << (gi & 31).astype(jnp.uint32)
+        wanted = jnp.stack([jnp.roll(wi, o) for o in offs], axis=1)
+        # fused select chain = one map stream (the shipped form)
+        ext = jnp.zeros(wanted.shape, jnp.uint32)
+        for w in range(Wp):
+            ext = jnp.where(wanted == w, availp[:, w][:, None], ext)
+        h = sum(((jnp.roll(ext[:, k], -o) & bm) != 0).astype(jnp.int32)
+                for k, o in enumerate(offs))
+        return c + h.astype(jnp.float32) * 1e-9
+    bench(f"elig: packed one-pass stencil x{T}", scanned(elig_stencil),
+          (v0,), base_dt, T)
+
+    def elig_stencil_gather(c):
+        # the CPU pick (ops/swarm_sim.py circulant_eligibility):
+        # per-row gather of the wanted words — gathers run at
+        # memcpy speed on CPU, ~50× slower per edge on TPU
+        gi = bit_of(c)
+        wi = gi >> 5
+        bm = jnp.uint32(1) << (gi & 31).astype(jnp.uint32)
+        wanted = jnp.stack([jnp.roll(wi, o) for o in offs], axis=1)
+        ext = jnp.take_along_axis(availp, wanted, axis=1)
+        h = sum(((jnp.roll(ext[:, k], -o) & bm) != 0).astype(jnp.int32)
+                for k, o in enumerate(offs))
+        return c + h.astype(jnp.float32) * 1e-9
+    bench(f"elig: packed one-pass row gather x{T}",
+          scanned(elig_stencil_gather), (v0,), base_dt, T)
+
     # ---- [P] vector gather vs roll, carry-dependent -----------------
     f = scanned(lambda c: c * 0.999 + jnp.sum(c[nbr], axis=1) * 1e-9)
     bench(f"vec[nbr] gather (carry-dep) x{T}", f, (v0,), base_dt, T)

@@ -215,17 +215,23 @@ def live_grid():
                               announces, waves)]
 
 
-def build_config(peers, segments, live, degree, live_sync_s=None):
+def build_config(peers, segments, live, degree, live_sync_s=None,
+                 eligibility="auto"):
     """The static scenario description: topology degree is the only
     compile-time knob (the live-sync cushion is dynamic scenario data
     since this round).  ``live_sync_s`` re-pins the cushion as a
     static config field — only the legacy group-per-cushion reference
     path uses it (``run_grid_batched(static_live_sync=True)``, the
-    benchmark baseline the one-group live grid is measured against)."""
+    benchmark baseline the one-group live grid is measured against).
+    ``eligibility`` selects the circulant formulation —
+    ``"kpass"`` is the retained pre-0.10 reference the one-pass
+    stencil is A/B'd and bit-identity-tested against (bench.py
+    ``detail.step_traffic``, tests/test_eligibility_stencil.py)."""
     kwargs = {} if live_sync_s is None else {"live_sync_s": live_sync_s}
     return SwarmConfig(n_peers=peers, n_segments=segments,
                       n_levels=N_LEVELS, live=live,
-                      neighbor_offsets=ring_offsets(degree), **kwargs)
+                      neighbor_offsets=ring_offsets(degree),
+                      eligibility=eligibility, **kwargs)
 
 
 def build_scenario(config, knobs, *, watch_s, stagger_s, seed):
@@ -263,6 +269,18 @@ def build_scenario(config, knobs, *, watch_s, stagger_s, seed):
     return scenario, join
 
 
+def sample_grid(grid, n):
+    """An ``n``-point slice spanning a grid's knob regimes (evenly
+    strided through the itertools.product order), degrading to the
+    whole grid when it holds ≤ ``n`` points — the shared sampler
+    bench.py's step-traffic A/B and the formulation bit-identity
+    tests draw from, so the two surfaces can never drift apart or
+    crash on a shrunken grid."""
+    if len(grid) <= n:
+        return list(grid)
+    return grid[::len(grid) // n][:n]
+
+
 def _static_key(knobs, static_live_sync=False):
     """One compile group per distinct value of this tuple.
     ``static_live_sync=True`` re-adds the live cushion to the key —
@@ -287,7 +305,8 @@ def group_grid(grid, static_live_sync=False):
 
 
 def build_groups(grid, *, peers, segments, watch_s, live, seed,
-                 stagger_s=60.0, static_live_sync=False):
+                 stagger_s=60.0, static_live_sync=False,
+                 eligibility="auto"):
     """The compile-group decomposition every execution path shares
     (batched engine, fabric workers, fabric merge): ``group_list``
     is ``run_groups_chunked``'s ``(config, items, build)`` triples,
@@ -301,7 +320,8 @@ def build_groups(grid, *, peers, segments, watch_s, live, seed,
     for key, idxs in groups_map.items():
         sync = key[-1] if (static_live_sync and live) else None
         config = build_config(peers, segments, live, key[0],
-                              live_sync_s=sync)
+                              live_sync_s=sync,
+                              eligibility=eligibility)
         build = (lambda k, cfg=config:
                  build_scenario(cfg, k, watch_s=watch_s,
                                 stagger_s=stagger_s, seed=seed))
@@ -326,7 +346,7 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
                      record_every=0, tracer=None, pipeline=True,
                      static_live_sync=False, interleave=True,
                      warm_start=None, raw=False, faults=None,
-                     journal=None, trace=None):
+                     journal=None, trace=None, eligibility="auto"):
     """The batched engine: one ``run_swarm_batch`` dispatch per
     padded chunk per compile group, host readback pipelined one chunk
     behind the device, chunks round-robined across groups when more
@@ -357,14 +377,18 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
     (engine/artifact_cache.py ``SweepJournal``) records each
     completed row crash-safely for ``--resume``.  ``trace``
     (engine/tracer.py ``FlightRecorder``) arms the flight recorder
-    (default off — the ``--trace-dir`` surface)."""
+    (default off — the ``--trace-dir`` surface).  ``eligibility``
+    selects the circulant formulation for every group's config
+    (``"kpass"`` = the pre-0.10 reference; bench.py's
+    ``detail.step_traffic`` A/B and the bit-identity tests use it —
+    rows are bit-identical across formulations by construction)."""
     if not grid:
         return [], {"compile_groups": 0, "chunk": None,
                     "chunk_autotuned": chunk is None, "groups": []}
     group_list, group_keys, n_steps = build_groups(
         grid, peers=peers, segments=segments, watch_s=watch_s,
         live=live, seed=seed, stagger_s=stagger_s,
-        static_live_sync=static_live_sync)
+        static_live_sync=static_live_sync, eligibility=eligibility)
     results, stats = run_groups_chunked(
         group_list, n_steps, watch_s=watch_s, chunk=chunk,
         record_every=record_every, tracer=tracer, pipeline=pipeline,
