@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
 	coverage soak scaling-artifact warmstart-gate chaos-gate \
-	fleet-gate trace-gate
+	fleet-gate trace-gate tracker-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -104,6 +104,19 @@ fleet-gate:
 trace-gate:
 	$(PY) tools/trace_gate.py
 
+# control-plane proof for the sharded tracker (engine/tracker.py):
+# a CI-sized churn workload (testing/churn.py — Poisson join/leave,
+# flash crowds, hostile squat/foreign ops, lowered quota caps)
+# replayed in lockstep against the retained seed store
+# (testing/tracker_oracle.py) on one VirtualClock — every announce
+# answer and shared registry family must match, every quota path
+# must FIRE, and after the drain the sharded store must hold zero
+# leases at every layer (slab, quota buckets, gauges).  A threaded
+# hammer gates the concurrent-adapter half.  TRACKER_GATE_LEASES /
+# TRACKER_GATE_OPS scale it up.
+tracker-gate:
+	$(PY) tools/tracker_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -112,6 +125,7 @@ examples:
 	$(PY) examples/swarm_demo.py --live
 	$(PY) examples/production_demo.py
 
-check: lint test dryrun warmstart-gate chaos-gate fleet-gate trace-gate
+check: lint test dryrun warmstart-gate chaos-gate fleet-gate \
+	trace-gate tracker-gate
 
 all: check bench

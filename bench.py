@@ -348,6 +348,171 @@ def numpy_baseline_throughput(config, n_steps, join):
 TIMELINE_RECORD_EVERY = 20
 
 
+def tracker_churn_benchmark():
+    """``detail.tracker_churn`` (round 9): the sharded slab tracker
+    (engine/tracker.py) A/B'd against the retained seed store
+    (testing/tracker_oracle.py) at ≥1M live leases under sustained
+    churn — the host-side control-plane hot path getting the same
+    A/B + bench-rider treatment ``detail.step_traffic`` gave the
+    device step.  Per store, sequentially (fresh VirtualClock each,
+    identical op schedule, gc between):
+
+    - **populate**: every lease announced once under ``tracemalloc``
+      → ``bytes_per_lease`` (the traced wall rides along but is NOT
+      the throughput headline — tracing taxes allocation);
+    - **churn**: re-announces of random live peers at full lease
+      count, virtual clock ticking across sweep windows (the seed
+      pays its O(total members) Python walks; the sharded wheel
+      skips clean shards) → ``announces_per_sec`` (the headline) and
+      sampled per-announce p50/p99 latency;
+    - **idle sweep**: one throttled sweep with NOTHING expired — the
+      lazy wheel's direct read (seed walks a million leases to find
+      nothing; sharded peeks one min-deadline per shard);
+    - **drain sweep**: every lease expired at once, one sweep wall —
+      then the sharded store is asserted empty at every layer (the
+      gate's zero-leak contract, re-checked at bench scale).
+
+    Observable equivalence between the stores is pinned elsewhere
+    (tests/test_tracker_oracle.py, ``make tracker-gate``); this rider
+    measures.  ``TRACKER_BENCH_LEASES`` / ``_CHURN_OPS`` / ``_SWARM``
+    resize it."""
+    import gc
+    import random
+    import tracemalloc
+
+    from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+    from hlsjs_p2p_wrapper_tpu.engine.tracker import Tracker
+    from hlsjs_p2p_wrapper_tpu.testing.tracker_oracle import (
+        OracleTracker)
+
+    leases = int(os.environ.get("TRACKER_BENCH_LEASES", 1 << 20))
+    per_swarm = int(os.environ.get("TRACKER_BENCH_SWARM", 64))
+    churn_ops = int(os.environ.get("TRACKER_BENCH_CHURN_OPS", 131_072))
+    n_swarms = max(1, leases // per_swarm)
+    lease_ms = 600_000.0  # long horizon: churn must not expire leases
+    # identities precomputed OUTSIDE the traced window: id strings are
+    # wire-decoded peers' property, identical for both stores —
+    # bytes_per_lease measures STORE overhead, not string payload
+    peer_ids = [f"10.{(i >> 16) & 255}.{(i >> 8) & 255}."
+                f"{i & 255}:4000" for i in range(leases)]
+    swarm_ids = [f"swarm-{i:05d}" for i in range(n_swarms)]
+    rng = random.Random(0xC0DE)
+    churn_idx = [rng.randrange(leases) for _ in range(churn_ops)]
+    ops_per_tick = max(1, churn_ops // 20)  # ~20 sweep windows
+
+    saved_caps = {}
+    for cls in (Tracker, OracleTracker):
+        saved_caps[cls] = (cls.MAX_SWARMS, cls.MAX_MEMBERS_PER_SWARM)
+        cls.MAX_SWARMS = n_swarms + 8
+        cls.MAX_MEMBERS_PER_SWARM = max(cls.MAX_MEMBERS_PER_SWARM,
+                                        per_swarm * 2)
+
+    def measure(make_store):
+        gc.collect()
+        clock = VirtualClock()
+        tracemalloc.start()
+        base = tracemalloc.get_traced_memory()[0]
+        store = make_store(clock)
+        start = time.perf_counter()
+        for i in range(leases):
+            store.announce(swarm_ids[i % n_swarms], peer_ids[i],
+                           source=peer_ids[i])
+        populate_s = time.perf_counter() - start
+        grown = tracemalloc.get_traced_memory()[0] - base
+        tracemalloc.stop()
+
+        samples = []
+        start = time.perf_counter()
+        for j, i in enumerate(churn_idx):
+            if j % ops_per_tick == 0:
+                # each tick must clear the sweep throttle, or the
+                # churn phase never actually charges the seed its
+                # O(total members) walks (~20 sweeps fire across the
+                # phase; the 600 s lease horizon keeps them no-op
+                # scans — pure sweep cost, no expiries)
+                clock.advance(Tracker.EXPIRE_SWEEP_MS + 1.0)
+            sid, pid = swarm_ids[i % n_swarms], peer_ids[i]
+            if j & 15 == 0:
+                t0 = time.perf_counter()
+                store.announce(sid, pid, source=pid)
+                samples.append(time.perf_counter() - t0)
+            else:
+                store.announce(sid, pid, source=pid)
+        churn_s = time.perf_counter() - start
+        samples.sort()
+
+        # one throttled sweep with nothing near expiry: the wheel's
+        # direct read (members() triggers it on both store designs)
+        clock.advance(Tracker.EXPIRE_SWEEP_MS + 1.0)
+        start = time.perf_counter()
+        store.members(swarm_ids[0])
+        idle_sweep_s = time.perf_counter() - start
+
+        # every lease expires at once; one sweep drains the store
+        clock.advance(lease_ms + Tracker.EXPIRE_SWEEP_MS + 1.0)
+        start = time.perf_counter()
+        store.members(swarm_ids[0])
+        drain_sweep_s = time.perf_counter() - start
+        result = {
+            "populate_traced_wall_s": round(populate_s, 2),
+            "bytes_per_lease": round(grown / leases, 1),
+            "churn_wall_s": round(churn_s, 3),
+            "announces_per_sec": round(churn_ops / churn_s, 1),
+            "announce_p50_us": round(
+                samples[len(samples) // 2] * 1e6, 1),
+            "announce_p99_us": round(
+                samples[int(len(samples) * 0.99)] * 1e6, 1),
+            "idle_sweep_s": round(idle_sweep_s, 6),
+            "drain_sweep_s": round(drain_sweep_s, 3),
+        }
+        return store, result
+
+    try:
+        sharded, sharded_out = measure(
+            lambda c: Tracker(c, lease_ms=lease_ms,
+                              registry=MetricsRegistry()))
+        sharded_out["shards"] = sharded._n_shards
+        # the zero-leak contract, re-checked at bench scale
+        assert sharded.lease_count() == 0, \
+            "sharded store leaked leases after the drain sweep"
+        sharded._assert_consistent()
+        del sharded
+        seed, seed_out = measure(
+            lambda c: OracleTracker(c, lease_ms=lease_ms,
+                                    registry=MetricsRegistry()))
+        assert seed._swarms == {}, \
+            "seed store retained swarms after the drain sweep"
+        del seed
+        gc.collect()
+    finally:
+        for cls, (max_swarms, max_members) in saved_caps.items():
+            cls.MAX_SWARMS = max_swarms
+            cls.MAX_MEMBERS_PER_SWARM = max_members
+
+    return {
+        "what": f"{leases:,}-lease control plane under sustained "
+                "churn: sharded slab store vs the seed dict store "
+                f"({n_swarms:,} swarms × {per_swarm}; equivalence "
+                "pinned by make tracker-gate)",
+        "live_leases": leases, "swarms": n_swarms,
+        "members_per_swarm": per_swarm, "churn_ops": churn_ops,
+        "sharded": sharded_out, "seed": seed_out,
+        "speedup_announces": round(
+            sharded_out["announces_per_sec"]
+            / seed_out["announces_per_sec"], 2),
+        "bytes_per_lease_ratio": round(
+            seed_out["bytes_per_lease"]
+            / sharded_out["bytes_per_lease"], 2),
+        "idle_sweep_speedup": round(
+            seed_out["idle_sweep_s"]
+            / max(sharded_out["idle_sweep_s"], 1e-9), 1),
+        "drain_sweep_speedup": round(
+            seed_out["drain_sweep_s"]
+            / max(sharded_out["drain_sweep_s"], 1e-9), 2),
+    }
+
+
 def step_traffic_benchmark():
     """The one-pass eligibility stencil's A/B (round 8): the
     1,048,576-peer circulant shape (K=8, C=1) stepped under
@@ -983,11 +1148,17 @@ def main():
                          "crash can leave a truncated artifact)")
     args = ap.parse_args()
 
-    # warm-start benchmark FIRST OF ALL: its cold pass must be the
-    # first compile of the batched VOD program in this process — run
-    # after the grid benchmark below, the AOT lower/compile could hit
-    # in-process caches the other benchmarks warmed and the "cold"
-    # wall would be fiction
+    # the tracker churn A/B runs before everything: it is pure
+    # host-side Python (no XLA, so it cannot warm the compile caches
+    # the warm-start benchmark needs cold), and its ~GB of transient
+    # lease state is freed before the device benchmarks size theirs
+    tracker_churn = tracker_churn_benchmark()
+
+    # warm-start benchmark FIRST of the device measurements: its cold
+    # pass must be the first compile of the batched VOD program in
+    # this process — run after the grid benchmark below, the AOT
+    # lower/compile could hit in-process caches the other benchmarks
+    # warmed and the "cold" wall would be fiction
     warm_start = warm_start_benchmark()
 
     # grid benchmark before the step bench: the step bench below
@@ -1050,6 +1221,7 @@ def main():
     # rows), not a property of the grid comparison it rode along
     detail["trace_overhead"] = sweep_grid.pop("trace_overhead")
     detail["warm_start"] = warm_start
+    detail["tracker_churn"] = tracker_churn
     # the one-pass stencil A/B runs LAST of the in-process
     # measurements: its 1M-peer buffers would fragment the heap
     # under everything above

@@ -704,6 +704,16 @@ class TcpEndpoint:
         #: ids an inbound preamble may never claim (module docstring:
         #: trust model).  The agent adds its tracker id here.
         self.reject_inbound_ids: set = set()
+        #: deliver inbound frames directly on the reader thread
+        #: instead of posting them to the NetLoop.  Default False —
+        #: the loop keeps single-threaded engine components
+        #: single-threaded by construction.  A handler that is
+        #: thread-safe end to end (the sharded tracker service:
+        #: ``TrackerEndpoint(..., concurrent=True)`` sets this) opts
+        #: in so concurrent remote announcers stop serializing on the
+        #: one dispatch thread — the host-side analogue of the store's
+        #: shard locks.
+        self.deliver_inline = False
         self._conns: Dict[str, _Connection] = {}
         self._extra_conns: list = []  # crossed-dial inbound links
         self._conn_lock = threading.Lock()
@@ -1085,6 +1095,20 @@ class TcpEndpoint:
             conn.last_activity = time.monotonic()
             self.bytes_received += len(frame)
             src = conn.remote_id
+
+            if self.deliver_inline:
+                # opt-in fast path (see the field docs): the handler
+                # runs HERE, concurrently across reader threads.  A
+                # handler bug must cost this connection's frame, not
+                # the reader thread (the loop path gets the same
+                # containment from NetLoop._run)
+                if not self.closed and self.on_receive is not None:
+                    try:
+                        self.on_receive(src, frame)
+                    except Exception:  # noqa: BLE001
+                        log.exception("unhandled error in inline "
+                                      "frame handler")
+                continue
 
             def deliver(frame=frame, src=src) -> None:
                 if not self.closed and self.on_receive is not None:

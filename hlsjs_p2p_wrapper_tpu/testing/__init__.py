@@ -1,14 +1,17 @@
 """First-class test fakes (the reference's mocks, promoted) and the
 executable media-engine contract."""
 
+from .churn import ChurnSpec, FlashCrowd, churn_events, replay
 from .elig_oracle import kpass_eligibility
 from .fixtures import (DEFAULT_CONFIG, FakePlayer, make_fragments,
                        wait_for)
 from .mock_cdn import MockCdnTransport, serve_manifest, synthetic_payload
 from .player_contract import run_player_contract
 from .swarm import SwarmHarness, SwarmPeer
+from .tracker_oracle import OracleTracker
 
 __all__ = ["DEFAULT_CONFIG", "FakePlayer", "make_fragments", "wait_for",
            "MockCdnTransport", "serve_manifest", "synthetic_payload",
            "SwarmHarness", "SwarmPeer", "kpass_eligibility",
-           "run_player_contract"]
+           "run_player_contract", "OracleTracker", "ChurnSpec",
+           "FlashCrowd", "churn_events", "replay"]

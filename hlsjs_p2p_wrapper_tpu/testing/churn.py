@@ -1,0 +1,240 @@
+"""Deterministic churn load generator for the tracker control plane.
+
+The device side simulates million-peer swarms in one dispatch; the
+host-side tracker those peers would rendezvous through needs load of
+the same shape to be benchmarked honestly.  This module generates it:
+a seeded, fully deterministic stream of ANNOUNCE/LEAVE operations
+modeling the population processes the heterogeneous-population
+roadmap item names — Poisson join/leave (exponential session
+lengths), periodic re-announce with per-peer jitter, flash crowds
+piling into one swarm, crash departures that age out by lease expiry
+vs orderly LEAVEs, shared-host populations that exercise the
+per-source quotas, and an optional hostile fraction (squatting
+announces + foreign leaves) that exercises the ownership paths.
+
+Everything is driven on an injected clock: :func:`replay` applies
+one op stream to any number of tracker stores in lockstep on a
+shared ``VirtualClock``, asserting response equality across stores —
+the harness ``tests/test_tracker_oracle.py``, ``tools/
+tracker_gate.py``, and ``bench.py detail.tracker_churn`` all build
+on.  A failure reproduces from the spec + seed alone.
+
+This module is test infrastructure: nothing under ``engine/`` may
+import it.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, NamedTuple, Optional, Tuple
+
+OP_ANNOUNCE = "announce"
+OP_LEAVE = "leave"
+
+#: registry families BOTH stores emit — the equivalence surface the
+#: oracle suite and the gate assert over (per-shard ``tracker.shard_*``
+#: families exist only on the sharded store and are excluded)
+TRACKER_FAMILIES = (
+    "tracker.announces", "tracker.lease_reclaims",
+    "tracker.lease_expiries", "tracker.announce_rejects",
+    "tracker.leave_rejects", "tracker.peers_returned",
+)
+
+
+class ChurnOp(NamedTuple):
+    """One generated operation (times in ms, nondecreasing)."""
+
+    t_ms: float
+    op: str           # OP_ANNOUNCE | OP_LEAVE
+    swarm_id: str
+    peer_id: str
+    source: Optional[str]
+
+
+@dataclass(frozen=True)
+class FlashCrowd:
+    """A burst of short-session joiners piling into ONE swarm."""
+
+    t_ms: float
+    swarm: int                 # index into the spec's swarm range
+    peers: int
+    window_ms: float = 500.0   # arrivals spread across this window
+    session_ms: float = 5_000.0
+
+
+@dataclass(frozen=True)
+class ChurnSpec:
+    """One churn workload, fully determined by its fields + seed."""
+
+    n_swarms: int = 32
+    #: steady-state live-lease target (spawned over ``ramp_ms``;
+    #: every departure schedules a replacement join)
+    target_leases: int = 1_024
+    duration_ms: float = 30_000.0
+    ramp_ms: float = 5_000.0
+    #: exponential mean session length; departures are Poisson
+    mean_session_ms: float = 120_000.0
+    announce_interval_ms: float = 10_000.0
+    #: each peer's re-announce period is interval*(1 ± U(0, jitter))
+    announce_jitter: float = 0.3
+    #: departing peers send LEAVE with this probability; the rest
+    #: crash and age out by lease expiry
+    orderly_leave_fraction: float = 0.5
+    #: fraction of peers drawn from a small shared-host pool (their
+    #: announces share per-source quota buckets); the rest get a
+    #: unique host each
+    shared_host_fraction: float = 0.0
+    shared_hosts: int = 8
+    #: fraction of announces shadowed by a hostile op: a squatting
+    #: re-announce of the same peer id from an attacker source, and
+    #: (half the time) a foreign LEAVE attempt
+    hostile_fraction: float = 0.0
+    flash_crowds: Tuple[FlashCrowd, ...] = ()
+    seed: int = 0
+
+
+def swarm_name(i: int) -> str:
+    return f"swarm-{i:05d}"
+
+
+def _peer_identity(idx: int, shared_host: Optional[int]) -> str:
+    """Deterministic transport id for peer ``idx``: a unique /32 per
+    peer, or a pool host (one quota bucket) with a per-peer port."""
+    if shared_host is not None:
+        return f"198.51.{(shared_host >> 8) & 255}." \
+               f"{shared_host & 255}:{4000 + idx % 60_000}"
+    return f"10.{(idx >> 16) & 255}.{(idx >> 8) & 255}." \
+           f"{idx & 255}:4000"
+
+
+def churn_events(spec: ChurnSpec) -> Iterator[ChurnOp]:
+    """Yield the spec's op stream in time order (lazy — the heap
+    holds one pending event per live peer, so million-lease specs
+    stream without materializing the full op list)."""
+    rng = random.Random(spec.seed)
+    seq = itertools.count()
+    heap: list = []  # (t, seq, kind, payload)
+    next_idx = itertools.count()
+
+    def spawn(t: float, swarm: int, session_ms: float,
+              replace: bool) -> None:
+        idx = next(next_idx)
+        shared = (rng.randrange(spec.shared_hosts)
+                  if spec.shared_hosts
+                  and rng.random() < spec.shared_host_fraction
+                  else None)
+        peer = _peer_identity(idx, shared)
+        depart = t + rng.expovariate(1.0 / session_ms)
+        heapq.heappush(heap, (t, next(seq), "announce",
+                              (swarm, peer, depart, replace)))
+
+    for _ in range(spec.target_leases):
+        spawn(rng.uniform(0.0, spec.ramp_ms),
+              rng.randrange(spec.n_swarms), spec.mean_session_ms,
+              replace=True)
+    for crowd in spec.flash_crowds:
+        for _ in range(crowd.peers):
+            spawn(crowd.t_ms + rng.uniform(0.0, crowd.window_ms),
+                  crowd.swarm, crowd.session_ms, replace=False)
+
+    while heap:
+        t, _, kind, payload = heapq.heappop(heap)
+        if t > spec.duration_ms:
+            continue  # drain the heap; later events never re-sort
+        if kind == "announce":
+            swarm, peer, depart, replace = payload
+            sid = swarm_name(swarm)
+            if t >= depart:
+                # the session ended before this re-announce fired
+                if rng.random() < spec.orderly_leave_fraction:
+                    yield ChurnOp(t, OP_LEAVE, sid, peer, peer)
+                # crashed peers emit nothing — the lease ages out
+                if replace:
+                    spawn(t + rng.expovariate(
+                        1.0 / max(spec.announce_interval_ms, 1.0)),
+                        rng.randrange(spec.n_swarms),
+                        spec.mean_session_ms, replace=True)
+                continue
+            yield ChurnOp(t, OP_ANNOUNCE, sid, peer, peer)
+            if spec.hostile_fraction \
+                    and rng.random() < spec.hostile_fraction:
+                attacker = f"203.0.113.{rng.randrange(32)}:1"
+                yield ChurnOp(t, OP_ANNOUNCE, sid, peer, attacker)
+                if rng.random() < 0.5:
+                    yield ChurnOp(t, OP_LEAVE, sid, peer, attacker)
+            jitter = 1.0 + rng.uniform(-spec.announce_jitter,
+                                       spec.announce_jitter)
+            heapq.heappush(
+                heap, (t + spec.announce_interval_ms * jitter,
+                       next(seq), "announce", payload))
+
+
+def tracker_counter_snapshot(registry) -> Dict[str, object]:
+    """The equivalence surface: every :data:`TRACKER_FAMILIES` series
+    (labels flattened into the key) with its read value — histograms
+    read as their full bucket structs, so two snapshots are equal iff
+    every shared counter AND distribution agree."""
+    out: Dict[str, object] = {}
+    for family in TRACKER_FAMILIES:
+        for labels, value in registry.series(family):
+            inner = ",".join(f"{k}={v}"
+                             for k, v in sorted(labels.items()))
+            out[f"{family}{{{inner}}}" if inner else family] = value
+    return out
+
+
+class Mismatch(NamedTuple):
+    """One point where two stores' observable behavior diverged."""
+
+    index: int
+    op: ChurnOp
+    answers: Tuple
+
+
+def replay(events, stores, clock, *,
+           on_op=None) -> Tuple[List[Mismatch], Dict[str, int]]:
+    """Apply one op stream to every store in lockstep on the shared
+    ``clock`` (a ``VirtualClock``), comparing each ANNOUNCE's answer
+    across stores.  Returns ``(mismatches, stats)``; an empty
+    mismatch list is the equivalence claim for this interleaving.
+    ``on_op(i, op)`` is the bench's timing hook."""
+    mismatches: List[Mismatch] = []
+    stats = {"announces": 0, "leaves": 0}
+    for i, op in enumerate(events):
+        dt = op.t_ms - clock.now()
+        if dt > 0:
+            clock.advance(dt)
+        if on_op is not None:
+            on_op(i, op)
+        if op.op == OP_ANNOUNCE:
+            stats["announces"] += 1
+            answers = tuple(s.announce(op.swarm_id, op.peer_id,
+                                       source=op.source)
+                            for s in stores)
+            if any(a != answers[0] for a in answers[1:]):
+                mismatches.append(Mismatch(i, op, answers))
+        else:
+            stats["leaves"] += 1
+            for s in stores:
+                s.leave(op.swarm_id, op.peer_id, source=op.source)
+    return mismatches, stats
+
+
+def drain(stores, clock, spec_or_swarms) -> None:
+    """Expire every remaining lease and sweep it out of all stores:
+    advance past the longest lease + the sweep throttle, then touch
+    every swarm (``members`` runs the throttled global sweep and the
+    inline expiry on both store designs).  After this, a leak-free
+    store is EMPTY — the gate asserts exactly that."""
+    n_swarms = (spec_or_swarms.n_swarms
+                if hasattr(spec_or_swarms, "n_swarms")
+                else int(spec_or_swarms))
+    longest = max(getattr(s, "lease_ms", 30_000.0) for s in stores)
+    sweep = max(type(s).EXPIRE_SWEEP_MS for s in stores)
+    clock.advance(longest + sweep + 1.0)
+    for s in stores:
+        for i in range(n_swarms):
+            s.members(swarm_name(i))

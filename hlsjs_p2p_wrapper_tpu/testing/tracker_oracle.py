@@ -1,0 +1,241 @@
+"""The pre-0.11 dict-of-dicts tracker store, kept as the TEST ORACLE.
+
+``engine/tracker.py`` shipped the sharded slab-backed membership
+engine in round 9: N independently-locked shards, preallocated lease
+slots with numpy deadline arrays, and a per-shard lazy expiry wheel
+replacing the Python-loop sweeps.  The optimization's correctness
+claim is *observable equivalence* — same responses, same quota
+decisions, same registry counters — and a claim needs a referee that
+cannot drift with the thing it referees (the ``elig_oracle`` rule).
+So the seed's single-table store lives here, verbatim in the most
+obviously-correct shape (one dict walk per sweep, one nested dict per
+swarm), for the randomized interleaving suite
+(tests/test_tracker_oracle.py), ``tools/tracker_gate.py``, and the
+``bench.py detail.tracker_churn`` A/B to hold the sharded store to.
+
+This module is test infrastructure: nothing under ``engine/`` may
+import it.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..core.clock import Clock
+from ..engine.telemetry import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+#: a member-attribution key: (swarm id, peer id)
+_MemberKey = Tuple[str, str]
+
+
+class OracleTracker:
+    """The seed ``Tracker`` core, unchanged: authoritative membership
+    store, transport-agnostic, single-threaded.  Every semantic the
+    sharded store must preserve is defined by THIS code: per-source
+    quotas, self-eviction, swarm-create refusal, lease reclaim on
+    transport-id match, throttled + forced expiry sweeps."""
+
+    MAX_SWARMS = 1_024
+    MAX_MEMBERS_PER_SWARM = 2_048
+    MAX_SWARM_CREATES_PER_SOURCE = 64
+    MAX_MEMBERS_PER_SOURCE = 256
+    EXPIRE_SWEEP_MS = 1_000.0
+
+    def __init__(self, clock: Clock, *, lease_ms: float = 30_000.0,
+                 max_peers_returned: int = 30,
+                 registry: Optional[MetricsRegistry] = None):
+        self.clock = clock
+        self.lease_ms = lease_ms
+        self.max_peers_returned = max_peers_returned
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        self._m_announces = self.metrics.counter("tracker.announces")
+        self._m_reclaims = self.metrics.counter("tracker.lease_reclaims")
+        self._m_expiries = self.metrics.counter("tracker.lease_expiries")
+        self._m_rejects = {
+            reason: self.metrics.counter("tracker.announce_rejects",
+                                         reason=reason)
+            for reason in ("swarm_cap", "create_quota",
+                           "foreign_owner", "member_cap")}
+        self._m_leave_rejects = self.metrics.counter(
+            "tracker.leave_rejects")
+        self._m_peers_returned = self.metrics.histogram(
+            "tracker.peers_returned",
+            buckets=(0.0, 1.0, 2.0, 5.0, 10.0, 20.0, 30.0))
+        # swarm id -> peer id -> lease expiry (ms)
+        self._swarms: Dict[str, Dict[str, float]] = {}
+        self._last_sweep_ms = -1e18
+        self._swarm_creator: Dict[str, str] = {}
+        self._creates_by_source: Dict[str, int] = {}
+        self._member_source: Dict[_MemberKey, str] = {}
+        self._members_by_source: Dict[str, Dict[_MemberKey, None]] = {}
+        self._last_forced_sweep_ms = -1e18
+
+    @staticmethod
+    def _source_key(source: Optional[str]) -> Optional[str]:
+        if source is None:
+            return None
+        return source.rsplit(":", 1)[0] if ":" in source else source
+
+    def announce(self, swarm_id: str, peer_id: str,
+                 source: Optional[str] = None) -> List[str]:
+        self._m_announces.inc()
+        now = self.clock.now()
+        self._expire_swarms(now)
+        swarm = self._swarms.get(swarm_id)
+        if swarm is not None:
+            self._expire_members(swarm_id, swarm, now)
+            swarm = self._swarms.get(swarm_id)
+        key = self._source_key(source)
+        if swarm is None:
+            if len(self._swarms) >= self.MAX_SWARMS:
+                if now - self._last_forced_sweep_ms \
+                        >= self.EXPIRE_SWEEP_MS:
+                    self._last_forced_sweep_ms = now
+                    self._last_sweep_ms = -1e18
+                    self._expire_swarms(now)
+                if len(self._swarms) >= self.MAX_SWARMS:
+                    self._reject("swarm_cap", swarm_id, peer_id, source)
+                    return []
+            if key is not None and self._creates_by_source.get(key, 0) \
+                    >= self.MAX_SWARM_CREATES_PER_SOURCE:
+                self._reject("create_quota", swarm_id, peer_id, source)
+                return []
+            swarm = self._swarms[swarm_id] = {}
+            if key is not None:
+                self._swarm_creator[swarm_id] = key
+                self._creates_by_source[key] = \
+                    self._creates_by_source.get(key, 0) + 1
+        if key is not None and peer_id in swarm:
+            owner = self._member_source.get((swarm_id, peer_id))
+            if owner is not None and owner != key and source != peer_id:
+                self._reject("foreign_owner", swarm_id, peer_id, source)
+                others = [p for p in swarm if p != peer_id]
+                others.reverse()
+                return others[: self.max_peers_returned]
+        known = swarm.pop(peer_id, None) is not None
+        registered = known or len(swarm) < self.MAX_MEMBERS_PER_SWARM
+        if registered:
+            if key is not None:
+                self._attribute_member(swarm_id, peer_id, key,
+                                       reclaim=(source == peer_id))
+            swarm[peer_id] = now + self.lease_ms
+        else:
+            self._reject("member_cap", swarm_id, peer_id, source)
+        others = [p for p in swarm if p != peer_id]
+        others.reverse()
+        answered = others[: self.max_peers_returned]
+        if registered:
+            self._m_peers_returned.observe(len(answered))
+        return answered
+
+    @property
+    def announce_count(self) -> int:
+        return self._m_announces.value
+
+    def _reject(self, reason: str, swarm_id: str, peer_id: str,
+                source: Optional[str]) -> None:
+        self._m_rejects[reason].inc()
+        log.debug("announce rejected (%s): swarm=%s peer=%s source=%s",
+                  reason, swarm_id, peer_id, source)
+
+    def _attribute_member(self, swarm_id: str, peer_id: str,
+                          key: str, reclaim: bool = False) -> None:
+        mkey = (swarm_id, peer_id)
+        prior = self._member_source.get(mkey)
+        if prior is not None and prior != key:
+            if not reclaim:
+                return
+            log.warning(
+                "lease reclaim: peer %s (swarm %s) took its "
+                "membership back from squatting source %s — "
+                "announcer's address-verified transport id equals "
+                "the claimed peer id", peer_id, swarm_id, prior)
+            self._m_reclaims.inc()
+            self._remove_member_attribution(swarm_id, peer_id)
+        bucket = self._members_by_source.setdefault(key, {})
+        if mkey not in bucket and len(bucket) >= self.MAX_MEMBERS_PER_SOURCE:
+            victim_swarm, victim_peer = next(iter(bucket))
+            self._remove_member_attribution(victim_swarm, victim_peer)
+            vswarm = self._swarms.get(victim_swarm)
+            if vswarm is not None:
+                vswarm.pop(victim_peer, None)
+                if not vswarm and victim_swarm != swarm_id:
+                    self._drop_swarm(victim_swarm)
+            bucket = self._members_by_source.setdefault(key, {})
+        bucket.pop(mkey, None)  # refresh = reinsert at the LRU tail
+        bucket[mkey] = None
+        self._member_source[mkey] = key
+
+    def _remove_member_attribution(self, swarm_id: str,
+                                   peer_id: str) -> None:
+        mkey = (swarm_id, peer_id)
+        src = self._member_source.pop(mkey, None)
+        if src is not None:
+            bucket = self._members_by_source.get(src)
+            if bucket is not None:
+                bucket.pop(mkey, None)
+                if not bucket:
+                    del self._members_by_source[src]
+
+    def _drop_swarm(self, swarm_id: str) -> None:
+        swarm = self._swarms.pop(swarm_id, None)
+        if swarm:
+            for peer_id in list(swarm):
+                self._remove_member_attribution(swarm_id, peer_id)
+        creator = self._swarm_creator.pop(swarm_id, None)
+        if creator is not None:
+            n = self._creates_by_source.get(creator, 0) - 1
+            if n > 0:
+                self._creates_by_source[creator] = n
+            else:
+                self._creates_by_source.pop(creator, None)
+
+    def leave(self, swarm_id: str, peer_id: str,
+              source: Optional[str] = None) -> None:
+        swarm = self._swarms.get(swarm_id)
+        if not swarm or peer_id not in swarm:
+            return
+        if source is not None:
+            owner = self._member_source.get((swarm_id, peer_id))
+            if owner is not None and owner != self._source_key(source):
+                self._m_leave_rejects.inc()
+                log.debug("leave rejected: source %s does not own "
+                          "membership (%s, %s)", source, swarm_id,
+                          peer_id)
+                return
+        swarm.pop(peer_id, None)
+        self._remove_member_attribution(swarm_id, peer_id)
+        if not swarm:
+            self._drop_swarm(swarm_id)
+
+    def members(self, swarm_id: str) -> List[str]:
+        now = self.clock.now()
+        self._expire_swarms(now)
+        swarm = self._swarms.get(swarm_id)
+        if swarm is not None:
+            self._expire_members(swarm_id, swarm, now)
+        return list(self._swarms.get(swarm_id, {}))
+
+    def _expire_members(self, swarm_id: str, swarm: Dict[str, float],
+                        now: float) -> None:
+        expired = [p for p, exp in swarm.items() if exp <= now]
+        for peer_id in expired:
+            del swarm[peer_id]
+            self._remove_member_attribution(swarm_id, peer_id)
+        if expired:
+            self._m_expiries.inc(len(expired))
+            log.debug("swarm %s: %d lease(s) expired", swarm_id,
+                      len(expired))
+        if not swarm:
+            self._drop_swarm(swarm_id)
+
+    def _expire_swarms(self, now: float) -> None:
+        if now - self._last_sweep_ms < self.EXPIRE_SWEEP_MS:
+            return
+        self._last_sweep_ms = now
+        for swarm_id in list(self._swarms):
+            self._expire_members(swarm_id, self._swarms[swarm_id], now)

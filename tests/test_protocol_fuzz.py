@@ -124,3 +124,91 @@ def test_trailing_garbage_rejected(msg):
         pytest.skip("chunk payload is the frame tail by design")
     with pytest.raises(P.ProtocolError):
         P.decode(P.encode(msg) + b"\x00")
+
+
+# -- tracker control-plane messages (round 9) ---------------------------
+# The sharded tracker turns ANNOUNCE/LEAVE/PEERS into the host-side
+# hot path, handled concurrently on transport reader threads — a
+# decode escape here kills a reader, not just the dispatch loop, so
+# the three tracker messages get directed exhaustive coverage on top
+# of the seeded fuzz above.
+
+TRACKER_MSGS = [
+    P.Announce("swarm-abc", "peer-1"),
+    P.Announce("", ""),                       # empty ids are legal
+    P.Announce("s" * 300, "péer-☃"),  # long + non-ASCII
+    P.Leave("swarm-abc", "peer-1"),
+    P.Leave("", "p"),
+    P.Peers("swarm-abc", ()),
+    P.Peers("swarm-abc", ("a",)),
+    P.Peers("swarm-abc", tuple(f"10.0.0.{i}:4000" for i in range(30))),
+    P.Peers("ümlaut", ("péer",)),
+]
+
+
+@pytest.mark.parametrize("msg", TRACKER_MSGS,
+                         ids=lambda m: type(m).__name__)
+def test_tracker_messages_round_trip(msg):
+    """encode → decode is the identity for every tracker message
+    shape, including empty ids, long ids, non-ASCII, and a
+    max_peers_returned-sized PEERS answer."""
+    frame = P.encode(msg)
+    assert P.decode(frame) == msg
+    assert P.encode(P.decode(frame)) == frame  # canonical both ways
+
+
+@pytest.mark.parametrize("msg", TRACKER_MSGS,
+                         ids=lambda m: type(m).__name__)
+def test_tracker_messages_every_truncation_rejected(msg):
+    """EVERY proper prefix of every tracker frame must raise
+    ProtocolError — never IndexError/struct.error/UnicodeDecodeError,
+    and never decode to a message (no prefix of a frame is a valid
+    frame: the length-prefixed string fields make short reads
+    detectable at each boundary)."""
+    frame = P.encode(msg)
+    for cut in range(len(frame)):
+        with pytest.raises(P.ProtocolError):
+            P.decode(frame[:cut])
+
+
+def test_peers_forged_count_rejected_without_allocation():
+    """A PEERS body whose declared member count exceeds the actual
+    body must reject at the string-field boundary, not trust the
+    count."""
+    body = P._pack_str("swarm") + b"\xff\xff" + P._pack_str("p0")
+    with pytest.raises(P.ProtocolError):
+        P.decode(P._frame(P.MsgType.PEERS, body))
+
+
+def test_tracker_endpoint_counts_decode_rejects():
+    """The adapter's reject path is OBSERVABLE: each dropped
+    undecodable frame bumps ``tracker.decode_rejects`` (the counter
+    the reject-path assertions and dashboards read), and the service
+    keeps serving."""
+    from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+    from hlsjs_p2p_wrapper_tpu.engine.tracker import (Tracker,
+                                                      TrackerEndpoint)
+    from hlsjs_p2p_wrapper_tpu.engine.transport import LoopbackNetwork
+
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=1.0)
+    registry = MetricsRegistry()
+    tracker = Tracker(clock, registry=registry)
+    TrackerEndpoint(tracker, net.register("tracker"))
+    evil = net.register("evil")
+    hostile = [
+        b"",                                    # empty
+        b"\xff\xff\xff\xff",                    # bad magic
+        P.encode(P.Announce("s", "p"))[:-1],    # truncated announce
+        P._frame(P.MsgType.LEAVE, b"\x01\x00s" + b"\x02\x00\xff\xfe"),
+        P._frame(0x6E, b"??"),                  # unknown type
+    ]
+    for frame in hostile:
+        evil.send("tracker", frame)
+    clock.advance(20.0)
+    assert registry.counter("tracker.decode_rejects").value \
+        == len(hostile)
+    # reject answers must not have perturbed the lease store
+    assert tracker.announce("s", "p1") == []
+    assert tracker.members("s") == ["p1"]

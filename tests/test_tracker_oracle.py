@@ -1,0 +1,191 @@
+"""Oracle equivalence: the sharded slab tracker vs the seed store.
+
+The round-9 tracker rewrite's correctness claim is OBSERVABLE
+EQUIVALENCE — identical announce answers, identical members lists,
+identical quota decisions, identical registry counters — against the
+seed's single-table store, retained verbatim as
+``testing/tracker_oracle.py`` (the ``elig_oracle`` pattern applied to
+the control plane).  Randomized churn interleavings from
+``testing/churn.py`` replay against both stores in lockstep on one
+VirtualClock; any divergence reproduces from (spec, seed) alone.
+``tools/tracker_gate.py`` runs the CI-sized version of the same
+contract inside ``make check``.
+"""
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+from hlsjs_p2p_wrapper_tpu.engine.tracker import Tracker
+from hlsjs_p2p_wrapper_tpu.testing.churn import (ChurnSpec, FlashCrowd,
+                                                 churn_events, drain,
+                                                 replay, swarm_name,
+                                                 tracker_counter_snapshot)
+from hlsjs_p2p_wrapper_tpu.testing.tracker_oracle import OracleTracker
+
+
+def make_pair(clock, lease_ms=8_000.0, shards=4):
+    """Sharded + oracle stores on one clock, separate registries."""
+    r_sharded, r_oracle = MetricsRegistry(), MetricsRegistry()
+    sharded = Tracker(clock, lease_ms=lease_ms, registry=r_sharded,
+                      shards=shards)
+    oracle = OracleTracker(clock, lease_ms=lease_ms,
+                           registry=r_oracle)
+    return sharded, oracle, r_sharded, r_oracle
+
+
+@pytest.fixture
+def caps():
+    """Lower the deployment-tunable caps on BOTH store classes for
+    one test (they are class attributes, read at use time)."""
+    saved = {}
+
+    def set_caps(**kwargs):
+        for name, value in kwargs.items():
+            for cls in (Tracker, OracleTracker):
+                saved.setdefault((cls, name), getattr(cls, name))
+                setattr(cls, name, value)
+
+    yield set_caps
+    for (cls, name), value in saved.items():
+        setattr(cls, name, value)
+
+
+def assert_equivalent(spec, *, shards=4, lease_ms=8_000.0,
+                      check_members=True):
+    """The core contract: replay ``spec`` against both stores and
+    assert every observable surface matches, then drain and assert
+    the sharded store leaked nothing."""
+    clock = VirtualClock()
+    sharded, oracle, r_sharded, r_oracle = make_pair(
+        clock, lease_ms=lease_ms, shards=shards)
+    mismatches, stats = replay(churn_events(spec), [sharded, oracle],
+                               clock)
+    assert not mismatches, mismatches[:3]
+    assert stats["announces"] > 0
+    assert tracker_counter_snapshot(r_sharded) \
+        == tracker_counter_snapshot(r_oracle)
+    if check_members:
+        for i in range(spec.n_swarms):
+            assert sharded.members(swarm_name(i)) \
+                == oracle.members(swarm_name(i)), swarm_name(i)
+        # the members sweeps above must count identically too
+        assert tracker_counter_snapshot(r_sharded) \
+            == tracker_counter_snapshot(r_oracle)
+    sharded._assert_consistent()
+    drain([sharded, oracle], clock, spec)
+    assert tracker_counter_snapshot(r_sharded) \
+        == tracker_counter_snapshot(r_oracle)
+    assert sharded.lease_count() == 0
+    assert sharded._swarms == {} == oracle._swarms
+    sharded._assert_consistent()
+    return stats, r_sharded
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_randomized_churn_equivalence(seed):
+    """Joins, crashes, orderly leaves, re-announce jitter, a flash
+    crowd, shared-host quota pressure, and hostile squat/foreign ops
+    — every announce answer and every shared counter family must
+    match the seed store, op for op."""
+    spec = ChurnSpec(
+        n_swarms=13, target_leases=160, duration_ms=25_000.0,
+        ramp_ms=3_000.0, mean_session_ms=9_000.0,
+        announce_interval_ms=2_000.0, orderly_leave_fraction=0.5,
+        shared_host_fraction=0.4, shared_hosts=3,
+        hostile_fraction=0.15,
+        flash_crowds=(FlashCrowd(t_ms=8_000.0, swarm=2, peers=60,
+                                 session_ms=2_000.0),),
+        seed=seed)
+    assert_equivalent(spec)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_member_quota_pressure_equivalence(caps, seed):
+    """Tiny per-source member quota + a shared-host-heavy population:
+    the LRU self-eviction path fires constantly, including evictions
+    whose victims live on OTHER shards (the deferred-apply path) —
+    decisions must still match the seed exactly."""
+    caps(MAX_MEMBERS_PER_SOURCE=5)
+    spec = ChurnSpec(
+        n_swarms=11, target_leases=120, duration_ms=18_000.0,
+        mean_session_ms=30_000.0, announce_interval_ms=2_500.0,
+        shared_host_fraction=0.9, shared_hosts=4,
+        hostile_fraction=0.1, seed=100 + seed)
+    stats, r_sharded = assert_equivalent(spec)
+    evicted = sum(v for labels, v
+                  in r_sharded.series("tracker.shard_evictions"))
+    assert evicted > 0, "quota pressure never fired the LRU eviction"
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_cap_pressure_equivalence(caps, seed):
+    """At MAX_SWARMS / MAX_MEMBERS_PER_SWARM: refusals, forced
+    pre-refusal sweeps (global, across shards), and re-admission
+    after expiry must track the seed through heavy churn."""
+    caps(MAX_SWARMS=6, MAX_MEMBERS_PER_SWARM=8)
+    spec = ChurnSpec(
+        n_swarms=14, target_leases=140, duration_ms=15_000.0,
+        mean_session_ms=4_000.0, announce_interval_ms=1_500.0,
+        orderly_leave_fraction=0.3, seed=200 + seed)
+    stats, r_sharded = assert_equivalent(spec, lease_ms=3_000.0)
+    rejects = {labels["reason"]: v for labels, v
+               in r_sharded.series("tracker.announce_rejects")}
+    assert rejects.get("swarm_cap", 0) > 0
+    assert rejects.get("member_cap", 0) > 0
+
+
+def test_create_quota_equivalence(caps):
+    """Swarm-creation quota refusals (and their release when swarms
+    die) match the seed under a swarm-minting population."""
+    caps(MAX_SWARM_CREATES_PER_SOURCE=2)
+    spec = ChurnSpec(
+        n_swarms=24, target_leases=80, duration_ms=12_000.0,
+        mean_session_ms=5_000.0, announce_interval_ms=2_000.0,
+        shared_host_fraction=1.0, shared_hosts=3, seed=300)
+    stats, r_sharded = assert_equivalent(spec, lease_ms=4_000.0)
+    rejects = {labels["reason"]: v for labels, v
+               in r_sharded.series("tracker.announce_rejects")}
+    assert rejects.get("create_quota", 0) > 0
+
+
+def test_directed_reclaim_interleavings():
+    """The squat → reclaim → re-squat dance, replayed op-for-op on
+    both stores across shard-spanning swarms, with expiries landing
+    between every phase."""
+    clock = VirtualClock()
+    sharded, oracle, r_sharded, r_oracle = make_pair(
+        clock, lease_ms=1_000.0, shards=4)
+    stores = [sharded, oracle]
+    swarms = [swarm_name(i) for i in range(8)]
+    victims = [f"10.0.{i}.7:4000" for i in range(8)]
+
+    def step(op, *args, advance=0.0):
+        if advance:
+            clock.advance(advance)
+        return [getattr(s, op)(*args) for s in stores]
+
+    for sid, victim in zip(swarms, victims):
+        # squatter claims the victim's id first
+        a, b = step("announce", sid, victim, "203.0.113.9:1")
+        assert a == b
+        # the real peer reclaims (observed transport id == peer id)
+        a, b = step("announce", sid, victim, victim, advance=100.0)
+        assert a == b
+        # squatter tries to take it back — blocked
+        a, b = step("announce", sid, victim, "203.0.113.9:1",
+                    advance=100.0)
+        assert a == b
+    # let every reclaimed lease expire, then re-register each id from
+    # the attacker: post-expiry the charge goes to whoever announces
+    clock.advance(2_500.0)
+    for sid, victim in zip(swarms, victims):
+        a, b = step("announce", sid, victim, "203.0.113.9:1")
+        assert a == b
+        assert sharded._member_source[(sid, victim)] == "203.0.113.9"
+        assert oracle._member_source[(sid, victim)] == "203.0.113.9"
+    assert tracker_counter_snapshot(r_sharded) \
+        == tracker_counter_snapshot(r_oracle)
+    assert sharded.metrics.counter("tracker.lease_reclaims").value \
+        == len(swarms)
+    sharded._assert_consistent()

@@ -1,0 +1,452 @@
+"""The sharded slab store's own mechanics: slab reuse, the vectorized
+expiry wheel, per-shard telemetry, thread-safety under concurrent
+adapters, reclaim racing expiry across shards, inline TCP delivery,
+and the churn generator that loads all of it.
+
+(Observable EQUIVALENCE with the seed store is pinned separately by
+tests/test_tracker_oracle.py; this file covers what the oracle cannot
+see — internals, concurrency, and the new surfaces.)
+"""
+
+import threading
+
+from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+from hlsjs_p2p_wrapper_tpu.engine import protocol as P
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+from hlsjs_p2p_wrapper_tpu.engine.tracker import (Tracker,
+                                                  TrackerEndpoint,
+                                                  default_shards)
+from hlsjs_p2p_wrapper_tpu.engine.transport import LoopbackNetwork
+from hlsjs_p2p_wrapper_tpu.testing.churn import (ChurnSpec, FlashCrowd,
+                                                 OP_ANNOUNCE,
+                                                 churn_events,
+                                                 swarm_name)
+
+
+def make_tracker(clock, shards=4, **kwargs):
+    registry = MetricsRegistry()
+    return Tracker(clock, registry=registry, shards=shards,
+                   **kwargs), registry
+
+
+def series_map(registry, family):
+    return {tuple(sorted(labels.items())): value
+            for labels, value in registry.series(family)}
+
+
+# -- sharding & slab ----------------------------------------------------
+
+def test_swarms_spread_across_shards():
+    clock = VirtualClock()
+    tracker, registry = make_tracker(clock, shards=4)
+    for i in range(64):
+        tracker.announce(swarm_name(i), f"p{i}")
+    populated = sum(1 for shard in tracker._shards if shard.swarms)
+    assert populated >= 2, "crc32 sharding left everything on one shard"
+    # per-shard occupancy gauges sum to the live-lease count
+    occupancy = series_map(registry, "tracker.shard_members")
+    assert sum(occupancy.values()) == 64 == tracker.lease_count()
+    tracker._assert_consistent()
+
+
+def test_shard_count_pinnable_and_env(monkeypatch):
+    clock = VirtualClock()
+    assert Tracker(clock, shards=3)._n_shards == 3
+    monkeypatch.setenv("TRACKER_SHARDS", "5")
+    assert default_shards() == 5
+    assert Tracker(clock)._n_shards == 5
+    monkeypatch.delenv("TRACKER_SHARDS")
+    assert default_shards() >= 1
+
+
+def test_slab_slots_reused_after_leave_and_expiry():
+    """Join/leave churn must recycle slots through the free list, not
+    grow the slab watermark forever."""
+    clock = VirtualClock()
+    tracker, _ = make_tracker(clock, shards=1, lease_ms=1_000.0)
+    shard = tracker._shards[0]
+    for i in range(50):
+        tracker.announce("s", f"p{i}", source=f"10.0.0.{i}:1")
+    peak = shard.hi
+    for round_no in range(10):
+        for i in range(50):
+            tracker.leave("s", f"p{i}", source=f"10.0.0.{i}:1")
+        for i in range(50):
+            tracker.announce("s", f"p{i}", source=f"10.0.0.{i}:1")
+    assert shard.hi == peak, "leave/announce churn grew the slab"
+    # expiry recycles the same way
+    clock.advance(Tracker.EXPIRE_SWEEP_MS + 2_000.0)
+    assert tracker.members("s") == []
+    for i in range(50):
+        tracker.announce("s", f"p{i}", source=f"10.0.0.{i}:1")
+    assert shard.hi == peak
+    tracker._assert_consistent()
+
+
+def test_vectorized_sweep_at_scale():
+    """Thousands of leases across many swarms expire in ONE throttled
+    sweep — counted once each, every structure empty after, and the
+    wheel (min-deadline) lets clean shards skip scans."""
+    clock = VirtualClock()
+    tracker, registry = make_tracker(clock, shards=4,
+                                     lease_ms=2_000.0)
+    n = 5_000
+    for i in range(n):
+        tracker.announce(swarm_name(i % 97), f"p{i}",
+                         source=f"10.{i >> 8 & 255}.{i & 255}.9:1")
+    assert tracker.lease_count() == n
+    clock.advance(Tracker.EXPIRE_SWEEP_MS + 3_000.0)
+    tracker.announce("poke", "p")  # triggers the throttled sweep
+    expiries = registry.counter("tracker.lease_expiries").value
+    assert expiries == n
+    assert tracker.lease_count() == 1  # just the poke
+    assert list(tracker._swarms) == ["poke"]
+    sweeps_before = sum(series_map(registry,
+                                   "tracker.shard_sweeps").values())
+    # nothing near expiry → the wheel skips every shard's scan
+    clock.advance(Tracker.EXPIRE_SWEEP_MS + 1.0)
+    tracker.announce("poke", "p")
+    sweeps_after = sum(series_map(registry,
+                                  "tracker.shard_sweeps").values())
+    assert sweeps_after == sweeps_before, \
+        "min-deadline wheel failed to skip clean shards"
+    tracker._assert_consistent()
+
+
+def test_inline_touched_swarm_expiry_vectorizes():
+    """A swarm past VECTOR_EXPIRE_MIN members expires inline via the
+    gather path with identical results to the loop path."""
+    clock = VirtualClock()
+    tracker, _ = make_tracker(clock, shards=2, lease_ms=1_000.0)
+    big = Tracker.VECTOR_EXPIRE_MIN * 2
+    for i in range(big):
+        tracker.announce("s", f"p{i}")
+        clock.advance(1.0)  # staggered deadlines
+    # advance so the FIRST half expired but the sweep throttle has
+    # not fired since (touch the swarm directly)
+    clock.advance(1_000.0 - big + big // 2)
+    now = clock.now()
+    expected = [f"p{i}" for i in range(big)
+                if i + 1_000.0 > now]
+    alive = tracker.members("s")
+    assert alive == expected
+    assert 0 < len(alive) < big
+    tracker._assert_consistent()
+
+
+# -- concurrency --------------------------------------------------------
+
+def test_concurrent_announce_hammer():
+    """8 threads × announce/leave churn over shard-spanning swarms
+    with quota pressure: no exception may escape, and the final
+    structure must pass the full cross-invariant check and drain to
+    empty."""
+    clock = VirtualClock()
+    tracker, _ = make_tracker(clock, shards=4, lease_ms=60_000.0)
+    errors = []
+    n_threads, per_thread = 8, 400
+
+    def worker(tid):
+        try:
+            for i in range(per_thread):
+                sid = swarm_name((tid * 7 + i) % 23)
+                peer = f"10.0.{tid}.{i % 50}:4000"
+                tracker.announce(sid, peer, source=peer)
+                if i % 5 == 4:
+                    tracker.leave(sid, peer, source=peer)
+        except Exception as exc:  # noqa: BLE001 — re-raised below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    tracker._assert_consistent()
+    assert tracker.announce_count == n_threads * per_thread
+    # drain to zero: no leaked lease survives its horizon
+    clock.advance(61_000.0 + Tracker.EXPIRE_SWEEP_MS)
+    for i in range(23):
+        tracker.members(swarm_name(i))
+    assert tracker.lease_count() == 0
+    tracker._assert_consistent()
+
+
+def test_concurrent_quota_eviction_across_shards():
+    """Threads sharing ONE quota bucket churn memberships spread
+    across every shard, forcing constant cross-shard (deferred) LRU
+    evictions — the store must stay consistent and the bucket at its
+    cap."""
+    clock = VirtualClock()
+    orig = Tracker.MAX_MEMBERS_PER_SOURCE
+    Tracker.MAX_MEMBERS_PER_SOURCE = 16
+    try:
+        tracker, registry = make_tracker(clock, shards=4,
+                                         lease_ms=60_000.0)
+        errors = []
+
+        def worker(tid):
+            try:
+                for i in range(300):
+                    sid = swarm_name((tid + i) % 31)
+                    # all threads announce from ONE host (one bucket)
+                    tracker.announce(sid, f"p{tid}-{i}",
+                                     source="10.9.9.9:400" + str(tid))
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        tracker._assert_consistent()
+        bucket = tracker._members_by_source.get("10.9.9.9", {})
+        assert len(bucket) == 16
+        assert tracker.lease_count() == 16
+        evictions = sum(series_map(
+            registry, "tracker.shard_evictions").values())
+        assert evictions == 6 * 300 - 16
+    finally:
+        Tracker.MAX_MEMBERS_PER_SOURCE = orig
+
+
+def test_swarm_cap_holds_under_concurrent_creation():
+    """MAX_SWARMS is a hard GLOBAL ceiling even under concurrent
+    creators on different shards: creation inserts under the quota
+    lock with an atomic cap re-check, so racing inline-delivery
+    threads can never overshoot the documented bound on
+    attacker-mintable state."""
+    clock = VirtualClock()
+    orig = Tracker.MAX_SWARMS
+    Tracker.MAX_SWARMS = 16
+    try:
+        tracker, _ = make_tracker(clock, shards=4,
+                                  lease_ms=60_000.0)
+        errors = []
+
+        def creator(tid):
+            try:
+                for i in range(60):
+                    tracker.announce(swarm_name(tid * 100 + i),
+                                     f"p{tid}")
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=creator, args=(t,))
+                   for t in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        live = sum(len(shard.swarms) for shard in tracker._shards)
+        assert live <= 16, f"swarm cap overshot: {live} live swarms"
+        assert live == 16  # the cap was actually reached, not avoided
+        tracker._assert_consistent()
+    finally:
+        Tracker.MAX_SWARMS = orig
+
+
+def test_reclaim_racing_expiry_across_shards():
+    """SECURITY.md residual check, directed: a reclaim announce
+    arriving exactly as the squatted lease expires (and while sweeps
+    run from OTHER shards' announces) must end with the membership
+    attributed to its rightful owner — whichever side of the expiry
+    the reclaim lands on — and no structure leaked."""
+    clock = VirtualClock()
+    tracker, _ = make_tracker(clock, shards=4, lease_ms=1_000.0)
+    victim = "10.0.7.7:4000"
+    # serial boundary cases first: reclaim in the same ms the lease
+    # expires (expiry wins — the announce is a fresh registration,
+    # charged to the owner, NOT counted as a reclaim)...
+    tracker.announce("sA", victim, source="203.0.113.9:1")
+    clock.advance(1_000.0)
+    tracker.announce("sA", victim, source=victim)
+    assert tracker._member_source[("sA", victim)] == "10.0.7.7"
+    assert tracker.metrics.counter("tracker.lease_reclaims").value == 0
+    # ...and one ms BEFORE expiry (squat still live — counted reclaim)
+    tracker.announce("sB", victim, source="203.0.113.9:1")
+    clock.advance(999.0)
+    tracker.announce("sB", victim, source=victim)
+    assert tracker._member_source[("sB", victim)] == "10.0.7.7"
+    assert tracker.metrics.counter("tracker.lease_reclaims").value == 1
+    tracker._assert_consistent()
+
+    # threaded: reclaims racing sweeps triggered from other shards
+    errors = []
+    swarms = [swarm_name(i) for i in range(16)]
+    for sid in swarms:
+        tracker.announce(sid, victim, source="203.0.113.9:1")
+    clock.advance(999.5)  # every squat is a hair from expiry
+
+    def reclaimer():
+        try:
+            for sid in swarms:
+                tracker.announce(sid, victim, source=victim)
+        except Exception as exc:  # noqa: BLE001 — re-raised below
+            errors.append(exc)
+
+    def sweeper(tid):
+        try:
+            for i in range(50):
+                tracker.announce(swarm_name(64 + tid * 50 + i),
+                                 f"s{tid}-{i}")
+        except Exception as exc:  # noqa: BLE001 — re-raised below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=reclaimer)] + [
+        threading.Thread(target=sweeper, args=(t,)) for t in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for sid in swarms:
+        assert tracker._member_source[(sid, victim)] == "10.0.7.7", \
+            f"reclaim lost to the race in {sid}"
+    tracker._assert_consistent()
+
+
+# -- transport adapters -------------------------------------------------
+
+def test_decode_reject_counter_on_malformed_frames():
+    """The adapter's reject path is counted, not just dropped —
+    malformed bytes and well-framed garbage both bump
+    ``tracker.decode_rejects`` and never crash the service."""
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=5.0)
+    tracker, registry = make_tracker(clock)
+    TrackerEndpoint(tracker, net.register("tracker"))
+    evil = net.register("evil")
+    evil.send("tracker", b"\xff\xff\xff\xff")
+    evil.send("tracker", P._frame(P.MsgType.ANNOUNCE,
+                                  b"\x01\x00s" + b"\x02\x00\xff\xfe"))
+    evil.send("tracker", P._frame(0x7F, b""))
+    clock.advance(50.0)
+    assert registry.counter("tracker.decode_rejects").value == 3
+    tracker.announce("s", "p1")
+    assert tracker.members("s") == ["p1"]
+
+
+def test_tcp_inline_delivery_concurrent_announces():
+    """``TrackerEndpoint(concurrent=True)`` on the TCP fabric: frames
+    are handled on reader threads (``deliver_inline``), concurrent
+    announcers all get PEERS answers, and the store registers every
+    lease."""
+    from hlsjs_p2p_wrapper_tpu.core.clock import SystemClock
+    from hlsjs_p2p_wrapper_tpu.engine.net import TcpNetwork
+    from hlsjs_p2p_wrapper_tpu.testing.fixtures import wait_for
+
+    network = TcpNetwork()
+    clock = SystemClock()
+    try:
+        tracker, _ = make_tracker(clock, shards=4)
+        service = network.register()
+        endpoint_adapter = TrackerEndpoint(tracker, service,
+                                           concurrent=True)
+        assert service.deliver_inline is True
+        replies = {}
+        clients = []
+        for i in range(4):
+            client = network.register()
+
+            def on_receive(src, frame, idx=i):
+                msg = P.decode(frame)
+                if isinstance(msg, P.Peers):
+                    replies[idx] = msg.peer_ids
+
+            client.on_receive = on_receive
+            clients.append(client)
+        for i, client in enumerate(clients):
+            client.send(service.peer_id, P.encode(
+                P.Announce("swarm", client.peer_id)))
+        wait_for(lambda: len(replies) == 4, timeout_s=5.0)
+        assert len(tracker.members("swarm")) == 4
+        assert endpoint_adapter.tracker is tracker
+        tracker._assert_consistent()
+    finally:
+        network.close()
+
+
+# -- the churn generator ------------------------------------------------
+
+def test_churn_events_deterministic_and_sorted():
+    spec = ChurnSpec(n_swarms=7, target_leases=50,
+                     duration_ms=8_000.0, mean_session_ms=3_000.0,
+                     announce_interval_ms=1_000.0,
+                     hostile_fraction=0.2, shared_host_fraction=0.3,
+                     shared_hosts=2, seed=42)
+    a = list(churn_events(spec))
+    b = list(churn_events(spec))
+    assert a == b, "same spec+seed must reproduce the same stream"
+    assert a, "empty op stream"
+    times = [op.t_ms for op in a]
+    assert times == sorted(times), "events must be time-ordered"
+    assert any(op.op == "leave" for op in a)
+    c = list(churn_events(ChurnSpec(n_swarms=7, target_leases=50,
+                                    duration_ms=8_000.0, seed=43)))
+    assert a != c, "different seeds should differ"
+
+
+def test_churn_flash_crowd_lands_in_its_swarm():
+    crowd = FlashCrowd(t_ms=2_000.0, swarm=3, peers=40,
+                       window_ms=200.0, session_ms=1_000.0)
+    spec = ChurnSpec(n_swarms=5, target_leases=10,
+                     duration_ms=5_000.0, flash_crowds=(crowd,),
+                     seed=7)
+    ops = [op for op in churn_events(spec)
+           if op.op == OP_ANNOUNCE and op.swarm_id == swarm_name(3)
+           and crowd.t_ms <= op.t_ms <= crowd.t_ms + crowd.window_ms]
+    assert len(ops) >= 40, "flash crowd did not burst into its swarm"
+
+
+# -- fleet console panel ------------------------------------------------
+
+def test_fleet_console_tracker_panel():
+    """Tracker counter events in a host's shard surface as the
+    console's control-plane panel lines."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import fleet_console
+
+    events = [
+        {"host": "host00", "t": 10.0, "kind": "counter",
+         "name": "tracker.announces", "labels": "", "n": 12},
+        {"host": "host00", "t": 11.0, "kind": "counter",
+         "name": "tracker.announce_rejects",
+         "labels": "reason=member_cap", "n": 2},
+        {"host": "host00", "t": 12.0, "kind": "counter",
+         "name": "tracker.shard_sweeps", "labels": "shard=1", "n": 3},
+        {"host": "host00", "t": 13.0, "kind": "counter",
+         "name": "tracker.lease_expiries", "labels": "", "n": 5},
+        {"host": "host01", "t": 14.0, "kind": "row", "key": "k"},
+    ]
+    hosts = fleet_console.host_activity(events, now=20.0)
+    assert hosts["host00"]["tracker"]["announces"] == 12
+    assert hosts["host00"]["tracker"]["announce_rejects"] == 2
+    assert hosts["host01"]["tracker"] == {}
+    # render path: the panel shows up when tracker counters exist
+    frame_lines = []
+    units = {}  # no fabric dir — exercise the trace side only
+
+    import tempfile
+    import json
+    with tempfile.TemporaryDirectory() as td:
+        shard = os.path.join(td, "host00.jsonl")
+        with open(shard, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps({"kind": "meta", "run_id": "r",
+                                 "host": "host00"}) + "\n")
+            for e in events[:4]:
+                fh.write(json.dumps({"seq": 1, **e}) + "\n")
+        frame = fleet_console.render_frame(trace_dir=td, now=20.0)
+    assert "tracker control plane" in frame
+    assert "announces 12" in frame
+    assert "sweeps 3" in frame
+    assert units == {} and frame_lines == []  # silence linters

@@ -102,7 +102,8 @@ def host_activity(events, now):
         host = hosts.setdefault(event.get("host", "?"), {
             "rows": 0, "recent_rows": [], "retries": 0,
             "bisections": 0, "giveups": 0, "cache_hits": 0,
-            "cache_misses": 0, "leases": 0, "last_t": 0.0})
+            "cache_misses": 0, "leases": 0, "last_t": 0.0,
+            "tracker": {}})
         host["last_t"] = max(host["last_t"], event.get("t", 0.0))
         kind = event.get("kind")
         if kind == "row":
@@ -125,6 +126,14 @@ def host_activity(events, now):
                     host["cache_hits"] += n
                 elif "layer=row,result=miss" in labels:
                     host["cache_misses"] += n
+            elif str(event.get("name", "")).startswith("tracker."):
+                # control-plane panel (round 9): a host running a
+                # tracker with the flight recorder attached to its
+                # registry exports every lease decision as counter
+                # events — aggregate by family, labels folded
+                family = event["name"][len("tracker."):]
+                trk = host["tracker"]
+                trk[family] = trk.get(family, 0) + n
     for host in hosts.values():
         recent = [t for t in host.pop("recent_rows")
                   if t >= now - RATE_WINDOW_S]
@@ -189,6 +198,19 @@ def render_frame(fabric_dir=None, trace_dir=None, now=None) -> str:
                     f"{h['rows_per_s']:>7} {h['retries']:>6} "
                     f"{h['bisections']:>6} {h['giveups']:>6} "
                     f"{hit:>6} {h['age_s']:>8.1f}s")
+            tracked = {name: h["tracker"]
+                       for name, h in hosts.items() if h["tracker"]}
+            if tracked:
+                lines.append("  tracker control plane:")
+                for name in sorted(tracked):
+                    t = tracked[name]
+                    lines.append(
+                        f"    {name}: announces "
+                        f"{t.get('announces', 0)}, rejects "
+                        f"{t.get('announce_rejects', 0)}, expiries "
+                        f"{t.get('lease_expiries', 0)}, reclaims "
+                        f"{t.get('lease_reclaims', 0)}, sweeps "
+                        f"{t.get('shard_sweeps', 0)}")
         else:
             lines.append(f"trace {trace_dir}: no event shards yet")
     if not lines:

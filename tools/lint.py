@@ -31,11 +31,11 @@ Five repo-specific rules:
   fault invisibly.  (Bare ``except:`` stays banned outright,
   everywhere.)
 - no naked ``time.time()`` / ``time.sleep()`` calls in the fabric
-  work ledger or the dispatch path (``CLOCK_FILES``): lease expiry
-  and retry backoff must route through the injectable clock/sleep
-  callables (the ``FaultPolicy`` convention) or their tests need
-  real waits and start flaking; ``# clock-ok: <why>`` is the
-  escape.
+  work ledger, the dispatch path, or the tracker/mesh control plane
+  (``CLOCK_FILES``): lease expiry and retry backoff must route
+  through the injectable clock/sleep callables (the ``FaultPolicy``
+  convention) or their tests need real waits and start flaking;
+  ``# clock-ok: <why>`` is the escape.
 - any ``jnp.roll`` whose operand is the bit-packed ``[P, W]``
   availability map inside ``ops/swarm_sim.py`` must carry an inline
   ``# traffic-ok: <why>`` justification: the one-pass eligibility
@@ -276,6 +276,12 @@ CLOCK_FILES = (
     os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "fabric.py"),
     os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "faults.py"),
     os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "tracer.py"),
+    # the control plane (round 9): lease deadlines, expiry wheels,
+    # and re-announce cadence are exactly the arithmetic the oracle
+    # equivalence suite and the churn harness pin with VirtualClock —
+    # one naked wall-clock read and tracker_gate needs real waits
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "tracker.py"),
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "mesh.py"),
     os.path.join("hlsjs_p2p_wrapper_tpu", "ops", "swarm_sim.py"),
 )
 
