@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
 	coverage soak scaling-artifact warmstart-gate chaos-gate \
-	fleet-gate trace-gate tracker-gate
+	fleet-gate trace-gate tracker-gate net-chaos-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -117,6 +117,20 @@ trace-gate:
 tracker-gate:
 	$(PY) tools/tracker_gate.py
 
+# socket-level chaos proof for the self-healing TCP transport
+# (engine/net.py ReconnectPolicy + engine/netfaults.py): a real-TCP
+# PSK swarm (agents + concurrent tracker) under a scripted fault
+# schedule covering connect refusal, handshake stall, mid-frame RST,
+# partial-write wedge, frame corruption, and latency/blackhole
+# windows — every injected fault class must map to ≥1 counted
+# recovery action (reconnect / probe / circuit / MAC-drop), every
+# foreground fetch must complete with the swarm still offloading,
+# threads/fds/PeerStates must return to baseline after close, and
+# two same-seed runs must fire identical schedules and counter
+# families.  NET_CHAOS_GATE_SEED / _SEGMENTS / _BYTES resize it.
+net-chaos-gate:
+	$(PY) tools/net_chaos_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -126,6 +140,6 @@ examples:
 	$(PY) examples/production_demo.py
 
 check: lint test dryrun warmstart-gate chaos-gate fleet-gate \
-	trace-gate tracker-gate
+	trace-gate tracker-gate net-chaos-gate
 
 all: check bench

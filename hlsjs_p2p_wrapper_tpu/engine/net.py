@@ -89,6 +89,8 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..core.clock import TimerHandle
+from .faults import FaultPolicy
+from .netfaults import FaultSocket
 from .telemetry import MetricsRegistry
 
 log = logging.getLogger(__name__)
@@ -173,7 +175,7 @@ def _tls_wrap(sock: socket.socket, ctx, deadline: float, *,
         with selectors.DefaultSelector() as sel:
             key = sel.register(tls, selectors.EVENT_READ)
             while True:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - time.monotonic()  # clock-ok: TLS handshake socket deadline
                 if remaining <= 0:
                     raise OSError("TLS handshake deadline exceeded")
                 try:
@@ -245,12 +247,12 @@ class _SafeTls:
 
     def recv(self, n: int) -> bytes:
         import ssl
-        deadline = (time.monotonic() + self._timeout
+        deadline = (time.monotonic() + self._timeout  # clock-ok: socket deadline
                     if self._timeout is not None else None)
         while True:
             if self._closed:
                 raise OSError("TLS connection closed")
-            if deadline is not None and time.monotonic() >= deadline:
+            if deadline is not None and time.monotonic() >= deadline:  # clock-ok: socket deadline
                 raise socket.timeout("timed out")  # OSError: caller drops
             with self._lock:
                 try:
@@ -266,12 +268,12 @@ class _SafeTls:
     def sendall(self, data: bytes) -> None:
         import ssl
         view = memoryview(data)
-        deadline = (time.monotonic() + self._timeout
+        deadline = (time.monotonic() + self._timeout  # clock-ok: socket deadline
                     if self._timeout is not None else None)
         while view.nbytes:
             if self._closed:
                 raise OSError("TLS connection closed")
-            if deadline is not None and time.monotonic() >= deadline:
+            if deadline is not None and time.monotonic() >= deadline:  # clock-ok: socket deadline
                 raise socket.timeout("timed out")  # OSError: caller drops
             want_write = True
             with self._lock:
@@ -328,7 +330,7 @@ class NetLoop:
 
     # -- Clock protocol ------------------------------------------------
     def now(self) -> float:
-        return time.monotonic() * 1000.0
+        return time.monotonic() * 1000.0  # clock-ok: NetLoop IS the wall clock
 
     def call_later(self, delay_ms: float, fn: Callable[[], None]) -> TimerHandle:
         handle = TimerHandle()
@@ -379,13 +381,149 @@ class NetLoop:
             self._cond.notify()
 
 
-class _Connection:
-    """One TCP link, reused for both directions.
+class ReconnectPolicy:
+    """Self-healing knobs for the TCP fabric (round 10): how a dead
+    link is re-dialed, when a remote is circuit-broken, and how a
+    half-open link is detected.
 
-    Writes never block the caller: frames go onto a per-connection
-    queue drained by a writer thread, which also performs the
-    (blocking) connect + preamble for outbound links — the NetLoop
-    dispatcher must never stall on socket I/O."""
+    The backoff is the dispatch plane's machinery REUSED verbatim — a
+    :class:`~.faults.FaultPolicy` provides the bounded
+    jittered-exponential schedule with its injectable ``sleep`` and
+    ``seed``, so reconnect tests pin the exact delays the same way the
+    chaos gate pins dispatch retries.  ``clock`` (seconds, monotonic
+    by default) drives the CIRCUIT COOLDOWN arithmetic — tests
+    inject a fake to step a breaker through open → half-open without
+    waiting.  (The idle probe deliberately stays on wall monotonic
+    time: a stuck ``sendall`` is wall-clock evidence, and its test
+    drives the deadline by backdating ``_send_started``.)
+
+    - ``max_retries``: dial attempts per (re)connect cycle beyond the
+      first, each separated by the jittered backoff;
+    - ``circuit_threshold`` consecutive no-progress failures against
+      one remote open its breaker for ``circuit_cooldown_s`` — sends
+      during the cooldown drop immediately
+      (``net.send_drops{reason=circuit_open}``), never a hot retry
+      loop; the first dial after the cooldown is a half-open probe;
+    - ``idle_probe_s``: a send stuck in flight this long declares the
+      link half-open and tears it down for a fresh dial (the
+      full-socket-buffer wedge TCP itself never reports; quieter
+      forms of peer death stay the mesh reap's and the protocol
+      timeouts' job)."""
+
+    def __init__(self, *, max_retries: int = 3,
+                 backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 2.0, jitter: float = 0.5,
+                 seed: int = 0, sleep=time.sleep,
+                 clock=time.monotonic,
+                 circuit_threshold: int = 4,
+                 circuit_cooldown_s: float = 15.0,
+                 idle_probe_s: float = 30.0):
+        if circuit_threshold < 1:
+            raise ValueError("circuit_threshold must be >= 1")
+        if idle_probe_s <= 0.0:
+            raise ValueError("idle_probe_s must be positive")
+        self._backoff = FaultPolicy(max_retries=max_retries,
+                                    backoff_base_s=backoff_base_s,
+                                    backoff_cap_s=backoff_cap_s,
+                                    jitter=jitter, seed=seed,
+                                    sleep=sleep)
+        self.max_retries = max_retries
+        self.circuit_threshold = circuit_threshold
+        self.circuit_cooldown_s = circuit_cooldown_s
+        self.idle_probe_s = idle_probe_s
+        self.clock = clock
+
+    def backoff_s(self, attempt: int) -> float:
+        return self._backoff.backoff_s(attempt)
+
+    def sleep_backoff(self, attempt: int) -> float:
+        return self._backoff.sleep_backoff(attempt)
+
+
+class _Circuit:
+    """Per-remote circuit breaker: ``closed`` → (threshold
+    consecutive no-progress failures) → ``open`` for the cooldown →
+    one ``half_open`` probe dial → ``closed`` on progress, back to
+    ``open`` on failure.  State transitions are returned to the
+    caller so the endpoint counts them exactly once
+    (``net.circuit{state=...}``)."""
+
+    __slots__ = ("_lock", "failures", "state", "open_until")
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.failures = 0
+        self.state = self.CLOSED
+        self.open_until = 0.0
+
+    def blocked(self, now: float) -> bool:
+        """Sends must not mint fresh connections while cooling."""
+        with self._lock:
+            return self.state == self.OPEN and now < self.open_until
+
+    def allow_attempt(self, now: float):
+        """May a dial start?  ``(allowed, transition)`` — transition
+        is ``"half_open"`` when this dial is the cooldown's single
+        probe."""
+        with self._lock:
+            if self.state != self.OPEN:
+                return True, None
+            if now < self.open_until:
+                return False, None
+            self.state = self.HALF_OPEN
+            return True, self.HALF_OPEN
+
+    def record_failure(self, now: float, policy: ReconnectPolicy):
+        """A dial failed, or a link died with zero inbound progress;
+        returns ``"open"`` when this trips (or re-trips) the
+        breaker."""
+        with self._lock:
+            self.failures += 1
+            if (self.state == self.HALF_OPEN
+                    or (self.state == self.CLOSED
+                        and self.failures
+                        >= policy.circuit_threshold)):
+                self.state = self.OPEN
+                self.open_until = now + policy.circuit_cooldown_s
+                return self.OPEN
+            return None
+
+    def record_success(self):
+        """Inbound progress on a live link; returns ``"closed"`` when
+        this transition re-closes a tripped breaker."""
+        with self._lock:
+            was = self.state
+            self.state = self.CLOSED
+            self.failures = 0
+            return self.CLOSED if was != self.CLOSED else None
+
+
+class _Connection:
+    """One TCP link, reused for both directions — and, under the
+    network's :class:`ReconnectPolicy`, SELF-HEALING: a link that dies
+    with frames still queued (or that the idle probe declares
+    half-open) is re-dialed by its own writer thread with bounded
+    jittered backoff, redoing the FULL preamble + PSK handshake (fresh
+    nonces, fresh frame keys, sequence numbers from zero — no
+    resumption shortcut).  A link that dies idle with an empty queue
+    closes exactly as before: the next send mints a fresh connection.
+
+    Writes never block the caller: frames go onto a bounded
+    per-connection queue drained by a writer thread, which also
+    performs the (blocking) connect + preamble for outbound links —
+    the NetLoop dispatcher must never stall on socket I/O.  Frames
+    dropped anywhere (full queue, dead endpoint, give-up after the
+    retry budget, circuit cooldown) are counted
+    (``net.send_drops{reason}``) — no silent ``False`` paths.  The
+    frame being written when a link dies stays queued (the writer
+    PEEKS, popping only after ``sendall`` returns), so a mid-frame
+    RST re-sends it on the healed link; receivers may therefore see a
+    duplicate, which the protocol layer already tolerates (stray
+    CHUNK/REQUEST handling)."""
 
     MAX_QUEUED_FRAMES = 4096
 
@@ -416,7 +554,6 @@ class _Connection:
         self.send_key: Optional[bytes] = None
         self.recv_key: Optional[bytes] = None
         self._send_seq = 0
-        self._recv_seq = 0
         self.closed = False
         self._queue: list = []
         self._queued_bytes = 0   # enqueued but not yet handed to the OS
@@ -429,7 +566,18 @@ class _Connection:
         #: whose worst-case staleness is one store, and eviction
         #: already tolerates minutes of slack — unlike the
         #: queue-state fields, no invariant hangs off it
-        self.last_activity = time.monotonic()
+        self.last_activity = time.monotonic()  # clock-ok: eviction hint, wall time by contract
+        # self-healing state (ReconnectPolicy): why the current link
+        # died (labels net.reconnects) and whether this link session
+        # has seen inbound progress (circuit accounting)
+        self._down_reason: Optional[str] = None
+        self._progressed = False
+        #: may the writer dial when it finds sock None?  True for the
+        #: initial outbound dial; _link_down sets it to its redial
+        #: decision UNDER _cond — the writer must never observe
+        #: "sock gone" without also observing whether healing was
+        #: sanctioned, or it races close() into a spurious redial
+        self._heal_pending = sock is None
         self._cond = threading.Condition()
         self._writer = threading.Thread(target=self._write_loop, daemon=True,
                                         name=f"p2p-writer-{remote_id}")
@@ -449,13 +597,18 @@ class _Connection:
 
     def enqueue(self, frame: bytes) -> bool:
         with self._cond:
-            if self.closed or len(self._queue) >= self.MAX_QUEUED_FRAMES:
-                return False
-            self.last_activity = time.monotonic()
-            self._queue.append(frame)
-            self._queued_bytes += len(frame)
-            self._cond.notify()
-            return True
+            if self.closed:
+                dropped = "closed"
+            elif len(self._queue) >= self.MAX_QUEUED_FRAMES:
+                dropped = "queue_full"
+            else:
+                self.last_activity = time.monotonic()  # clock-ok: eviction hint
+                self._queue.append(frame)
+                self._queued_bytes += len(frame)
+                self._cond.notify()
+                return True
+        self.endpoint._count("send_drops", dropped)
+        return False
 
     def backlog_ms(self) -> float:
         """Estimated time for the unsent queue to drain, from the
@@ -475,7 +628,7 @@ class _Connection:
             queued = self._queued_bytes
             started = self._send_started
             drain_bps = self._drain_bps
-        stall_ms = ((time.monotonic() - started) * 1000.0
+        stall_ms = ((time.monotonic() - started) * 1000.0  # clock-ok: socket deadline
                     if started is not None else 0.0)
         if queued <= 0:
             return stall_ms
@@ -483,55 +636,69 @@ class _Connection:
         return max(queued * 8.0 / rate * 1000.0, stall_ms)
 
     def _write_loop(self) -> None:
-        if self.sock is None:
-            sock = self._connect_with_preamble()
-            if sock is None:
-                self.close()
-                return
-            with self._cond:
-                # close() may have raced the connect: it saw sock=None
-                # and closed nothing, so this thread owns the cleanup
-                if self.closed:
-                    closed_during_connect = True
-                else:
-                    closed_during_connect = False
-                    self.sock = sock
-            if closed_during_connect:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                return
-            threading.Thread(target=self.endpoint._reader_loop, args=(self,),
-                             daemon=True).start()
         while True:
+            dial = False
             with self._cond:
-                while not self._queue and not self.closed:
+                if self.closed:
+                    return
+                sock = self.sock
+                if sock is None:
+                    if not self._heal_pending:
+                        # teardown landing: close() is about to set
+                        # closed (its notify frees this wait) — do
+                        # NOT slip a dial in between
+                        self._cond.wait()
+                        continue
+                    dial = True
+            if dial:
+                # initial dial, or a sanctioned redial — the
+                # backoff/circuit loop owns give-up and close
+                if not self._establish():
+                    return
+                continue
+            with self._cond:
+                while not self._queue and not self.closed \
+                        and self.sock is sock:
                     self._cond.wait()
                 if self.closed:
                     return
-                frame = self._queue.pop(0)
-                self._send_started = time.monotonic()
-            try:
-                t0 = self._send_started
-                if self.send_key is not None:
-                    tag = _frame_tag(self.send_key, self._send_seq, frame)
+                if self.sock is not sock:
+                    continue  # link died (or healed) under the wait
+                # PEEK, don't pop: a frame the link dies under stays
+                # queued and re-sends on the healed link.  The MAC
+                # key + sequence are snapshotted UNDER the same lock
+                # _link_down nulls them under — reading them after
+                # release could deref a mid-teardown None (or send an
+                # untagged frame on an authenticated link)
+                frame = self._queue[0]
+                send_key = self.send_key
+                send_seq = self._send_seq
+                if send_key is not None:
                     self._send_seq += 1
+                t0 = time.monotonic()  # clock-ok: stall-floor timebase
+                self._send_started = t0
+            try:
+                if send_key is not None:
+                    tag = _frame_tag(send_key, send_seq, frame)
                     # single-copy join: frame + tag then prefix + wire
                     # would memcpy a 64 MiB chunk twice
                     wire = b"".join((_LEN.pack(len(frame) + len(tag)),
                                      frame, tag))
                 else:
                     wire = _LEN.pack(len(frame)) + frame
-                self.sock.sendall(wire)
-                elapsed = time.monotonic() - t0
+                sock.sendall(wire)
+                elapsed = time.monotonic() - t0  # clock-ok: EWMA measurement
                 self.endpoint.bytes_sent += len(frame)
             except OSError:
-                self.close()
-                return
+                with self._cond:
+                    self._send_started = None
+                self._link_down("send_error", sock)
+                continue
             with self._cond:
                 self._send_started = None
-                self._queued_bytes -= len(frame)
+                if self._queue and self._queue[0] is frame:
+                    self._queue.pop(0)
+                    self._queued_bytes -= len(frame)
                 # EWMA update under the same lock as the other
                 # queue-state fields: backlog_ms() reads it from the
                 # dispatcher thread, and one consistent concurrency
@@ -542,15 +709,182 @@ class _Connection:
                                        else 0.8 * self._drain_bps
                                        + 0.2 * inst_bps)
 
+    def _establish(self) -> bool:
+        """Dial (or re-dial) under bounded jittered backoff and the
+        per-remote circuit breaker.  Returns True with the socket
+        installed, MAC state reset, and a reader spawned; False after
+        closing the connection (give-up / circuit open / endpoint
+        closed).  Every retry and every redial is counted
+        (``net.reconnects{reason}``)."""
+        endpoint = self.endpoint
+        heal = endpoint._heal
+        reason = self._down_reason or "connect"
+        redialing = self._down_reason is not None
+        attempt = 0
+        while True:
+            with self._cond:
+                if self.closed:
+                    return False
+            circuit = endpoint._circuit_for(self.remote_id)
+            if circuit is not None:
+                allowed, probe = circuit.allow_attempt(endpoint._hclock())
+                if not allowed:
+                    self.close(drop_reason="circuit_open")
+                    return False
+                if probe is not None:
+                    endpoint._count("circuit", "half_open")
+            if redialing or attempt > 0:
+                endpoint._count("reconnects", reason)
+                endpoint._trace("reconnect", remote=self.remote_id,
+                                reason=reason, attempt=attempt)
+            sock = self._connect_with_preamble()
+            if sock is not None:
+                with self._cond:
+                    installed = not self.closed
+                    if installed:
+                        self.sock = sock
+                        self._heal_pending = False
+                        # whatever its origin, the link is now one WE
+                        # dialed — probe-healing is ours from here
+                        self._inbound = False
+                        self._send_seq = 0
+                        self._down_reason = None
+                        self._progressed = False
+                if not installed:
+                    # close() raced the dial; this thread owns cleanup
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    return False
+                # the reader gets ITS link's socket + key at spawn
+                # time: capturing conn.sock when the thread body runs
+                # would let a stale reader grab a newer link's socket
+                # after a fast die-and-heal cycle (two readers on one
+                # socket steal bytes from each other)
+                threading.Thread(target=endpoint._reader_loop,
+                                 args=(self, sock, self.recv_key),
+                                 daemon=True).start()
+                if redialing or attempt > 0:
+                    endpoint._notify_reconnect(self.remote_id)
+                return True
+            if circuit is not None and heal is not None:
+                tripped = circuit.record_failure(endpoint._hclock(), heal)
+                if tripped is not None:
+                    endpoint._count("circuit", "open")
+                    endpoint._trace("circuit_open", remote=self.remote_id)
+                    self.close(drop_reason="circuit_open")
+                    return False
+            attempt += 1
+            if heal is None or attempt > heal.max_retries:
+                self.close(drop_reason="giveup")
+                return False
+            heal.sleep_backoff(attempt - 1)
+
+    def _link_down(self, reason: str, sock) -> None:
+        """A live link failed (reader EOF/error, writer send error,
+        MAC verification, idle probe): tear the socket, keep the
+        connection for a writer-thread redial when healing applies —
+        frames still queued, or a probe tore a half-open link —
+        otherwise close outright (the pre-heal behavior, so an idle
+        remote departure never spawns dial churn)."""
+        heal = self.endpoint._heal
+        # circuit handle fetched BEFORE _cond (lock order: _conn_lock
+        # is never taken inside a connection's _cond)
+        circuit = (self.endpoint._circuit_for(self.remote_id)
+                   if heal is not None else None)
+        tripped = None
+        with self._cond:
+            if self.closed or sock is None or self.sock is not sock:
+                return  # stale report from an already-replaced link
+            self.sock = None
+            self._down_reason = reason
+            self.send_key = self.recv_key = None
+            # redial when frames are queued, or when the probe tore a
+            # half-open link WE dialed — an inbound link's remote owns
+            # healing it (and a tracker-style protected id could never
+            # redial inbound anyway: reject_inbound_ids)
+            redial = heal is not None and (bool(self._queue)
+                                           or (reason == "probe"
+                                               and not self._inbound))
+            if circuit is not None and not self._progressed:
+                # a session that never received anything counts
+                # against the breaker (a progressed one reset it on
+                # its first frame); a trip vetoes the redial
+                tripped = circuit.record_failure(
+                    self.endpoint._hclock(), heal)
+                if tripped is not None:
+                    redial = False
+            # the decision and the torn sock become visible to the
+            # writer TOGETHER — deciding after notify would race the
+            # parked writer into a spurious dial before close() lands
+            self._heal_pending = redial
+            self._cond.notify_all()
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if tripped is not None:
+            self.endpoint._count("circuit", "open")
+            self.endpoint._trace("circuit_open", remote=self.remote_id)
+        if not redial:
+            self.close("circuit_open" if tripped is not None
+                       else "closed")
+
+    def _mark_progress(self) -> None:
+        """Reader-side: a frame arrived on this link session —
+        re-close a tripped circuit on first progress."""
+        if not self._progressed:
+            self._progressed = True
+            circuit = self.endpoint._circuit_for(self.remote_id)
+            if circuit is not None and circuit.record_success() \
+                    is not None:
+                self.endpoint._count("circuit", "closed")
+
+    def probe(self, probe_s: float) -> None:
+        """Half-open detection (endpoint maintenance timer): a send
+        stuck IN FLIGHT past the probe deadline tears the link for a
+        fresh dial — the blackholed-peer shape where ``sendall``
+        blocks forever once the socket buffer fills and TCP itself
+        never reports an error.  Deliberately NOT a send-without-
+        reply heuristic: one-way push links (a seeder broadcasting
+        HAVEs to a quiet neighbor) are legitimate, and tearing them
+        on a reply deadline would re-handshake every healthy such
+        link once per probe window; a dead-but-unfilled pipe is the
+        mesh layer's job (``PEER_IDLE_REAP_MS``) and the protocol
+        timeouts' — transport healing triggers on transport
+        evidence."""
+        with self._cond:
+            sock = self.sock
+            if sock is None or self.closed:
+                return
+            started = self._send_started
+            stuck = (started is not None
+                     and time.monotonic() - started >= probe_s)  # clock-ok: _send_started timebase
+        if stuck:
+            self._link_down("probe", sock)
+
     def _connect_with_preamble(self) -> Optional[socket.socket]:
         try:
             host, port_s = self.remote_id.rsplit(":", 1)
+            plan = self.endpoint.network.fault_plan
+            stalled = False
+            if plan is not None:
+                kind = plan.on_connect()
+                if kind == "refuse":
+                    raise ConnectionRefusedError(
+                        "injected connect refusal")
+                stalled = kind == "stall"
             sock = socket.create_connection((host, int(port_s)),
                                             timeout=HANDSHAKE_TIMEOUT_S)
             # one absolute deadline for the whole handshake — TLS wrap
             # included: a byte-dribbling acceptor must not wedge the
             # writer thread
-            deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S
+            deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S  # clock-ok: socket deadline
             ssl_ctx = self.endpoint.network.ssl_client_context
             if ssl_ctx is not None:
                 # confidentiality wrap BEFORE any identity bytes; the
@@ -560,6 +894,11 @@ class _Connection:
                 if tls is None:
                     return None  # _tls_wrap owns failure cleanup
                 sock = tls
+            if plan is not None:
+                # the fault shim rides ABOVE any TLS wrap and UNDER
+                # the identity handshake, so stall/latency exercise
+                # the real deadline discipline (engine/netfaults.py)
+                sock = FaultSocket(sock, plan, stalled=stalled)
             raw = self.endpoint.peer_id.encode()
             _send_with_deadline(sock, _LEN.pack(len(raw)) + raw,
                                 deadline)
@@ -584,30 +923,40 @@ class _Connection:
                 c2a, a2c = _derive_frame_keys(psk, a_nonce, c_nonce, raw)
                 self.send_key, self.recv_key = c2a, a2c
             sock.settimeout(None)  # handshake timeout must not poison recv
+            if isinstance(sock, FaultSocket):
+                sock.arm_frames()  # send-fault indices count frames only
             return sock
         except (OSError, ValueError):
             return None
 
-    def close(self) -> None:
+    def close(self, drop_reason: str = "closed") -> None:
+        """Final teardown (no healing past this point).  Frames still
+        queued are dropped and COUNTED under ``drop_reason`` — the
+        self-heal give-up paths pass ``"giveup"``/``"circuit_open"``
+        so the gate can join every abandoned queue to its cause."""
         with self._cond:
             if self.closed:
                 return
             self.closed = True
+            dropped = len(self._queue)
             self._queue.clear()
             self._queued_bytes = 0
             self._send_started = None
+            sock = self.sock
             self._cond.notify_all()
-        if self.sock is not None:
+        if dropped:
+            self.endpoint._count("send_drops", drop_reason, n=dropped)
+        if sock is not None:
             try:
                 # shutdown, not just close: close() while the reader
                 # thread is blocked in recv neither wakes it nor sends
                 # FIN (the in-flight syscall pins the open file);
                 # shutdown delivers EOF to both sides immediately
-                self.sock.shutdown(socket.SHUT_RDWR)
+                sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
             try:
-                self.sock.close()
+                sock.close()
             except OSError:
                 pass
         self.endpoint._forget(self)
@@ -624,7 +973,7 @@ def _read_exact(sock: socket.socket, n: int,
     while len(buf) < n:
         try:
             if deadline is not None:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - time.monotonic()  # clock-ok: socket deadline
                 if remaining <= 0:
                     return None
                 sock.settimeout(remaining)
@@ -648,7 +997,7 @@ def _send_with_deadline(sock: socket.socket, data: bytes,
     as an overall sendall deadline, and ``_SafeTls`` honors it in
     its want-write loop.  Raises ``OSError`` on expiry like any
     other torn-down-connection write."""
-    remaining = deadline - time.monotonic()
+    remaining = deadline - time.monotonic()  # clock-ok: socket deadline
     if remaining <= 0:
         raise socket.timeout("handshake deadline exceeded")
     sock.settimeout(remaining)
@@ -718,6 +1067,19 @@ class TcpEndpoint:
         self._extra_conns: list = []  # crossed-dial inbound links
         self._conn_lock = threading.Lock()
         self._pending_handshakes = 0  # guarded by _conn_lock
+        #: the network's ReconnectPolicy (None = self-healing off:
+        #: every failure path behaves exactly as before this round)
+        self._heal: Optional[ReconnectPolicy] = network.heal
+        #: the policy clock (injectable seconds) every self-heal
+        #: decision reads; plain monotonic when healing is off
+        self._hclock = (self._heal.clock if self._heal is not None
+                        else time.monotonic)
+        #: per-remote circuit breakers (guarded by _conn_lock;
+        #: size-bounded — attacker-claimable state, like the
+        #: resolver cache)
+        self._circuits: Dict[str, _Circuit] = {}
+        self._reconnect_listeners: list = []
+        self._probe_timer = None
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -739,10 +1101,25 @@ class TcpEndpoint:
                            "psk", "socket")}
         self._m_counts[("mac_drops", None)] = registry.counter(
             "net.mac_drops", endpoint=self.peer_id)
+        # the self-healing families (round 10): reconnect attempts by
+        # what took the link down, dropped frames by cause, circuit
+        # transitions by new state
+        for reason in ("connect", "send_error", "recv", "mac", "probe"):
+            self._m_counts[("reconnects", reason)] = registry.counter(
+                "net.reconnects", endpoint=self.peer_id, reason=reason)
+        for reason in ("closed", "admission", "circuit_open",
+                       "queue_full", "giveup"):
+            self._m_counts[("send_drops", reason)] = registry.counter(
+                "net.send_drops", endpoint=self.peer_id, reason=reason)
+        for state in ("open", "half_open", "closed"):
+            self._m_counts[("circuit", state)] = registry.counter(
+                "net.circuit", endpoint=self.peer_id, state=state)
         threading.Thread(target=self._accept_loop, daemon=True,
                          name=f"p2p-accept-{self.peer_id}").start()
+        self._arm_probe_timer()
 
-    def _count(self, counter: str, reason: Optional[str] = None) -> None:
+    def _count(self, counter: str, reason: Optional[str] = None,
+               n: int = 1) -> None:
         """Locked counter bump into the registry series — ONE lock per
         event (Counter.inc's): these feed alerting during exactly the
         high-concurrency bursts where unlocked ``+=`` from 64
@@ -752,7 +1129,82 @@ class TcpEndpoint:
         ``(counter, reason)`` combo is a programming error that
         raises ``KeyError`` loudly instead of silently minting a new
         series — add new reasons to the ``__init__`` table."""
-        self._m_counts[(counter, reason)].inc()
+        self._m_counts[(counter, reason)].inc(n)
+
+    def _trace(self, event: str, **fields) -> None:
+        """One flight-recorder event per self-heal action when the
+        network carries a recorder (``TcpNetwork(trace=...)``); the
+        registry counters stay the source of truth either way."""
+        recorder = self.network.trace
+        if recorder is not None:
+            recorder.emit("net", event=event, endpoint=self.peer_id,
+                          **fields)
+
+    #: bound on per-remote circuit-breaker entries (dialed remote ids
+    #: are attacker-influenced state on open fabrics)
+    MAX_CIRCUITS = 1024
+
+    def _circuit_for(self, remote_id: str) -> Optional[_Circuit]:
+        """Get-or-create the remote's breaker (None with healing
+        off).  At the cap, clean breakers are pruned first — a dirty
+        one holds cooldown state that still gates dials."""
+        if self._heal is None:
+            return None
+        with self._conn_lock:
+            circuit = self._circuits.get(remote_id)
+            if circuit is None:
+                if len(self._circuits) >= self.MAX_CIRCUITS:
+                    clean = [rid for rid, c in self._circuits.items()
+                             if c.state == _Circuit.CLOSED
+                             and c.failures == 0]
+                    for rid in clean or [next(iter(self._circuits))]:
+                        del self._circuits[rid]
+                circuit = self._circuits[remote_id] = _Circuit()
+            return circuit
+
+    def add_reconnect_listener(self, fn) -> None:
+        """Subscribe ``fn(remote_id)`` to link RE-establishments
+        (never first connects), delivered on the NetLoop.  The
+        tracker client uses this to re-announce immediately after its
+        tracker link heals, so swarm membership converges without
+        waiting out the announce interval."""
+        self._reconnect_listeners.append(fn)
+
+    def _notify_reconnect(self, remote_id: str) -> None:
+        listeners = list(self._reconnect_listeners)
+        self._trace("reconnected", remote=remote_id)
+        if not listeners:
+            return
+
+        def deliver() -> None:
+            for fn in listeners:
+                try:
+                    fn(remote_id)
+                except Exception:  # noqa: BLE001
+                    log.exception("reconnect listener failed")
+
+        self.loop.post(deliver)
+
+    def _arm_probe_timer(self) -> None:
+        """Start the half-open maintenance tick (no-op with healing
+        off): every quarter of the probe deadline, every primary
+        connection is checked for a stuck send or a silent
+        send-without-reply window (see :meth:`_Connection.probe`)."""
+        heal = self._heal
+        if heal is None:
+            return
+        interval_ms = max(heal.idle_probe_s * 250.0, 50.0)
+
+        def tick() -> None:
+            if self.closed:
+                return
+            with self._conn_lock:
+                conns = list(self._conns.values())
+            for conn in conns:
+                conn.probe(heal.idle_probe_s)
+            self._probe_timer = self.loop.call_later(interval_ms, tick)
+
+        self._probe_timer = self.loop.call_later(interval_ms, tick)
 
     @property
     def handshake_rejects(self) -> int:
@@ -815,7 +1267,7 @@ class TcpEndpoint:
                 if not c.closed]
         if len(live) < self.MAX_CONNECTIONS:
             return True, None
-        now = time.monotonic()
+        now = time.monotonic()  # clock-ok: at-cap idle eviction reads the eviction-hint timebase
         candidates = [
             c for c in live
             if now - c.last_activity >= self.CONN_IDLE_EVICT_S]
@@ -832,20 +1284,36 @@ class TcpEndpoint:
     def send(self, dest_id: str, frame: bytes) -> bool:
         """Queue a frame; never blocks.  True means queued — like the
         loopback fabric, delivery is not acknowledged and receivers
-        rely on protocol timeouts."""
+        rely on protocol timeouts.  Every False is a COUNTED drop
+        (``net.send_drops{reason}``): dead endpoint, circuit cooldown,
+        all-links-busy admission refusal, or the bounded queue."""
         started = victim = None
+        drop = None
         with self._conn_lock:
             # closed-check inside the lock: a send racing close() must
             # not register a fresh connection on a dead endpoint
             if self.closed:
-                return False
-            conn = self._conns.get(dest_id)
-            if conn is None or conn.closed:
-                admit, victim = self._evict_for_admission_locked()
-                if not admit:
-                    return False  # every link busy; like a full queue
-                conn = started = _Connection(self, dest_id)
-                self._conns[dest_id] = conn
+                drop = "closed"
+            else:
+                conn = self._conns.get(dest_id)
+                if conn is None or conn.closed:
+                    circuit = self._circuits.get(dest_id)
+                    if circuit is not None \
+                            and circuit.blocked(self._hclock()):
+                        # cooling down: never a hot dial loop
+                        drop = "circuit_open"
+                    else:
+                        admit, victim = \
+                            self._evict_for_admission_locked()
+                        if not admit:
+                            # every link busy; like a full queue
+                            drop = "admission"
+                        else:
+                            conn = started = _Connection(self, dest_id)
+                            self._conns[dest_id] = conn
+        if drop is not None:
+            self._count("send_drops", drop)
+            return False
         if victim is not None:
             victim.close()
         queued = conn.enqueue(frame)
@@ -923,7 +1391,7 @@ class TcpEndpoint:
         # the whole identity handshake runs under ONE absolute
         # deadline: a connection that sends nothing — or dribbles one
         # byte per almost-timeout — must not pin this thread
-        deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S
+        deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S  # clock-ok: socket deadline
         ssl_ctx = self.network.ssl_server_context
         if ssl_ctx is not None:
             # the TLS handshake runs on THIS per-handshake thread,
@@ -934,6 +1402,10 @@ class TcpEndpoint:
                 self._count("handshake_rejects", reason="tls")
                 return  # _tls_wrap owns failure cleanup
             sock = tls
+        if self.network.fault_plan is not None:
+            # accepted links get the fault shim too (send-side faults
+            # apply wherever the serve traffic actually rides)
+            sock = FaultSocket(sock, self.network.fault_plan)
         preamble = _read_frame(sock, max_bytes=self.MAX_PREAMBLE_BYTES,
                                deadline=deadline)
         if preamble is None:
@@ -1013,6 +1485,8 @@ class TcpEndpoint:
             self._count("handshake_rejects", reason="socket")
             sock.close()
             return
+        if isinstance(sock, FaultSocket):
+            sock.arm_frames()  # send-fault indices count frames only
         conn = _Connection(self, remote_id, sock)
         if frame_keys is not None:
             # acceptor sends on the a2c key, verifies on c2a — set
@@ -1056,43 +1530,62 @@ class TcpEndpoint:
             return
         conn.start()
 
-    def _reader_loop(self, conn: _Connection) -> None:
+    def _reader_loop(self, conn: _Connection, sock=None,
+                     recv_key=None) -> None:
+        # THIS link session's socket and key: a healed connection
+        # swaps both, and a stale reader must neither read the fresh
+        # socket nor touch the fresh MAC state (its _link_down
+        # reports are ignored by the sock identity check).  Redial
+        # spawns pass them explicitly AT SPAWN TIME; the inbound
+        # start() spawn reads them here, which is race-free there —
+        # an inbound conn's sock cannot be replaced before its first
+        # reader runs (no queue, so no redial path)
+        if sock is None:
+            sock = conn.sock
+            recv_key = conn.recv_key
+        # the inbound MAC sequence is LOCAL to this reader: every
+        # link session starts at 0 by protocol, and a shared field
+        # would let a stale reader's increment corrupt the healed
+        # session's expectation (one spurious MAC tear per race)
+        recv_seq = 0
         # the tag rides INSIDE the length-prefixed record, so an
         # authenticated link's wire records run up to tag-length past
         # the payload cap — a max-size frame must stay deliverable on
         # both fabrics
         max_wire = MAX_FRAME_BYTES + (FRAME_MAC_LEN
-                                      if conn.recv_key is not None else 0)
-        while not self.closed and not conn.closed:
-            frame = _read_frame(conn.sock, max_bytes=max_wire)
+                                      if recv_key is not None else 0)
+        while not self.closed and not conn.closed \
+                and conn.sock is sock:
+            frame = _read_frame(sock, max_bytes=max_wire)
             if frame is None:
-                conn.close()
+                conn._link_down("recv", sock)
                 return
-            if conn.recv_key is not None:
+            if recv_key is not None:
                 # per-frame integrity (module docstring: trust model):
                 # strip + verify the tag against this direction's key
                 # and the expected sequence number.  Any mismatch —
                 # missing tag, forged tag, replayed/spliced frame —
                 # drops the connection, the same fail-closed
-                # discipline the wire decoder applies
+                # discipline the wire decoder applies (a healed link
+                # re-handshakes from scratch: fresh keys, sequence 0)
                 if len(frame) < FRAME_MAC_LEN:
                     log.warning("dropping %s: untagged frame on an "
                                 "authenticated link", conn.remote_id)
                     self._count("mac_drops")
-                    conn.close()
+                    conn._link_down("mac", sock)
                     return
                 body, tag = frame[:-FRAME_MAC_LEN], frame[-FRAME_MAC_LEN:]
                 if not hmac.compare_digest(
-                        tag, _frame_tag(conn.recv_key, conn._recv_seq,
-                                        body)):
+                        tag, _frame_tag(recv_key, recv_seq, body)):
                     log.warning("dropping %s: frame MAC mismatch "
                                 "(injection or splice?)", conn.remote_id)
                     self._count("mac_drops")
-                    conn.close()
+                    conn._link_down("mac", sock)
                     return
-                conn._recv_seq += 1
+                recv_seq += 1
                 frame = body
-            conn.last_activity = time.monotonic()
+            conn.last_activity = time.monotonic()  # clock-ok: eviction hint
+            conn._mark_progress()
             self.bytes_received += len(frame)
             src = conn.remote_id
 
@@ -1124,6 +1617,10 @@ class TcpEndpoint:
             conns = list(self._conns.values()) + list(self._extra_conns)
             self._conns.clear()
             self._extra_conns.clear()
+            probe_timer = self._probe_timer
+            self._probe_timer = None
+        if probe_timer is not None:
+            probe_timer.cancel()
         try:
             # shutdown BEFORE close, like _Connection.close: close()
             # alone does not wake a thread blocked in accept() — the
@@ -1179,7 +1676,8 @@ class TcpNetwork:
                  psk: Optional[bytes] = None,
                  ssl_server_context=None,
                  ssl_client_context=None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 heal=None, fault_plan=None, trace=None):
         self.host = host
         self._owns_loop = loop is None
         self.loop = loop or NetLoop()
@@ -1188,6 +1686,26 @@ class TcpNetwork:
         #: registry keeps call sites unconditional when none is given
         self.registry = registry if registry is not None \
             else MetricsRegistry()
+        #: self-healing policy (round 10): ``None`` = the default
+        #: :class:`ReconnectPolicy` (bounded jittered redial +
+        #: circuit breaker + half-open probe); ``False`` disables
+        #: healing entirely (pre-0.12 failure behavior); or inject a
+        #: tuned/seeded policy.  Fault-free traffic is byte-identical
+        #: under any of the three.
+        self.heal: Optional[ReconnectPolicy] = \
+            ReconnectPolicy() if heal is None else (heal or None)
+        #: deterministic socket-fault injection
+        #: (engine/netfaults.py NetFaultPlan): when set, outbound
+        #: dials consult the plan and every connection is wrapped in
+        #: the FaultSocket shim — the REAL handshake/framing/reader/
+        #: writer paths run under the schedule.  Production fabrics
+        #: leave this None; the net chaos gate does not.
+        self.fault_plan = fault_plan
+        #: optional FlightRecorder (engine/tracer.py): self-heal
+        #: actions (reconnect / circuit transitions) emit one ``net``
+        #: event each, alongside the counter-bump correlation the
+        #: recorder already gets from an attached registry
+        self.trace = trace
         #: per-swarm pre-shared key: when set, every connection must
         #: pass the HMAC challenge-response before its claimed id is
         #: believed, and every subsequent frame carries a sequence-
@@ -1238,7 +1756,7 @@ class TcpNetwork:
         closed."""
         if claimed_host == observed_host:
             return True
-        now = time.monotonic()
+        now = time.monotonic()  # clock-ok: resolver throttle window is wall time
         with self._resolve_lock:
             cached = self._resolve_cache.get(claimed_host)
             if cached is not None:

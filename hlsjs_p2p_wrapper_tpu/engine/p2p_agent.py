@@ -194,6 +194,12 @@ class P2PAgent:
                                          MAX_TOTAL_SERVES),
                 registry=self.metrics_registry)
             self.mesh.on_remote_have = lambda _peer: self._schedule_prefetch()
+            # reject-path visibility (the TrackerEndpoint convention):
+            # undecodable frames are dropped — one malformed peer must
+            # not kill the dispatch path — but COUNTED, so the fuzz
+            # suite and dashboards see a poisoning attempt, not silence
+            self._m_decode_rejects = self.mesh.metrics.counter(
+                "mesh.decode_rejects")
             self.tracker_client = TrackerClient(
                 self.endpoint, self.swarm_id, self.peer_id, self.clock,
                 tracker_peer_id=cfg.get("tracker_peer_id", TRACKER_PEER_ID),
@@ -235,6 +241,7 @@ class P2PAgent:
             msg = P.decode(frame)
         except P.ProtocolError:
             log.warning("dropping malformed frame from %s", src_id)
+            self._m_decode_rejects.inc()
             return
         if self.tracker_client.handle_frame(src_id, msg):
             return

@@ -1047,7 +1047,14 @@ class TrackerEndpoint:
 
 class TrackerClient:
     """Agent-side membership client: periodic re-announce over the
-    transport, membership-change callback, orderly leave."""
+    transport, membership-change callback, orderly leave.
+
+    On a self-healing transport (``TcpEndpoint.
+    add_reconnect_listener``), a healed tracker link triggers an
+    IMMEDIATE re-announce instead of waiting out the announce
+    interval: the tracker may have expired our lease during the
+    outage, and swarm membership must converge at reconnect speed,
+    not at lease-refresh speed."""
 
     def __init__(self, endpoint: Endpoint, swarm_id: str, peer_id: str,
                  clock: Clock, *,
@@ -1064,6 +1071,19 @@ class TrackerClient:
         self.known_peers: Tuple[str, ...] = ()
         self._timer = None
         self._stopped = False
+        hook = getattr(endpoint, "add_reconnect_listener", None)
+        if hook is not None:
+            hook(self._on_transport_reconnect)
+
+    def _on_transport_reconnect(self, remote_id: str) -> None:
+        """Transport-link healed: if it was OUR tracker link,
+        re-announce now (delivered on the dispatch loop, so the timer
+        churn below is single-threaded like every other timer op)."""
+        if remote_id != self.tracker_peer_id or self._stopped:
+            return
+        if self._timer is not None:
+            self._timer.cancel()
+        self._announce()
 
     def start(self) -> None:
         self._announce()

@@ -76,13 +76,21 @@ class LoopbackNetwork:
       RNG, reproducible)
     - per-link overrides via :meth:`set_link`; hard partitions via
       :meth:`partition`
+    - ``fault_plan``: a :class:`~.netfaults.NetFaultPlan` driving the
+      SAME knobs on a schedule — ``loss`` windows drop frames through
+      the plan's seeded RNG, ``partition`` windows block a
+      deterministic fraction of peer pairs, ``latency`` windows add
+      delay — so the loopback fabric and the TCP fabric
+      (``TcpNetwork(fault_plan=...)``) run one chaos schedule
     """
 
     def __init__(self, clock: Clock, *, default_latency_ms: float = 10.0,
-                 loss_rate: float = 0.0, seed: int = 0):
+                 loss_rate: float = 0.0, seed: int = 0,
+                 fault_plan=None):
         self.clock = clock
         self.default_latency_ms = default_latency_ms
         self.loss_rate = loss_rate
+        self.fault_plan = fault_plan
         self._rng = random.Random(seed)
         self._endpoints: Dict[str, Endpoint] = {}
         self._links: Dict[Tuple[str, str], Dict] = {}
@@ -122,13 +130,21 @@ class LoopbackNetwork:
     def _transmit(self, src: Endpoint, dest_id: str, frame: bytes) -> bool:
         dest = self._endpoints.get(dest_id)
         link = self._links.get((src.peer_id, dest_id), {})
-        if dest is None or dest.closed or link.get("blocked"):
+        plan = self.fault_plan
+        if dest is None or dest.closed or link.get("blocked") or (
+                plan is not None
+                and plan.link_blocked(src.peer_id, dest_id)):
+            # a scheduled partition window behaves exactly like the
+            # hard partition() knob: an observable send failure
             self.frames_dropped += 1
             return False
         loss = link.get("loss_rate", self.loss_rate)
         if loss and self._rng.random() < loss:
             self.frames_dropped += 1
             return True  # loss is silent, like the UDP it models
+        if plan is not None and plan.drop_frame():
+            self.frames_dropped += 1
+            return True  # scheduled loss is silent too
 
         now = self.clock.now()
         size = len(frame)
@@ -145,6 +161,8 @@ class LoopbackNetwork:
             ready = now
 
         latency = link.get("latency_ms", self.default_latency_ms)
+        if plan is not None:
+            latency += plan.extra_latency_ms()
         src_id = src.peer_id
 
         def deliver() -> None:

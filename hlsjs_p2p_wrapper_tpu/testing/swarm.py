@@ -94,7 +94,9 @@ class SwarmHarness:
                  p2p_latency_ms: float = 8.0,
                  loss_rate: float = 0.0, seed: int = 0,
                  live: bool = False, redundant: bool = False,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 fault_plan_specs: Optional[str] = None,
+                 fault_plan_kwargs: Optional[dict] = None):
         self.clock = VirtualClock()
         #: ONE registry for the whole swarm (engine/telemetry.py):
         #: every agent's stats land here as per-peer labeled series,
@@ -118,9 +120,21 @@ class SwarmHarness:
         self.cdn = MockCdnTransport(self.clock, latency_ms=cdn_latency_ms,
                                     bandwidth_bps=cdn_bandwidth_bps)
         serve_manifest(self.cdn, self.manifest)
+        # optional scheduled chaos (engine/netfaults.py): a
+        # ``kind@t0-t1`` spec string drives the loopback loss/latency/
+        # partition knobs on THIS swarm's VirtualClock, counting every
+        # injection into the shared registry — the soak's --chaos mode
+        self.fault_plan = None
+        if fault_plan_specs is not None:
+            from ..engine.netfaults import NetFaultPlan
+            self.fault_plan = NetFaultPlan.parse(
+                fault_plan_specs, clock=self.clock,
+                registry=self.metrics, **(fault_plan_kwargs or {}))
+            self.fault_plan.arm()
         self.network = LoopbackNetwork(self.clock,
                                        default_latency_ms=p2p_latency_ms,
-                                       loss_rate=loss_rate, seed=seed)
+                                       loss_rate=loss_rate, seed=seed,
+                                       fault_plan=self.fault_plan)
         self.tracker = Tracker(self.clock, registry=self.metrics)
         TrackerEndpoint(self.tracker, self.network.register("tracker"))
         self.peers: List[SwarmPeer] = []

@@ -1,0 +1,489 @@
+"""The network chaos plane (engine/netfaults.py) and the
+self-healing TCP transport (engine/net.py ReconnectPolicy): plan
+grammar, both fabric drives, reconnect/backoff/circuit behavior, and
+the counted drop paths."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+from hlsjs_p2p_wrapper_tpu.engine.faults import FaultPolicy
+from hlsjs_p2p_wrapper_tpu.engine.net import (ReconnectPolicy,
+                                              TcpNetwork)
+from hlsjs_p2p_wrapper_tpu.engine.netfaults import (FaultSocket,
+                                                    NetFaultPlan)
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+from hlsjs_p2p_wrapper_tpu.engine.transport import LoopbackNetwork
+from hlsjs_p2p_wrapper_tpu.testing.fixtures import wait_for
+
+
+def series(registry, name):
+    return {tuple(sorted(labels.items())): value
+            for labels, value in registry.series(name)}
+
+
+def reason_counts(registry, name, key):
+    return {labels[key]: value for labels, value
+            in registry.series(name) if value}
+
+
+# -- plan grammar and matching ------------------------------------------
+
+
+def test_plan_parse_grammar():
+    plan = NetFaultPlan.parse(
+        "refuse@0x2, rst@3, corrupt@1, blackhole@2-4.5, latency@0-10")
+    kinds = [s["kind"] for s in plan.specs]
+    assert kinds == ["refuse", "rst", "corrupt", "blackhole", "latency"]
+    assert plan.specs[0] == {"kind": "refuse", "at": 0, "count": 2}
+    assert plan.specs[3] == {"kind": "blackhole", "t0": 2.0, "t1": 4.5}
+
+
+@pytest.mark.parametrize("bad", [
+    "bogus@0", "refuse@1-2", "blackhole@3", "rst@", "refuse@x",
+    "blackhole@5-2",
+])
+def test_plan_rejects_bad_specs(bad):
+    with pytest.raises(ValueError):
+        NetFaultPlan.parse(bad)
+
+
+def test_plan_op_matching_and_schedule():
+    registry = MetricsRegistry()
+    plan = NetFaultPlan.parse("refuse@1x2,rst@0", registry=registry)
+    # connect ops: 0 clean, 1 and 2 refused, 3 clean
+    assert plan.on_connect() is None
+    assert plan.on_connect() == "refuse"
+    assert plan.on_connect() == "refuse"
+    assert plan.on_connect() is None
+    # send ops: 0 reset, rest clean
+    assert plan.on_send() == "rst"
+    assert plan.on_send() is None
+    assert plan.schedule() == ["refuse@1x2", "rst@0"]
+    assert plan.remaining() == []
+    counts = reason_counts(registry, "mesh.transport_faults", "kind")
+    assert counts == {"refuse": 2, "rst": 1}
+
+
+def test_plan_windows_follow_injected_clock():
+    clock = VirtualClock()
+    plan = NetFaultPlan.parse("latency@1-2", clock=clock,
+                              latency_ms=250.0)
+    plan.arm()
+    assert plan.extra_latency_ms() == 0.0
+    clock.advance(1500.0)
+    assert plan.extra_latency_ms() == 250.0
+    clock.advance(1000.0)
+    assert plan.extra_latency_ms() == 0.0
+    assert plan.schedule() == ["latency@1-2"]
+
+
+# -- the loopback drive -------------------------------------------------
+
+
+def test_loopback_loss_window_drops_then_recovers():
+    clock = VirtualClock()
+    registry = MetricsRegistry()
+    plan = NetFaultPlan.parse("loss@0-5", clock=clock, loss_rate=1.0,
+                              registry=registry)
+    net = LoopbackNetwork(clock, default_latency_ms=1.0,
+                          fault_plan=plan)
+    a, b = net.register("a"), net.register("b")
+    got = []
+    b.on_receive = lambda src, f: got.append(f)
+    plan.arm()
+    assert a.send("b", b"in-window") is True  # loss is silent
+    clock.advance(10.0)
+    assert got == []
+    assert net.frames_dropped == 1
+    clock.advance(6_000.0)  # window over
+    a.send("b", b"after")
+    clock.advance(10.0)
+    assert got == [b"after"]
+    assert reason_counts(registry, "mesh.transport_faults",
+                         "kind")["loss"] == 1
+
+
+def test_loopback_partition_window_blocks_deterministic_pairs():
+    clock = VirtualClock()
+    plan = NetFaultPlan.parse("partition@0-5", clock=clock,
+                              partition_fraction=1.0)
+    net = LoopbackNetwork(clock, fault_plan=plan)
+    a, b = net.register("a"), net.register("b")
+    got = []
+    b.on_receive = lambda src, f: got.append(f)
+    plan.arm()
+    assert a.send("b", b"x") is False  # observable, like partition()
+    clock.advance(6_000.0)
+    assert a.send("b", b"y") is True
+    clock.advance(20.0)
+    assert got == [b"y"]
+    # fraction 0: window active but no pair hashes under it
+    plan2 = NetFaultPlan.parse("partition@0-5", clock=VirtualClock(),
+                               partition_fraction=0.0)
+    assert plan2.link_blocked("a", "b") is False
+
+
+def test_loopback_latency_window_delays_delivery():
+    clock = VirtualClock()
+    plan = NetFaultPlan.parse("latency@0-60", clock=clock,
+                              latency_ms=500.0)
+    net = LoopbackNetwork(clock, default_latency_ms=10.0,
+                          fault_plan=plan)
+    a, b = net.register("a"), net.register("b")
+    got = []
+    b.on_receive = lambda src, f: got.append(f)
+    plan.arm()
+    a.send("b", b"slow")
+    clock.advance(400.0)
+    assert got == []  # base 10 ms + 500 ms spike not yet elapsed
+    clock.advance(200.0)
+    assert got == [b"slow"]
+
+
+def test_same_seed_plans_produce_identical_schedules():
+    def run(seed):
+        clock = VirtualClock()
+        plan = NetFaultPlan.parse("loss@0-5,partition@6-8",
+                                  clock=clock, seed=seed,
+                                  loss_rate=0.5,
+                                  partition_fraction=1.0)
+        net = LoopbackNetwork(clock, fault_plan=plan)
+        a, b = net.register("a"), net.register("b")
+        b.on_receive = lambda src, f: None
+        plan.arm()
+        sent = []
+        for i in range(40):
+            sent.append(a.send("b", bytes([i])))
+            clock.advance(200.0)
+        return plan.schedule(), sent, net.frames_dropped
+
+    # the gate's determinism contract: same seed → identical fired
+    # schedule, identical send outcomes, identical drop count
+    s1, sent1, dropped1 = run(seed=3)
+    s2, sent2, dropped2 = run(seed=3)
+    assert s1 == s2 and sent1 == sent2 and dropped1 == dropped2
+    assert s1 == ["loss@0-5", "partition@6-8"]  # both specs live
+    assert dropped1 > 0
+
+
+# -- the FaultSocket shim -----------------------------------------------
+
+
+def test_fault_socket_blackhole_swallows_then_flows():
+    plan = NetFaultPlan.parse("blackhole@0-0.3")
+    a, b = socket.socketpair()
+    try:
+        shim = FaultSocket(a, plan)
+        plan.arm()
+        shim.sendall(b"swallowed")
+        time.sleep(0.35)
+        shim.sendall(b"through")
+        b.settimeout(2.0)
+        assert b.recv(64) == b"through"
+        assert "blackhole@0-0.3" in plan.schedule()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_fault_socket_partial_wedges_until_torn_down():
+    plan = NetFaultPlan.parse("partial@0")
+    a, b = socket.socketpair()
+    shim = FaultSocket(a, plan)
+    shim.arm_frames()
+    errors = []
+
+    def sender():
+        try:
+            shim.sendall(b"x" * 64)
+        except OSError as exc:
+            errors.append(exc)
+
+    t = threading.Thread(target=sender, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not errors  # wedged, exactly as injected
+    shim.close()  # the teardown (probe path in real use) releases it
+    t.join(5.0)
+    assert errors, "partial-write stall never released on close"
+    b.close()
+
+
+def test_fault_socket_rst_tears_mid_frame():
+    plan = NetFaultPlan.parse("rst@0")
+    a, b = socket.socketpair()
+    try:
+        shim = FaultSocket(a, plan)
+        shim.arm_frames()
+        with pytest.raises(ConnectionResetError):
+            shim.sendall(b"y" * 64)
+        b.settimeout(2.0)
+        assert len(b.recv(64)) == 32  # exactly half went out
+    finally:
+        a.close()
+        b.close()
+
+
+# -- self-healing TCP ---------------------------------------------------
+
+
+def fast_policy(**kw):
+    kw.setdefault("max_retries", 3)
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_cap_s", 0.05)
+    kw.setdefault("seed", 1)
+    return ReconnectPolicy(**kw)
+
+
+def test_reconnect_policy_reuses_faultpolicy_backoff():
+    policy = ReconnectPolicy(seed=42, backoff_base_s=0.2, jitter=0.7)
+    reference = FaultPolicy(seed=42, backoff_base_s=0.2, jitter=0.7)
+    assert [policy.backoff_s(i) for i in range(5)] \
+        == [reference.backoff_s(i) for i in range(5)]
+    slept = []
+    policy2 = ReconnectPolicy(seed=7, sleep=slept.append)
+    delay = policy2.sleep_backoff(0)
+    assert slept == [delay]  # injectable sleep, like FaultPolicy's
+
+
+def test_injected_refusal_is_retried_and_counted():
+    registry = MetricsRegistry()
+    plan = NetFaultPlan.parse("refuse@0", registry=registry)
+    network = TcpNetwork(registry=registry, fault_plan=plan,
+                         heal=fast_policy())
+    try:
+        a, b = network.register(), network.register()
+        got = []
+        done = threading.Event()
+        b.on_receive = lambda src, f: (got.append(f), done.set())
+        assert a.send(b.peer_id, b"heals")
+        assert wait_for(done.is_set)
+        assert got == [b"heals"]
+        assert reason_counts(registry, "mesh.transport_faults",
+                             "kind")["refuse"] == 1
+        rec = reason_counts(registry, "net.reconnects", "reason")
+        assert rec.get("connect", 0) >= 1
+    finally:
+        network.close()
+
+
+def test_injected_rst_heals_and_redelivers():
+    registry = MetricsRegistry()
+    plan = NetFaultPlan.parse("rst@0", registry=registry)
+    network = TcpNetwork(registry=registry, fault_plan=plan,
+                         heal=fast_policy())
+    try:
+        a, b = network.register(), network.register()
+        got = []
+        done = threading.Event()
+        b.on_receive = lambda src, f: (got.append(f), done.set())
+        assert a.send(b.peer_id, b"survives-rst")
+        assert wait_for(done.is_set, 10.0)
+        assert got == [b"survives-rst"]
+        rec = reason_counts(registry, "net.reconnects", "reason")
+        assert rec.get("send_error", 0) >= 1
+    finally:
+        network.close()
+
+
+def test_injected_corruption_hits_mac_drop_then_recovers():
+    registry = MetricsRegistry()
+    plan = NetFaultPlan.parse("corrupt@0", registry=registry)
+    network = TcpNetwork(psk=b"chaos", registry=registry,
+                         fault_plan=plan, heal=fast_policy())
+    try:
+        a, b = network.register(), network.register()
+        got = []
+        b.on_receive = lambda src, f: got.append(f)
+        a.send(b.peer_id, b"poisoned")
+        # the corrupted frame must NEVER deliver: the MAC layer drops
+        # it (and the link), countable on the receiving endpoint
+        assert wait_for(lambda: b.mac_drops == 1, 10.0)
+        assert got == []
+        done = threading.Event()
+        b.on_receive = lambda src, f: (got.append(f), done.set())
+
+        def clean_delivered():
+            # a send can race the dying link's teardown and be
+            # dropped with it (counted); retry until one lands —
+            # exactly what the protocol layer's timeouts do
+            a.send(b.peer_id, b"clean")
+            return done.wait(0.5)
+
+        assert wait_for(clean_delivered, 15.0)
+        assert got and set(got) == {b"clean"}
+    finally:
+        network.close()
+
+
+def test_circuit_breaker_opens_cools_and_half_opens():
+    t = {"now": 0.0}
+    registry = MetricsRegistry()
+    policy = fast_policy(max_retries=1, circuit_threshold=2,
+                         circuit_cooldown_s=30.0,
+                         sleep=lambda s: None,
+                         clock=lambda: t["now"])
+    network = TcpNetwork(registry=registry, heal=policy)
+    try:
+        a = network.register()
+        dead = "127.0.0.1:1"
+        assert a.send(dead, b"x") is True  # queued; dial fails async
+        assert wait_for(lambda: dead not in a._conns, 10.0)
+        circ = series(registry, "net.circuit")
+        key = (("endpoint", a.peer_id), ("state", "open"))
+        assert circ.get(key) == 1
+        # cooling: the send is refused up front, no dial, counted
+        assert a.send(dead, b"y") is False
+        drops = reason_counts(registry, "net.send_drops", "reason")
+        assert drops.get("circuit_open", 0) >= 1
+        # cooldown over: the next send is the half-open probe
+        t["now"] = 31.0
+        assert a.send(dead, b"z") is True
+        assert wait_for(lambda: dead not in a._conns, 10.0)
+        circ = series(registry, "net.circuit")
+        assert circ.get((("endpoint", a.peer_id),
+                         ("state", "half_open"))) == 1
+        assert circ.get(key) == 2  # probe failed → re-opened
+        # the abandoned frames were counted, not silently dropped
+        drops = reason_counts(registry, "net.send_drops", "reason")
+        assert drops.get("circuit_open", 0) >= 2
+    finally:
+        network.close()
+
+
+def test_queue_full_drop_is_counted():
+    from hlsjs_p2p_wrapper_tpu.engine.net import _Connection
+
+    registry = MetricsRegistry()
+    network = TcpNetwork(registry=registry)
+    orig = _Connection.MAX_QUEUED_FRAMES
+    _Connection.MAX_QUEUED_FRAMES = 2
+    try:
+        a = network.register()
+        conn = _Connection(a, "10.255.255.1:1")  # writer never started
+        with a._conn_lock:
+            a._conns["10.255.255.1:1"] = conn
+        assert conn.enqueue(b"1") and conn.enqueue(b"2")
+        assert conn.enqueue(b"3") is False
+        drops = reason_counts(registry, "net.send_drops", "reason")
+        assert drops.get("queue_full") == 1
+        conn.close()
+        drops = reason_counts(registry, "net.send_drops", "reason")
+        assert drops.get("closed") == 2  # the queued pair, attributed
+    finally:
+        _Connection.MAX_QUEUED_FRAMES = orig
+        network.close()
+
+
+def test_idle_probe_tears_and_heals_a_stuck_link():
+    """The half-open detector: a send stuck in flight past the probe
+    deadline (the blackholed-peer shape — sendall wedged in a full
+    socket buffer) tears the link and re-dials with a full fresh
+    handshake.  A healthy one-way push link never trips: probe fires
+    on transport evidence (a wedged send), not on a reply deadline."""
+    registry = MetricsRegistry()
+    policy = fast_policy(idle_probe_s=30.0)
+    network = TcpNetwork(registry=registry, heal=policy)
+    try:
+        a, b = network.register(), network.register()
+        got = []
+        b.on_receive = lambda src, f: got.append(f)
+        a.send(b.peer_id, b"one-way")
+        assert wait_for(lambda: got == [b"one-way"])
+        conn = a._conns[b.peer_id]
+        first_sock = conn.sock
+        # a healthy link (no send in flight) never trips, even after
+        # arbitrary quiet time
+        conn.probe(policy.idle_probe_s)
+        time.sleep(0.1)
+        assert conn.sock is first_sock
+        # a send wedged in flight past the deadline does
+        with conn._cond:
+            conn._send_started = time.monotonic() - 100.0
+        conn.probe(policy.idle_probe_s)
+        assert wait_for(lambda: conn.sock is not None
+                        and conn.sock is not first_sock, 10.0)
+        rec = reason_counts(registry, "net.reconnects", "reason")
+        assert rec.get("probe") == 1
+        done = threading.Event()
+        b.on_receive = lambda src, f: (got.append(f), done.set())
+        a.send(b.peer_id, b"after-heal")
+        assert wait_for(done.is_set)
+        assert got == [b"one-way", b"after-heal"]
+    finally:
+        network.close()
+
+
+def test_heal_disabled_restores_single_shot_dialing():
+    registry = MetricsRegistry()
+    network = TcpNetwork(registry=registry, heal=False)
+    try:
+        a = network.register()
+        assert a.send("127.0.0.1:1", b"x") is True
+        assert wait_for(lambda: "127.0.0.1:1" not in a._conns, 5.0)
+        rec = reason_counts(registry, "net.reconnects", "reason")
+        assert rec == {}  # no retries at all
+        drops = reason_counts(registry, "net.send_drops", "reason")
+        assert drops.get("giveup") == 1  # ...but the drop is counted
+    finally:
+        network.close()
+
+
+def test_tracker_client_reannounces_after_reconnect():
+    from hlsjs_p2p_wrapper_tpu.engine import protocol as P
+    from hlsjs_p2p_wrapper_tpu.engine.tracker import TrackerClient
+
+    clock = VirtualClock()
+    sent = []
+    listeners = []
+
+    class FakeEndpoint:
+        peer_id = "me"
+
+        def send(self, dest, frame):
+            sent.append((dest, P.decode(frame)))
+            return True
+
+        def add_reconnect_listener(self, fn):
+            listeners.append(fn)
+
+    client = TrackerClient(FakeEndpoint(), "swarm", "me", clock,
+                           announce_interval_ms=10_000.0)
+    assert listeners, "client never subscribed to reconnects"
+    client.start()
+    assert len(sent) == 1
+    # an unrelated peer link healing is not our business
+    listeners[0]("somebody:else")
+    assert len(sent) == 1
+    # the tracker link healing re-announces IMMEDIATELY
+    listeners[0]("tracker")
+    assert len(sent) == 2
+    assert isinstance(sent[-1][1], P.Announce)
+    # and the periodic cadence was re-armed, not doubled
+    clock.advance(10_001.0)
+    assert len(sent) == 3
+    client.stop()
+
+
+def test_fault_free_plan_changes_nothing():
+    registry = MetricsRegistry()
+    plan = NetFaultPlan([], registry=registry)
+    network = TcpNetwork(psk=b"s", registry=registry, fault_plan=plan)
+    try:
+        a, b = network.register(), network.register()
+        got = []
+        done = threading.Event()
+        b.on_receive = lambda src, f: (got.append(f), done.set())
+        assert a.send(b.peer_id, b"clean-run")
+        assert wait_for(done.is_set)
+        assert got == [b"clean-run"]
+        assert plan.schedule() == []
+        assert reason_counts(registry, "mesh.transport_faults",
+                             "kind") == {}
+        assert reason_counts(registry, "net.reconnects", "reason") == {}
+    finally:
+        network.close()

@@ -15,6 +15,7 @@ All fuzzing is seeded and deterministic — a failure reproduces.
 
 import hashlib
 import random
+import struct
 
 import pytest
 
@@ -212,3 +213,135 @@ def test_tracker_endpoint_counts_decode_rejects():
     # reject answers must not have perturbed the lease store
     assert tracker.announce("s", "p1") == []
     assert tracker.members("s") == ["p1"]
+
+
+# -- mesh data-plane messages (round 10) --------------------------------
+# The chaos plane's corrupt fault hands the mesh decoder hostile bytes
+# at socket speed, and the agent dispatch handles them on the NetLoop
+# (or, inline-delivery fabrics, on reader threads) — so the five mesh
+# data-plane messages get the same directed exhaustive treatment the
+# tracker messages got in round 9: round-trip over edge shapes,
+# every-prefix truncation rejection, forged length fields, and a
+# COUNTED reject path that never tracebacks.
+
+MESH_MSGS = [
+    P.Request(0, key()),
+    P.Request(0xFFFFFFFF, key(2, 1, 199)),
+    P.Chunk(1, 0, 0, b""),                      # empty-payload serve
+    P.Chunk(7, 16_384, 65_536, b"\x00" * 64),
+    P.Chunk(0xFFFFFFFF, 0xFFFFFFF0, 0xFFFFFFFF, b"tail"),
+    P.Have(key(), 0, hashlib.sha256(b"").digest()),
+    P.Have(key(1, 1, 120), 0xFFFFFFFF, hashlib.sha256(b"x").digest()),
+    P.Lost(key()),
+    P.Deny(77, P.DenyReason.NOT_FOUND),
+    P.Deny(77, P.DenyReason.UPLOAD_OFF),
+    P.Deny(0, P.DenyReason.BUSY),
+]
+
+
+def _mesh_id(m):
+    return f"{type(m).__name__}-{abs(hash(repr(m))) % 1000:03d}"
+
+
+@pytest.mark.parametrize("msg", MESH_MSGS, ids=_mesh_id)
+def test_mesh_messages_round_trip(msg):
+    """encode → decode is the identity for every mesh data-plane
+    shape, including empty chunks, u32-edge ids/offsets, and
+    zero-size announcements."""
+    frame = P.encode(msg)
+    assert P.decode(frame) == msg
+    assert P.encode(P.decode(frame)) == frame  # canonical both ways
+
+
+@pytest.mark.parametrize("msg", MESH_MSGS, ids=_mesh_id)
+def test_mesh_messages_every_truncation_rejected(msg):
+    """EVERY proper prefix of every mesh frame must raise
+    ProtocolError — never struct.error/IndexError, and never decode
+    to a message.  (The one deliberate laxity: a CHUNK's payload is
+    the frame tail, so truncating INTO the payload yields a shorter
+    but well-formed CHUNK — those prefixes must decode canonically
+    instead.)"""
+    frame = P.encode(msg)
+    for cut in range(len(frame)):
+        prefix = frame[:cut]
+        if type(msg) is P.Chunk and cut >= 4 + 12:
+            # header complete: the shorter payload is a VALID chunk
+            decoded = P.decode(prefix)
+            assert isinstance(decoded, P.Chunk)
+            assert P.encode(decoded) == prefix
+            continue
+        with pytest.raises(P.ProtocolError):
+            P.decode(prefix)
+
+
+@pytest.mark.parametrize("make", [
+    lambda: P._frame(P.MsgType.REQUEST,
+                     struct.pack("<I", 5) + b"\x00" * 11),   # short key
+    lambda: P._frame(P.MsgType.REQUEST,
+                     struct.pack("<I", 5) + b"\x00" * 13),   # long key
+    lambda: P._frame(P.MsgType.HAVE, P._pack_entry(
+        key(), 3, hashlib.sha256(b"x").digest()) + b"\x00"),  # oversize
+    lambda: P._frame(P.MsgType.HAVE, P._pack_entry(
+        key(), 3, hashlib.sha256(b"x").digest())[:-1]),       # undersize
+    lambda: P._frame(P.MsgType.BITFIELD, struct.pack("<I", 3)
+                     + P._pack_entry(key(), 1,
+                                     hashlib.sha256(b"a").digest())),
+    lambda: P._frame(P.MsgType.BITFIELD, struct.pack("<I", 0xFFFFFFFF)
+                     + b"\x00" * 32),                 # forged count
+    lambda: P._frame(P.MsgType.LOST, b"\x00" * 11),
+    lambda: P._frame(P.MsgType.DENY, struct.pack("<IB", 7, 2) + b"x"),
+    lambda: P._frame(P.MsgType.CANCEL, struct.pack("<I", 7) + b"x"),
+    lambda: P._frame(P.MsgType.CHUNK, struct.pack("<II", 1, 0)),
+], ids=["req-short-key", "req-long-key", "have-oversize",
+        "have-undersize", "bitfield-count-high", "bitfield-forged",
+        "lost-short-key", "deny-trailing", "cancel-trailing",
+        "chunk-short-header"])
+def test_mesh_forged_lengths_rejected(make):
+    """Forged length/count fields in mesh frames reject at the
+    boundary check, never via allocation or a non-ProtocolError."""
+    with pytest.raises(P.ProtocolError):
+        P.decode(make())
+
+
+def test_agent_counts_mesh_decode_rejects():
+    """The agent dispatch's reject path is OBSERVABLE (the
+    TrackerEndpoint convention): every undecodable frame bumps
+    ``mesh.decode_rejects``, and the agent keeps serving."""
+    from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+    from hlsjs_p2p_wrapper_tpu.engine.p2p_agent import P2PAgent
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+    from hlsjs_p2p_wrapper_tpu.engine.transport import LoopbackNetwork
+    from hlsjs_p2p_wrapper_tpu.testing.seed_process import (
+        InstantCdn, NullBridge, NullMediaMap)
+
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=1.0)
+    registry = MetricsRegistry()
+    agent = P2PAgent(
+        NullBridge(), "http://cdn.example/master.m3u8", NullMediaMap(),
+        {"network": net, "clock": clock,
+         "cdn_transport": InstantCdn(16), "peer_id": "victim",
+         "content_id": "fuzz-mesh", "metrics_registry": registry},
+        SegmentView, "hls", "v2")
+    try:
+        evil = net.register("evil")
+        hostile = [
+            b"",                                   # empty
+            b"\xff\xff\xff\xff",                   # bad magic
+            P.encode(P.Request(1, key()))[:-1],    # truncated request
+            P._frame(P.MsgType.CHUNK, b"\x01"),    # short chunk header
+            P._frame(P.MsgType.HELLO, BAD + GOOD),  # hostile UTF-8
+            P._frame(0x6F, b"??"),                 # unknown type
+        ]
+        for frame in hostile:
+            evil.send("victim", frame)
+        clock.advance(20.0)
+        assert registry.counter("mesh.decode_rejects").value \
+            == len(hostile)
+        # the dispatch thread survived: a VALID handshake still lands
+        evil.send("victim", P.encode(P.Hello(agent.swarm_id, "evil")))
+        clock.advance(20.0)
+        assert "evil" in agent.mesh.peers
+        assert agent.mesh.peers["evil"].handshaked
+    finally:
+        agent.dispose()

@@ -285,15 +285,29 @@ CLOCK_FILES = (
     os.path.join("hlsjs_p2p_wrapper_tpu", "ops", "swarm_sim.py"),
 )
 
+#: the transports (round 10): these ALSO flag naked
+#: ``time.monotonic()`` calls — reconnect backoff, circuit cooldowns,
+#: and the idle-probe deadline must route through the injectable
+#: ReconnectPolicy clock/sleep or the self-heal tests need real
+#: waits; the legitimately-wall-clock sites (socket/handshake
+#: deadlines, the NetLoop clock itself, eviction hints) carry
+#: ``# clock-ok:`` annotations naming why
+CLOCK_STRICT_FILES = (
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "net.py"),
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "transport.py"),
+)
 
-def check_clock_discipline(path):
+
+def check_clock_discipline(path, strict=False):
     """Injectable-clock discipline for the fabric and the dispatch
     path: no naked ``time.time()`` / ``time.sleep()`` CALLS — both
     must flow through the injectable ``clock``/``sleep`` callables
     (default-argument REFERENCES like ``clock=time.time`` are the
     injection points themselves and stay legal; ``perf_counter``
     spans are measurement, not control flow, and are not flagged).
-    ``# clock-ok: <why>`` is the inline escape."""
+    ``strict`` (the transports) additionally flags
+    ``time.monotonic()``, whose socket-deadline uses there are legal
+    but must say so.  ``# clock-ok: <why>`` is the inline escape."""
     findings = []
     with open(path, encoding="utf-8") as fh:
         source = fh.read()
@@ -301,13 +315,15 @@ def check_clock_discipline(path):
         tree = ast.parse(source, filename=path)
     except SyntaxError:
         return []  # check_file already reports the syntax error
+    attrs = ("time", "sleep", "monotonic") if strict \
+        else ("time", "sleep")
     lines = source.splitlines()
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         func = node.func
         if not (isinstance(func, ast.Attribute)
-                and func.attr in ("time", "sleep")
+                and func.attr in attrs
                 and isinstance(func.value, ast.Name)
                 and func.value.id == "time"):
             continue
@@ -548,6 +564,9 @@ def main(argv=None):
             all_findings.extend(check_broad_excepts(path))
         if path.endswith(CLOCK_FILES):
             all_findings.extend(check_clock_discipline(path))
+        if path.endswith(CLOCK_STRICT_FILES):
+            all_findings.extend(check_clock_discipline(path,
+                                                       strict=True))
         if path.endswith(TRAFFIC_FILE):
             all_findings.extend(check_traffic_discipline(path))
     all_findings.extend(check_static_knobs(

@@ -24,7 +24,16 @@ Deterministic (seeded RNG + VirtualClock; exported timestamps are
 simulated ms).  ~35 s of wall clock for ~5 simulated minutes with
 ~36 churned viewers.
 
-Usage: ``python tools/soak.py [--rounds N] [--seed S]
+``--chaos`` layers a seeded :class:`NetFaultPlan` schedule
+(engine/netfaults.py) over the churn: loss, latency-spike, and
+partition WINDOWS drive the LoopbackNetwork's existing knobs on the
+soak's own VirtualClock, every injection counted as
+``mesh.transport_faults{kind}`` into the exported registry.  The
+artifact-derived invariants still run — the swarm must stay healthy
+THROUGH the schedule, and the transport-fault families must appear in
+the export (a chaos soak whose schedule never fired is red).
+
+Usage: ``python tools/soak.py [--rounds N] [--seed S] [--chaos]
 [--metrics-out SOAK_local.jsonl]``
 """
 
@@ -58,12 +67,27 @@ def main() -> int:
                         metavar="FILE",
                         help="JSON-lines metrics artifact (one line "
                              "per churn round; overwritten per run)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="run the churn under a seeded transport "
+                             "fault schedule (loss/latency/partition "
+                             "windows on the VirtualClock)")
     args = parser.parse_args()
 
     t0 = time.time()
     rng = random.Random(args.seed)
+    # windows in simulated seconds from the soak's t=0: a loss band
+    # mid-warmup churn, a latency spike band, and a partition band —
+    # each long enough to span several churn rounds, with healthy
+    # time before, between, and after (recovery must be visible)
+    chaos_specs = ("loss@40-80,latency@110-150,partition@180-200"
+                   if args.chaos else None)
     swarm = SwarmHarness(cdn_bandwidth_bps=40_000_000.0, live=True,
-                         frag_count=200, seg_duration=4.0)
+                         frag_count=200, seg_duration=4.0,
+                         fault_plan_specs=chaos_specs,
+                         fault_plan_kwargs={
+                             "seed": args.seed, "loss_rate": 0.15,
+                             "latency_ms": 120.0,
+                             "partition_fraction": 0.2})
     # the soak runs the "adaptive" policy deliberately: under the
     # "spread" default the penalty map is empty BY CONSTRUCTION
     # (mesh._penalize_holder is a no-op), which would make the
@@ -188,6 +212,20 @@ def main() -> int:
           "tracker.announces missing from the export")
     check(any(k.startswith("mesh.reaps") for k in final),
           "mesh reap counters missing from the export")
+    if args.chaos:
+        # the schedule must have RUN (a chaos soak whose windows
+        # never fired proves nothing), observable from the artifact:
+        # the injection counters are in the exported registry
+        check(swarm.fault_plan.remaining() == [],
+              f"chaos windows never all fired: "
+              f"{swarm.fault_plan.remaining()}")
+        for kind in ("loss", "latency", "partition"):
+            check(series_sum(final,
+                             f"mesh.transport_faults{{kind={kind}}}")
+                  > 0,
+                  f"mesh.transport_faults{{kind={kind}}} missing "
+                  f"from the export")
+        print(f"chaos schedule fired: {swarm.fault_plan.schedule()}")
     if failures:
         for what in failures:
             print(f"SOAK FAILURE: {what}", file=sys.stderr)
