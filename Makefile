@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
 	coverage soak scaling-artifact warmstart-gate chaos-gate \
-	fleet-gate trace-gate tracker-gate net-chaos-gate
+	fleet-gate trace-gate tracker-gate net-chaos-gate optimize-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -131,6 +131,19 @@ tracker-gate:
 net-chaos-gate:
 	$(PY) tools/net_chaos_gate.py
 
+# process-level proof for the closed-loop policy search plane
+# (engine/search.py, tools/optimize.py): on the 144-pt live family,
+# a successive-halving search with a budget under 50% of exhaustive
+# must discover a config whose offload >= the best feasible
+# uniform-grid point's (rebuffer constraint respected), a same-seed
+# rerun must reproduce the identical frontier with zero fresh
+# dispatches and zero XLA compiles against the warm cache, and a
+# SIGKILLed search must --resume bit-identically with every
+# journaled row served from the layer-2 row cache.
+# OPTIMIZE_GATE_PEERS etc. scale it up on accelerator hosts.
+optimize-gate:
+	$(PY) tools/optimize_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -140,6 +153,6 @@ examples:
 	$(PY) examples/production_demo.py
 
 check: lint test dryrun warmstart-gate chaos-gate fleet-gate \
-	trace-gate tracker-gate net-chaos-gate
+	trace-gate tracker-gate net-chaos-gate optimize-gate
 
 all: check bench

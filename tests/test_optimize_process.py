@@ -1,0 +1,117 @@
+"""Process-level crash-safe policy search: SIGKILL a real
+``tools/optimize.py`` run mid-screen (the fault plane's ``kill``
+injection, so the death lands at a known chunk), rerun with
+``--resume``, and hold the tool to its contract — the frontier and
+every trial VALUE are bit-identical to an uninterrupted run, the
+rows journaled before the kill are replayed from the layer-2 row
+cache (round-0 provenance says so), and nothing is lost or doubled.
+
+This is the subprocess half of the search-plane suite: the
+driver/orchestrator mechanics (determinism, checkpoint round-trips,
+constraint edge cases) are pinned in-process by tests/test_search.py,
+and the full acceptance chain (budget vs exhaustive, zero-compile
+assertions on the warm cache) runs as ``make optimize-gate``."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: gate-sized search: the 144-pt live lattice at a tiny swarm, chunk
+#: pinned to 8 → the 144-point screen is 18 chunks; the kill lands at
+#: chunk 5, by which point chunks 0-3 have drained and journaled
+#: (the pipelined drain runs one chunk behind the dispatch)
+ARGS = ["--peers", "16", "--segments", "8", "--watch-s", "8",
+        "--chunk", "8", "--budget", "66", "--seed", "0"]
+
+
+def run_optimize(cache_dir, out, *extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "optimize.py"),
+         *ARGS, "--cache-dir", str(cache_dir), "--out", str(out),
+         *extra],
+        capture_output=True, text=True, cwd=_REPO, env=env)
+
+
+from hlsjs_p2p_wrapper_tpu.engine.search import (  # noqa: E402
+    scrub_provenance as scrub)
+
+
+def test_sigkilled_search_resumes_bit_exact(tmp_path):
+    # 1. the uninterrupted reference, against its own cache (the
+    # killed/resumed run must not be able to borrow its rows)
+    ref_proc = run_optimize(tmp_path / "cache_ref",
+                            tmp_path / "ref.json")
+    assert ref_proc.returncode == 0, ref_proc.stderr
+    ref = json.loads((tmp_path / "ref.json").read_text())
+    assert ref["spent"] < 72  # under half of exhaustive (144)
+
+    # 2. the same search, SIGKILLed at screen chunk 5: the process
+    # dies hard — no artifact, but the journal holds chunks 0-3
+    cache = tmp_path / "cache_run"
+    killed = run_optimize(cache, tmp_path / "out.json",
+                          "--inject-faults", "kill@0:5")
+    assert killed.returncode == -signal.SIGKILL, killed.stderr
+    assert not (tmp_path / "out.json").exists()
+    journals = [name for name in os.listdir(cache / "journals")
+                if name.endswith(".jsonl")]
+    assert len(journals) == 1
+    journal_lines = [json.loads(line) for line in
+                     (cache / "journals" / journals[0])
+                     .read_text().splitlines() if line.strip()]
+    journaled = [rec for rec in journal_lines
+                 if rec.get("kind") == "row"]
+    assert len(journaled) == 32  # four 8-point screen chunks drained
+    assert not any(rec.get("kind") == "done" for rec in journal_lines)
+    # the kill landed mid-round, before the first checkpoint
+    assert not os.path.isdir(cache / "searches") or not os.listdir(
+        cache / "searches")
+
+    # 3. --resume: re-asks the in-flight round deterministically and
+    # serves the journaled rows from the row cache
+    resumed = run_optimize(cache, tmp_path / "out.json", "--resume")
+    assert resumed.returncode == 0, resumed.stderr
+    assert "journal lists 32 completed rows" in resumed.stderr
+    out = json.loads((tmp_path / "out.json").read_text())
+
+    # the frontier and every trial VALUE are bit-identical to the
+    # uninterrupted run (full-precision floats round-trip JSON)
+    assert scrub(out["frontier"]) == scrub(ref["frontier"])
+    assert scrub(out["trials"]) == scrub(ref["trials"])
+    assert out["rounds"][-1]["best_offload"] == \
+        ref["rounds"][-1]["best_offload"]
+
+    # journaled rows were NOT re-dispatched: round 0's provenance
+    # counts them all as layer-2 row-cache hits, and only the rest
+    # dispatched fresh
+    assert out["meta"]["journal_preloaded"] == len(journaled)
+    assert out["rounds"][0]["row_cache_hits"] == len(journaled)
+    assert out["rounds"][0]["fresh_dispatches"] == \
+        ref["rounds"][0]["fresh_dispatches"] - len(journaled)
+
+    # the resumed completion finalized the journal
+    final_lines = (cache / "journals" / journals[0]).read_text()
+    assert '"done"' in final_lines
+
+
+def test_fresh_runs_share_rows_through_the_cache(tmp_path):
+    """Two same-seed runs against one cache: the second performs
+    zero fresh dispatches (every trial a row-cache hit) and zero
+    XLA compiles, and reports the identical frontier — the
+    warm-rerun half of the determinism contract, one process
+    deep."""
+    cache = tmp_path / "cache"
+    first = run_optimize(cache, tmp_path / "a.json")
+    assert first.returncode == 0, first.stderr
+    second = run_optimize(cache, tmp_path / "b.json")
+    assert second.returncode == 0, second.stderr
+    a = json.loads((tmp_path / "a.json").read_text())
+    b = json.loads((tmp_path / "b.json").read_text())
+    assert scrub(a["frontier"]) == scrub(b["frontier"])
+    assert scrub(a["trials"]) == scrub(b["trials"])
+    assert sum(r["fresh_dispatches"] for r in b["rounds"]) == 0
+    assert b["meta"]["xla_compiles"] == 0

@@ -1,0 +1,599 @@
+"""engine/search.py: the closed-loop policy search plane's driver
+protocol must be deterministic in (seed, tells), checkpoint/resume
+must replay bit-identically, constraint handling must keep and label
+infeasible points (all-infeasible and objective-tie edge cases
+included), the grid analysis must find 1-D flips and AND-shaped
+interactions, and the seeded-RNG lint rule must hold the module to
+its own contract.  All in-process on synthetic evaluators — the
+process-level half (SIGKILL + --resume against the real dispatch
+engine) lives in tests/test_optimize_process.py and
+``make optimize-gate``."""
+
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+from hlsjs_p2p_wrapper_tpu.engine.search import (  # noqa: E402
+    CategoricalAxis, CmaEsDriver, Constraint, ContinuousAxis,
+    GridDriver, GridRefineDriver, HalvingDriver, PolicySearch,
+    RandomDriver, SearchSpace, grid_flips, grid_interactions,
+    pareto_front, rank_key, search_checkpoint_path)
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import (  # noqa: E402
+    MetricsRegistry)
+
+
+def space2d():
+    return SearchSpace(
+        continuous=(ContinuousAxis("x", 0.0, 1.0),
+                    ContinuousAxis("y", 0.0, 2.0)),
+        categorical=(CategoricalAxis("mode", ("a", "b")),),
+        fixed={"degree": 8})
+
+
+def lattice2d(nx=4, ny=4):
+    return [{"x": i / (nx - 1), "y": 2.0 * j / (ny - 1), "mode": 0}
+            for i in range(nx) for j in range(ny)]
+
+
+def synthetic_evaluate(space, *, objective=None, constraint_fn=None):
+    """A host-arithmetic evaluator: offload = ``objective(knobs)``,
+    rebuffer = ``constraint_fn(knobs)`` — deterministic, instant."""
+    objective = objective or (lambda k: 1.0 - (k["x"] - 0.6) ** 2
+                              - (k["y"] / 2.0 - 0.4) ** 2)
+    constraint_fn = constraint_fn or (lambda k: 0.0)
+
+    def evaluate(proposals, round_index):
+        out = []
+        for prop in proposals:
+            knobs = space.materialize(prop["point"])
+            out.append({"point": dict(prop["point"]),
+                        "fidelity": prop["fidelity"],
+                        "knobs": knobs,
+                        "offload": float(objective(knobs)),
+                        "rebuffer": float(constraint_fn(knobs)),
+                        "failed": False, "cached": False})
+        return out
+    return evaluate
+
+
+# -- space / constraint / ranking ---------------------------------------
+
+def test_space_materialize_merges_fixed_and_categorical():
+    sp = SearchSpace(
+        continuous=(ContinuousAxis("x", 0.0, 1.0),),
+        categorical=(CategoricalAxis("supply", (
+            {"uplink_mbps": 1.2, "cdn_mbps": 1.2},
+            {"uplink_mbps": 10.0, "cdn_mbps": 8.0})),),
+        fixed={"degree": 8})
+    knobs = sp.materialize({"x": 0.25, "supply": 1})
+    assert knobs == {"degree": 8, "x": 0.25,
+                     "uplink_mbps": 10.0, "cdn_mbps": 8.0}
+
+
+def test_space_unit_roundtrip():
+    sp = space2d()
+    point = {"x": 0.3, "y": 1.4, "mode": 1}
+    unit = sp.to_unit(point)
+    back = sp.from_unit(unit, {"mode": 1})
+    assert back["x"] == pytest.approx(0.3)
+    assert back["y"] == pytest.approx(1.4)
+    assert back["mode"] == 1
+
+
+def test_constraint_parse_and_feasibility():
+    c = Constraint.parse("rebuffer<=0.02")
+    assert c.metric == "rebuffer" and c.bound == 0.02
+    assert c.feasible({"rebuffer": 0.02})
+    assert not c.feasible({"rebuffer": 0.0201})
+    assert not c.feasible({"rebuffer": None})
+    assert c.violation({"rebuffer": 0.05}) == pytest.approx(0.03)
+    with pytest.raises(ValueError):
+        Constraint.parse("rebuffer>0.02")
+
+
+def test_rank_key_orders_feasible_then_violation_then_failed():
+    c = Constraint("rebuffer", 0.02)
+    feas_hi = {"offload": 0.5, "rebuffer": 0.01}
+    feas_lo = {"offload": 0.3, "rebuffer": 0.0}
+    infeas_close = {"offload": 0.9, "rebuffer": 0.03}
+    infeas_far = {"offload": 0.9, "rebuffer": 0.5}
+    failed = {"offload": None, "rebuffer": None, "failed": True}
+    ranked = sorted([failed, infeas_far, feas_lo, infeas_close,
+                     feas_hi], key=lambda t: rank_key(t, c))
+    assert ranked == [feas_hi, feas_lo, infeas_close, infeas_far,
+                      failed]
+
+
+def test_rank_key_tie_on_objective_prefers_lower_metric():
+    c = Constraint("rebuffer", 0.02)
+    a = {"offload": 0.5, "rebuffer": 0.015}
+    b = {"offload": 0.5, "rebuffer": 0.001}
+    assert rank_key(b, c) < rank_key(a, c)
+
+
+def test_pareto_front_keeps_infeasible_side_labeled():
+    c = Constraint("rebuffer", 0.02)
+    trials = [
+        {"offload": 0.4, "rebuffer": 0.0, "feasible": True},
+        {"offload": 0.5, "rebuffer": 0.01, "feasible": True},
+        {"offload": 0.45, "rebuffer": 0.015, "feasible": True},
+        {"offload": 0.9, "rebuffer": 0.1, "feasible": False},
+    ]
+    front = pareto_front(trials, c)
+    assert trials[3] in front       # infeasible but non-dominated
+    assert trials[2] not in front   # dominated by trials[1]
+    assert front[0]["offload"] == 0.9
+
+
+# -- driver determinism / state round-trips -----------------------------
+
+def hex_points(proposals):
+    return [[float(p["point"]["x"]).hex(), float(p["point"]["y"]).hex(),
+             p["point"]["mode"], float(p["fidelity"]).hex()]
+            for p in proposals]
+
+
+def test_random_driver_same_seed_same_sequence():
+    sp = space2d()
+    a = RandomDriver(sp, seed=7).ask(32)
+    b = RandomDriver(sp, seed=7).ask(32)
+    assert hex_points(a) == hex_points(b)
+    c = RandomDriver(sp, seed=8).ask(32)
+    assert hex_points(a) != hex_points(c)
+
+
+def test_random_driver_state_resumes_mid_sequence():
+    sp = space2d()
+    ref = RandomDriver(sp, seed=3)
+    whole = ref.ask(20)
+    first = RandomDriver(sp, seed=3)
+    head = first.ask(8)
+    resumed = RandomDriver(sp, seed=3)
+    resumed.load_state(first.state())
+    tail = resumed.ask(12)
+    assert hex_points(head + tail) == hex_points(whole)
+
+
+def test_cmaes_same_seed_same_generations():
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    ev = synthetic_evaluate(sp)
+
+    def drive(seed, gens):
+        drv = CmaEsDriver(sp, seed=seed, popsize=6, constraint=c)
+        seq = []
+        for _ in range(gens):
+            props = drv.ask(99)
+            seq.extend(hex_points(props))
+            drv.tell(ev(props, 0))
+        return seq
+
+    assert drive(5, 3) == drive(5, 3)
+    assert drive(5, 3) != drive(6, 3)
+
+
+def test_cmaes_state_roundtrip_branches_identically():
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    ev = synthetic_evaluate(sp)
+    drv = CmaEsDriver(sp, seed=11, popsize=6, constraint=c)
+    drv.tell(ev(drv.ask(99), 0))
+    snap = json.loads(json.dumps(drv.state()))  # through JSON
+    cont = drv.ask(99)
+    branched = CmaEsDriver(sp, seed=11, popsize=6, constraint=c)
+    branched.load_state(snap)
+    assert hex_points(branched.ask(99)) == hex_points(cont)
+
+
+def test_cmaes_improves_on_a_smooth_objective():
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    ev = synthetic_evaluate(sp)
+    drv = CmaEsDriver(sp, seed=2, popsize=8, constraint=c)
+    first_best = None
+    best = None
+    for _ in range(12):
+        props = drv.ask(99)
+        trials = ev(props, 0)
+        drv.tell(trials)
+        top = max(t["offload"] for t in trials)
+        if first_best is None:
+            first_best = top
+        best = top if best is None else max(best, top)
+    assert best > first_best  # the optimum (1.0 at x=.6, y=.8) pulls
+    assert best > 0.99
+
+
+def test_cmaes_requires_two_continuous_axes():
+    with pytest.raises(ValueError):
+        CmaEsDriver(SearchSpace(
+            continuous=(ContinuousAxis("x", 0.0, 1.0),)), seed=0)
+
+
+def test_cmaes_ask_rejects_sub_generation_batches():
+    drv = CmaEsDriver(space2d(), seed=0, popsize=6)
+    with pytest.raises(ValueError, match="whole generations"):
+        drv.ask(4)
+
+
+def test_cmaes_partial_tell_drops_and_redraws_the_generation():
+    """A budget-truncated generation must not freeze the driver: the
+    partial tell drops the generation without a covariance update,
+    and the next ask redraws the SAME (seed, gen)-derived points —
+    whose evaluated prefix comes back as row-cache hits."""
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    ev = synthetic_evaluate(sp)
+    drv = CmaEsDriver(sp, seed=0, popsize=6, constraint=c)
+    gen = drv.ask(6)
+    drv.tell(ev(gen[:3], 0))  # truncated: only half came back
+    again = drv.ask(6)
+    assert hex_points(again) == hex_points(gen)
+    drv.tell(ev(again, 0))  # a full tell advances normally
+    assert drv.gen == 1 and drv.ask(6)
+
+
+def test_halving_promotes_the_constraint_aware_top():
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    # objective = x; x >= 0.9 violates the constraint, so the best
+    # FEASIBLE x must win, not the best raw x
+    ev = synthetic_evaluate(
+        sp, objective=lambda k: k["x"],
+        constraint_fn=lambda k: 0.05 if k["x"] >= 0.9 else 0.0)
+    lattice = [{"x": i / 10.0, "y": 1.0, "mode": 0}
+               for i in range(11)]
+    drv = HalvingDriver(sp, seed=0, initial=lattice, rungs=2,
+                        eta=4.0, fidelities=[0.25, 1.0],
+                        constraint=c)
+    search = PolicySearch(drv, ev, c, budget=100, batch=64)
+    result = search.run()
+    best = result["frontier"]["best"]
+    assert best["knobs"]["x"] == pytest.approx(0.8)
+    assert best["feasible"]
+    # infeasible trials were kept and labeled, never dropped
+    infeasible = [t for t in result["trials"]
+                  if not t["feasible"] and not t["failed"]]
+    assert {t["knobs"]["x"] for t in infeasible
+            if t["fidelity"] >= 1.0} <= {0.9, 1.0}
+    # the screen rung cost a quarter per point
+    assert result["rounds"][0]["cost"] == pytest.approx(11 * 0.25)
+
+
+def test_halving_same_seed_same_frontier_and_checkpoint_resume(
+        tmp_path):
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    ev = synthetic_evaluate(sp)
+    lattice = lattice2d()
+
+    def run(path=None, interrupt_after=None):
+        drv = HalvingDriver(sp, seed=1, initial=lattice, rungs=2,
+                            eta=4.0, constraint=c)
+        search = PolicySearch(
+            drv, ev, c, budget=100, batch=6,
+            checkpoint_path=path, checkpoint_meta={"case": "t"})
+        if interrupt_after is None:
+            return search.run()
+        # drive only a few rounds, checkpointing each — the
+        # "SIGKILL between rounds" model
+        for _ in range(interrupt_after):
+            props = search._trim_to_budget(search.driver.ask(6))
+            trials = search.evaluate(props, search.round)
+            for t in trials:
+                t["round"] = search.round
+                t["feasible"] = c.feasible(t)
+            search.driver.tell(trials)
+            search.trials.extend(trials)
+            search.spent += sum(p["fidelity"] for p in props)
+            search.rounds.append({"round": search.round,
+                                  "driver": drv.name,
+                                  "proposals": len(props),
+                                  "cost": 0, "fresh_dispatches": 0,
+                                  "row_cache_hits": 0, "failed": 0,
+                                  "infeasible": 0, "spent": 0,
+                                  "best_offload": None})
+            search.round += 1
+            search.checkpoint()
+        return None
+
+    ref = run()
+    path = str(tmp_path / "ckpt.json")
+    run(path=path, interrupt_after=3)
+    drv = HalvingDriver(sp, seed=1, initial=lattice, rungs=2,
+                        eta=4.0, constraint=c)
+    resumed = PolicySearch(drv, ev, c, budget=100, batch=6,
+                           checkpoint_path=path,
+                           checkpoint_meta={"case": "t"})
+    assert resumed.resume()
+    assert resumed.round == 3
+    result = resumed.run()
+    assert json.dumps(result["frontier"]) == \
+        json.dumps(ref["frontier"])
+    assert [t["point"] for t in result["trials"]] == \
+        [t["point"] for t in ref["trials"]]
+
+
+def test_checkpoint_digest_mismatch_refuses(tmp_path):
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    ev = synthetic_evaluate(sp)
+    path = str(tmp_path / "ckpt.json")
+    search = PolicySearch(GridDriver(sp, initial=lattice2d()), ev, c,
+                          budget=100, batch=99,
+                          checkpoint_path=path,
+                          checkpoint_meta={"seed": 0})
+    search.run()
+    other = PolicySearch(GridDriver(sp, initial=lattice2d()), ev, c,
+                         budget=100, batch=99,
+                         checkpoint_path=path,
+                         checkpoint_meta={"seed": 1})
+    with pytest.raises(ValueError, match="different search"):
+        other.resume()
+
+
+def test_search_checkpoint_path_is_content_addressed(tmp_path):
+    a = search_checkpoint_path(str(tmp_path), {"seed": 0})
+    b = search_checkpoint_path(str(tmp_path), {"seed": 1})
+    assert a != b
+    assert a.startswith(os.path.join(str(tmp_path), "searches"))
+
+
+# -- constraint edge cases ----------------------------------------------
+
+def test_all_infeasible_reports_least_violating_not_a_winner():
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    ev = synthetic_evaluate(
+        sp, objective=lambda k: k["x"],
+        constraint_fn=lambda k: 0.1 + k["x"] * 0.1)  # never <= 0.02
+    search = PolicySearch(GridDriver(sp, initial=lattice2d()), ev, c,
+                          budget=100, batch=99)
+    result = search.run()
+    frontier = result["frontier"]
+    assert frontier["best"] is None
+    assert frontier["feasible"] == 0
+    assert frontier["infeasible"] == len(lattice2d())
+    least = frontier["least_violating"]
+    assert least is not None
+    assert least["knobs"]["x"] == pytest.approx(0.0)  # lowest viol.
+    # every infeasible trial is present and labeled
+    assert all(not t["feasible"] for t in result["trials"])
+
+
+def test_objective_tie_resolves_deterministically():
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    # two feasible points tie on offload; lower rebuffer must win
+    ev = synthetic_evaluate(
+        sp, objective=lambda k: 0.5,
+        constraint_fn=lambda k: 0.001 if k["x"] < 0.5 else 0.01)
+    points = [{"x": 0.9, "y": 1.0, "mode": 0},
+              {"x": 0.1, "y": 1.0, "mode": 0}]
+    search = PolicySearch(GridDriver(sp, initial=points), ev, c,
+                          budget=10, batch=10)
+    best = search.run()["frontier"]["best"]
+    assert best["knobs"]["x"] == pytest.approx(0.1)
+    # exact tie on BOTH metrics: evaluation order breaks it, stably
+    ev2 = synthetic_evaluate(sp, objective=lambda k: 0.5,
+                             constraint_fn=lambda k: 0.001)
+    search2 = PolicySearch(GridDriver(sp, initial=points), ev2, c,
+                           budget=10, batch=10)
+    best2 = search2.run()["frontier"]["best"]
+    assert best2["point"] == points[0]  # first evaluated wins
+
+
+def test_failed_trials_are_labeled_and_counted():
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+
+    def evaluate(proposals, round_index):
+        out = []
+        for i, prop in enumerate(proposals):
+            knobs = sp.materialize(prop["point"])
+            if i == 0:
+                out.append({"point": dict(prop["point"]),
+                            "fidelity": prop["fidelity"],
+                            "knobs": knobs, "offload": None,
+                            "rebuffer": None, "failed": True,
+                            "cached": False, "reason": "oom"})
+            else:
+                out.append({"point": dict(prop["point"]),
+                            "fidelity": prop["fidelity"],
+                            "knobs": knobs, "offload": 0.1,
+                            "rebuffer": 0.0, "failed": False,
+                            "cached": False})
+        return out
+
+    registry = MetricsRegistry()
+    search = PolicySearch(GridDriver(sp, initial=lattice2d(2, 2)),
+                          evaluate, c, budget=10, batch=10,
+                          registry=registry)
+    result = search.run()
+    assert result["frontier"]["failed"] == 1
+    assert result["rounds"][0]["failed"] == 1
+    fams = {labels["source"]: v for labels, v in
+            registry.series("search_evals")}
+    assert fams["failed"] == 1
+    assert fams["dispatch"] == 3
+
+
+def test_budget_counts_proposed_work_and_trims():
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    ev = synthetic_evaluate(sp)
+    search = PolicySearch(RandomDriver(sp, seed=0), ev, c,
+                          budget=10, batch=4)
+    result = search.run()
+    assert result["spent"] == pytest.approx(10.0)
+    assert len(result["trials"]) == 10
+    assert [r["proposals"] for r in result["rounds"]] == [4, 4, 2]
+
+
+def test_search_counters_emit(tmp_path):
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    registry = MetricsRegistry()
+    search = PolicySearch(
+        GridDriver(sp, initial=lattice2d(2, 2)),
+        synthetic_evaluate(sp), c, budget=10, batch=10,
+        registry=registry,
+        checkpoint_path=str(tmp_path / "c.json"),
+        checkpoint_meta={"m": 1})
+    search.run()
+    snap = registry.snapshot()
+    assert snap["search_rounds{driver=grid}"] == 1
+    assert snap["search_evals{source=dispatch}"] == 4
+    assert snap["search_checkpoints"] == 1
+    assert snap["search_budget_spent"] == pytest.approx(4.0)
+    assert "search_best_offload" in snap
+
+
+# -- the grid analysis + refiner ----------------------------------------
+
+def test_grid_flips_finds_the_boundary_axis():
+    points = [{"x": x, "y": y} for x in (0.0, 0.5, 1.0)
+              for y in (0.0, 1.0)]
+    flagged = {i for i, p in enumerate(points) if p["x"] >= 1.0}
+    flips = grid_flips(points, ["x", "y"], flagged)
+    assert all(f["axis"] == "x" for f in flips)
+    assert len(flips) == 2  # one per y line
+    assert all(f["healthy_value"] == 0.5
+               and f["flagged_value"] == 1.0 for f in flips)
+
+
+def test_grid_interactions_finds_the_and_corner():
+    points = [{"x": x, "y": y} for x in (0.0, 1.0)
+              for y in (0.0, 1.0)]
+    flagged = {3}  # only (1, 1)
+    inter = grid_interactions(points, ["x", "y"], flagged)
+    assert len(inter) == 1
+    assert inter[0]["axes"] == ["x", "y"]
+    assert inter[0]["flagged_point"] == 3
+    assert inter[0]["base_point"] == 0
+    # a single-axis pathology is NOT an interaction
+    assert grid_interactions(points, ["x", "y"], {2, 3}) == []
+
+
+def test_refiner_densifies_the_flip_edge():
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    # feasibility boundary at x = 0.55: lattice points at 1/3 and
+    # 2/3 straddle it, so the refiner must propose midpoints whose
+    # x walks toward the boundary
+    ev = synthetic_evaluate(
+        sp, objective=lambda k: k["x"],
+        constraint_fn=lambda k: 0.05 if k["x"] > 0.55 else 0.0)
+    drv = GridRefineDriver(sp, seed=0, initial=lattice2d(),
+                           max_per_round=32)
+    search = PolicySearch(drv, ev, c, budget=200, batch=32)
+    result = search.run()
+    assert "refined_edges" in result
+    edges = result["refined_edges"]["x"]
+    assert edges, "the x axis must carry flip edges"
+    for edge in edges:
+        assert edge["lo"] <= 0.55 <= edge["hi"] or \
+            edge["hi"] - edge["lo"] < 1.0 / 3.0
+    # refined proposals actually landed between lattice x values
+    refined = [t for t in result["trials"] if t["round"] > 0]
+    assert refined
+    lattice_xs = {p["x"] for p in lattice2d()}
+    assert any(t["point"]["x"] not in lattice_xs for t in refined)
+    # and the refinement tightened the located boundary: some
+    # refined x sits within one bisection of 0.55
+    assert min(abs(t["point"]["x"] - 0.55) for t in refined) < 1.0 / 6
+
+
+def test_refiner_proposes_the_interaction_diagonal():
+    sp = space2d()
+    c = Constraint("rebuffer", 0.02)
+    # AND-shaped infeasibility: only (x high AND y high) violates
+    ev = synthetic_evaluate(
+        sp, objective=lambda k: 0.5,
+        constraint_fn=lambda k: (0.05 if (k["x"] > 0.8
+                                          and k["y"] > 1.5)
+                                 else 0.0))
+    lattice = [{"x": x, "y": y, "mode": 0}
+               for x in (0.0, 1.0) for y in (0.0, 2.0)]
+    drv = GridRefineDriver(sp, seed=0, initial=lattice,
+                           max_per_round=16)
+    search = PolicySearch(drv, ev, c, budget=50, batch=16)
+    result = search.run()
+    assert result["interactions"], "the AND corner must be reported"
+    inter = result["interactions"][0]
+    assert inter["axes"] == ["x", "y"]
+    # the diagonal midpoint between flagged (1, 2) and base (0, 0)
+    # was proposed and evaluated
+    assert any(t["round"] > 0
+               and t["point"]["x"] == pytest.approx(0.5)
+               and t["point"]["y"] == pytest.approx(1.0)
+               for t in result["trials"])
+
+
+# -- the tool-facing lattice --------------------------------------------
+
+def test_live_lattice_matches_the_shipped_live_grid():
+    """tools/optimize.py's lattice must materialize knob-for-knob to
+    tools/sweep.py's 144-pt live grid — that is what makes lattice
+    rows shared row-cache entries and the gate's uniform baseline
+    the genuine article."""
+    import optimize as opt
+    import sweep as sweep_tool
+    space = opt.live_space()
+    lattice = [space.materialize(p) for p in opt.live_lattice()]
+    grid = sweep_tool.live_grid()
+    assert len(lattice) == len(grid) == 144
+    for ours, theirs in zip(lattice, grid):
+        assert ours == theirs
+
+
+def test_search_meta_covers_driver_hyperparams():
+    """Two searches differing only in a driver hyperparameter must
+    not share a journal/checkpoint identity — the resume refusal
+    depends on the digest seeing them."""
+    import optimize as opt
+    from hlsjs_p2p_wrapper_tpu.engine.search import Constraint as C
+    space = opt.live_space()
+    c = C("rebuffer", 0.02)
+    base = opt.build_parser().parse_args([])
+    for flags in (["--eta", "4"], ["--rungs", "3"],
+                  ["--screen-fidelity", "0.5"], ["--popsize", "9"],
+                  ["--sigma0", "0.5"], ["--pin", "supply=2"]):
+        other = opt.build_parser().parse_args(flags)
+        assert opt.search_meta(base, space, c) != \
+            opt.search_meta(other, space, c), flags
+
+
+# -- the seeded-RNG lint rule -------------------------------------------
+
+def test_rng_lint_rule(tmp_path):
+    import lint as lint_tool
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import random\nimport numpy as np\n"
+        "a = random.random()\n"
+        "b = np.random.rand(3)\n"
+        "c = np.random.default_rng()\n"          # unseeded!
+        "d = np.random.default_rng(7)\n"          # fine
+        "e = np.random.Generator(np.random.PCG64(7))\n"  # fine
+        "f = np.random.shuffle([1])  # rng-ok: test escape\n")
+    findings = lint_tool.check_rng_discipline(str(bad))
+    assert len(findings) == 3
+    assert any("random.random" in f for f in findings)
+    assert any("np.random.rand" in f for f in findings)
+    assert any("np.random.default_rng" in f for f in findings)
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import numpy as np\n"
+        "rng = np.random.default_rng([seed, 3])\n"
+        "x = rng.standard_normal(4)\n")
+    assert lint_tool.check_rng_discipline(str(good)) == []
+    # the shipped module holds its own rule
+    assert lint_tool.check_rng_discipline(os.path.join(
+        _REPO, "hlsjs_p2p_wrapper_tpu", "engine", "search.py")) == []

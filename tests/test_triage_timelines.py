@@ -335,7 +335,72 @@ def test_grid_triage_finds_the_flipping_axis():
                for up in (1.2, 2.4) for w in ("steady", "crowd")]
     assert triage.grid_triage(healthy,
                               triage.triage_records(healthy)) == \
-        {"axes": {}, "flips": []}
+        {"axes": {}, "flips": [],
+         "interactions": {"pairs": {}, "flips": []}}
+
+
+def and_grid_records():
+    """A 2×2 synthetic grid with an AND-SHAPED pathology: only the
+    (knob_a=2, knob_b=2) corner stalls — flipping either knob alone
+    from the (1, 1) base keeps the point healthy, so no 1-D
+    neighbor diff can attribute the flip to a single axis."""
+    records = []
+    for a in (1.0, 2.0):
+        for b in (1.0, 2.0):
+            base = (stalled_record() if (a == 2.0 and b == 2.0)
+                    else healthy_record())
+            records.append({**base, "knob_a": a, "knob_b": b,
+                            "urgent_margin_s": 4.0})
+    return records
+
+
+def test_grid_interactions_detect_the_and_shape():
+    records = and_grid_records()
+    triaged = triage.triage_records(records)
+    flagged = {entry["point"] for entry in triaged}
+    assert flagged == {3}  # only the both-high corner
+    grid = triage.grid_triage(records, triaged)
+    inter = grid["interactions"]
+    assert len(inter["flips"]) == 1
+    (flip,) = inter["flips"]
+    assert flip["axes"] == ["knob_a", "knob_b"]
+    assert flip["flagged_point"] == 3
+    assert flip["base_point"] == 0  # the healthy diagonal base
+    assert flip["flagged_values"] == [2.0, 2.0]
+    assert flip["base_values"] == [1.0, 1.0]
+    assert flip["reasons"] == ["offload_stall"]
+    assert inter["pairs"]["knob_a×knob_b"]["flips"] == 1
+    # the 1-D view still reports the two conditional flips (each
+    # holding the OTHER knob at its high value) — the interaction
+    # entry is what says they only fire together
+    assert set(grid["axes"]) == {"knob_a", "knob_b"}
+
+
+def test_single_axis_pathology_is_not_an_interaction():
+    """The uplink-only grid: its 2×2 blocks hold zero or two flagged
+    corners, never exactly one — a pathology one axis fully explains
+    must not masquerade as an interaction."""
+    records = synthetic_grid_records()
+    triaged = triage.triage_records(records)
+    grid = triage.grid_triage(records, triaged)
+    assert grid["interactions"]["flips"] == []
+    assert grid["interactions"]["pairs"] == {}
+
+
+def test_grid_interactions_ride_the_json_line(tmp_path, capsys):
+    records = and_grid_records()
+    path = tmp_path / "and.jsonl"
+    with open(path, "w", encoding="utf-8") as f:
+        for record in records:
+            f.write(json.dumps(record) + "\n")
+    triage.main([str(path), "--grid", "--json"])
+    lines = [json.loads(line) for line in
+             capsys.readouterr().out.strip().splitlines()]
+    inter = lines[-1]["grid"]["interactions"]
+    assert inter["pairs"]["knob_a×knob_b"]["flips"] == 1
+    triage.main([str(path), "--grid"])
+    assert "grid interaction knob_a×knob_b" in \
+        capsys.readouterr().out
 
 
 def test_grid_mode_emits_into_triage_json(tmp_path, capsys):

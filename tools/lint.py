@@ -7,7 +7,7 @@ library code logs through ``logging`` or counts into the telemetry
 registry (engine/telemetry.py); tools/tests/examples, which OWN their
 stdout, are exempt.
 
-Five repo-specific rules:
+Six repo-specific rules:
 
 - every entry of ``STATIC_KNOBS`` in ``tools/sweep.py`` (the sweep's
   compile-group key) must carry an inline ``# static:``
@@ -43,6 +43,14 @@ Five repo-specific rules:
   step — a full-map roll is a whole extra stream, and the K·C
   re-stream pattern the stencil replaced must not regrow silently
   (``[P]``-vector rolls are fine and not flagged).
+- no naked ``random.*`` / ``np.random.*`` calls in the policy-search
+  plane (``RNG_FILES``, engine/search.py): the search's whole
+  resume/determinism contract is "same seed ⇒ identical proposal
+  sequence", and ONE draw from global RNG state silently breaks it
+  — every draw must come from an explicitly-seeded constructor
+  (``np.random.default_rng(seed)`` / ``Generator`` / ``PCG64`` /
+  ``SeedSequence`` WITH a seed argument); ``# rng-ok: <why>`` is
+  the escape.
 
 Run: ``python tools/lint.py`` (exit code 1 on findings).
 """
@@ -396,6 +404,71 @@ def check_traffic_discipline(path):
     return findings
 
 
+#: the policy-search plane (the closed-loop round): drivers promise
+#: "same seed ⇒ identical proposal sequence ⇒ identical frontier"
+#: (make optimize-gate asserts it at process level), and a single
+#: global-state RNG draw breaks that invisibly — the checkpoint
+#: can't serialize global state, so a resumed search would diverge
+RNG_FILES = (
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "search.py"),
+)
+
+#: numpy constructors that, WITH an explicit seed argument, are the
+#: sanctioned way to draw randomness in RNG_FILES
+_RNG_SEEDED_CONSTRUCTORS = ("default_rng", "Generator", "PCG64",
+                            "SeedSequence")
+
+
+def check_rng_discipline(path):
+    """Seeded-RNG discipline for the policy-search plane: every
+    ``random.<fn>()`` call and every ``np.random.<fn>()`` call is
+    rejected UNLESS it is an explicitly-seeded constructor
+    (``np.random.default_rng(seed)`` etc. with at least one
+    argument) or carries an inline ``# rng-ok: <why>``.  Method
+    calls on a constructed ``Generator`` instance are fine — the
+    discipline is that the generator's seed is explicit, not that
+    randomness is banned."""
+    findings = []
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []  # check_file already reports the syntax error
+    lines = source.splitlines()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        chain = []
+        root = node.func
+        while isinstance(root, ast.Attribute):
+            chain.append(root.attr)
+            root = root.value
+        if not isinstance(root, ast.Name):
+            continue
+        chain.append(root.id)
+        chain.reverse()  # e.g. ["np", "random", "default_rng"]
+        stdlib_random = chain[0] == "random" and len(chain) == 2
+        np_random = (chain[0] in ("np", "numpy") and len(chain) >= 3
+                     and chain[1] == "random")
+        if not (stdlib_random or np_random):
+            continue
+        if (np_random and chain[-1] in _RNG_SEEDED_CONSTRUCTORS
+                and len(node.args) + len(node.keywords) > 0):
+            continue  # explicitly-seeded constructor
+        if "# rng-ok:" in lines[node.lineno - 1]:
+            continue
+        findings.append(
+            f"{path}:{node.lineno}: naked "
+            f"{'.'.join(chain)}() in the policy-search plane — "
+            f"global RNG state breaks the same-seed determinism "
+            f"contract; draw from an explicitly-seeded "
+            f"np.random.default_rng(seed) / Generator, or annotate "
+            f"'# rng-ok: <why>'")
+    return findings
+
+
 #: roots the metrics reference is collected from: the package (what
 #: the engine emits) plus tools/ (soak's invariant gauges).  Tests
 #: mint throwaway families and must not pollute the reference.
@@ -569,6 +642,8 @@ def main(argv=None):
                                                        strict=True))
         if path.endswith(TRAFFIC_FILE):
             all_findings.extend(check_traffic_discipline(path))
+        if path.endswith(RNG_FILES):
+            all_findings.extend(check_rng_discipline(path))
     all_findings.extend(check_static_knobs(
         os.path.join(repo_root, "tools", "sweep.py")))
     all_findings.extend(check_metrics_reference(repo_root))

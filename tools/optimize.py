@@ -1,0 +1,522 @@
+"""Closed-loop policy search: discover the offload/rebuffer frontier.
+
+``tools/sweep.py`` answers "what happens at these 144 points"; this
+tool answers the north star's inverse question — **which knobs
+maximize offload subject to rebuffer ≤ X** — by driving the
+engine/search.py ask/tell loop over the warm-started dispatch
+engine: one proposal batch is one ``stream_groups_chunked`` dispatch
+of the row-cache MISSES (revisited points are bit-identical layer-2
+hits), every completed row journals crash-safely, and the search
+state itself checkpoints atomically after every round — so a
+SIGKILL'd search ``--resume``-s to a bit-identical frontier with
+zero recompute of journaled rows (``make optimize-gate`` holds the
+whole chain to that, at a budget under half of exhaustive).
+
+Drivers (``--driver``; all seeded + deterministic — same seed, same
+proposal sequence, same frontier):
+
+- ``halving`` (default) — successive halving over the shipped
+  144-pt live lattice: screen everyone at ``--screen-fidelity`` of
+  the watch window, promote the constraint-aware top ``1/eta`` to
+  full length.  Short screens are their own compile group (one
+  extra AOT-cached program), full-length survivors reuse the same
+  program every later round.
+- ``random`` — rotated-Halton quasi-random warmup over the
+  continuous axes.
+- ``cmaes`` — CMA-ES over the smooth knobs (live cushion, urgency
+  margin, stagger window — all dynamic ``SwarmScenario`` data, so a
+  generation is ONE stacked-scenario chunk); categorical axes are
+  pinned (``--pin supply=2``).
+- ``refine`` — the adaptive grid refiner: evaluate the lattice,
+  then densify proposals around the CONSTRAINT flip edges (the
+  ``triage_timelines.py --grid`` join applied to feasibility) and
+  the two-knob interaction flips; the refined-edge map rides the
+  artifact.
+- ``grid`` — exhaustive lattice evaluation: the uniform baseline
+  the gate compares the budgeted drivers against.
+
+Constraint handling is explicit (``--constraint rebuffer<=0.02``):
+infeasible points are kept and labeled, never dropped; an
+all-infeasible search reports ``best: null`` plus the
+least-violating trial.  Budget (``--budget``) is counted in
+FULL-RUN EQUIVALENTS of proposed work (a 1/4-fidelity screen costs
+0.25), cache hits included, so the spend — like the proposal
+sequence — is identical across warm reruns; per-round row-cache
+hits vs fresh dispatches are recorded separately (the provenance
+the artifact's ``rounds`` table carries).
+
+Usage::
+
+    python tools/optimize.py                       # halving, live family
+    python tools/optimize.py --driver cmaes --budget 96
+    python tools/optimize.py --resume              # after a SIGKILL
+    python tools/optimize.py --out POLICY_OPT.json
+
+Output: the frontier table (best feasible config, Pareto set across
+the bound) on stdout, per-round progress on stderr, and — with
+``--out`` — the POLICY_OPT artifact: meta + per-round provenance +
+every trial (feasible/infeasible/failed labeled) + the frontier +
+the refiner's edge map.  ``--trace-dir`` arms the flight recorder
+(one ``search_round`` mark per round correlated with the dispatch
+events); ``--inject-faults`` is the chaos hook shared with sweep.
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
+    CompileCounter, SweepJournal, WarmStart, atomic_write_json,
+    enable_persistent_compilation_cache, journal_path)
+from hlsjs_p2p_wrapper_tpu.engine.faults import (  # noqa: E402
+    FaultPlan, FaultPolicy)
+from hlsjs_p2p_wrapper_tpu.engine.search import (  # noqa: E402
+    CategoricalAxis, CmaEsDriver, Constraint, ContinuousAxis,
+    GridDriver, GridRefineDriver, HalvingDriver, PolicySearch,
+    RandomDriver, SearchSpace, search_checkpoint_path)
+from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
+    FlightRecorder, run_id_for)
+from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
+    stream_groups_chunked)
+
+import sweep as sweep_tool  # noqa: E402
+
+
+def live_space() -> SearchSpace:
+    """The live scenario FAMILY as a search space: the smooth knobs
+    continuous (they are all dynamic ``SwarmScenario`` data — PR 3's
+    live-sync promotion is why a proposal batch is one compile
+    group), the coupled/discrete ones categorical, the compile-group
+    static (topology degree) fixed.  The shipped 144-pt live grid is
+    exactly this space's lattice (:func:`live_lattice`), so lattice
+    rows share the sweep tool's row-cache keys."""
+    return SearchSpace(
+        continuous=(
+            ContinuousAxis("live_sync_s", 4.0, 16.0),
+            ContinuousAxis("urgent_margin_s", 0.25, 8.0),
+            ContinuousAxis("spread_s", 0.0, 10.0),
+        ),
+        categorical=(
+            CategoricalAxis("supply", (
+                {"uplink_mbps": 1.2, "cdn_mbps": 1.2},
+                {"uplink_mbps": 2.4, "cdn_mbps": 2.4},
+                {"uplink_mbps": 10.0, "cdn_mbps": 8.0},
+            )),
+            CategoricalAxis("announce_delay_s", (0.0, 4.0)),
+            CategoricalAxis("join_wave", ("steady", "crowd")),
+        ),
+        fixed={"degree": 8, "ladder": "hd",
+               "budget_cap_ms": 6_000.0},
+    )
+
+
+def live_lattice():
+    """The 144-pt live grid as points in :func:`live_space` — the
+    same knob crossing ``sweep.live_grid()`` ships (pinned against
+    it by tests/test_search.py), expressed as space points so the
+    lattice drivers (halving / refine / grid) can seed from it."""
+    syncs = (6.0, 12.0)
+    urgents = (0.5, 4.0)
+    spreads = (0.0, 2.0, 8.0)
+    return [{"live_sync_s": sync, "urgent_margin_s": u,
+             "spread_s": sp, "supply": sup,
+             "announce_delay_s": ann, "join_wave": wave}
+            for sync, u, sp, sup, ann, wave in itertools.product(
+                syncs, urgents, spreads, range(3), range(2),
+                range(2))]
+
+
+def search_meta(args, space: SearchSpace,
+                constraint: Constraint) -> dict:
+    """The search-identity material the journal AND the checkpoint
+    are content-addressed by — everything that changes what a trial
+    IS or which trial comes next, so ``--resume`` can never replay a
+    different search's progress."""
+    return {
+        "tool": "optimize", "peers": args.peers,
+        "segments": args.segments, "watch_s": args.watch_s,
+        "seed": args.seed, "driver": args.driver,
+        "budget": args.budget, "batch": args.batch,
+        "constraint": [constraint.metric, constraint.bound],
+        "chunk": args.chunk,
+        # every driver hyperparameter that changes which trial comes
+        # next: two searches differing only in these must NOT share
+        # a journal/checkpoint digest (the resume refusal depends on
+        # it)
+        "driver_params": {
+            "rungs": args.rungs, "eta": args.eta,
+            "screen_fidelity": args.screen_fidelity,
+            "popsize": args.popsize, "sigma0": args.sigma0,
+            "pin": sorted(args.pin or ()),
+        },
+        "space": {
+            "continuous": [list(a) for a in space.continuous],
+            "categorical": [[a.name, list(a.values)]
+                            for a in space.categorical],
+            "fixed": space.fixed,
+        },
+    }
+
+
+#: the metric fields every evaluated trial carries (Evaluator fills
+#: them from the dispatch stream) — the only names a ``--constraint``
+#: can reference, validated up front so a typo'd metric fails before
+#: any budget is spent
+TRIAL_METRICS = ("offload", "rebuffer")
+
+
+class Evaluator:
+    """proposals → trials, through the chunked dispatch engine: one
+    ``stream_groups_chunked`` call per distinct fidelity in the
+    batch (each fidelity is one compile group — its own ``n_steps``
+    — warm-started like any other), with ``exact_chunk`` pinning the
+    canonical ``[chunk, P, …]`` batch shape so every round of the
+    search reuses ONE compiled program per fidelity regardless of
+    how many proposals a round holds.  Row-cache hits fill trials
+    without dispatching (``cached: true`` — the provenance signal);
+    a point whose recovery budget ran out comes back as a labeled
+    ``failed`` trial, never an exception."""
+
+    def __init__(self, space: SearchSpace, *, peers: int,
+                 segments: int, watch_s: float, seed: int, chunk: int,
+                 warm_start: WarmStart, faults: FaultPolicy,
+                 journal=None, trace=None, stagger_s: float = 60.0):
+        self.space = space
+        self.peers = peers
+        self.segments = segments
+        self.watch_s = watch_s
+        self.seed = seed
+        self.chunk = chunk
+        self.warm_start = warm_start
+        self.faults = faults
+        self.journal = journal
+        self.trace = trace
+        self.stagger_s = stagger_s
+
+    def _run_fidelity(self, fidelity: float, knob_list):
+        """One fidelity's dispatch: a short screen scales the WHOLE
+        scenario horizon (watch window, join wave, rebuffer
+        denominator) by the fidelity — a consistent short proxy of
+        the same scenario, with its own content-addressed row
+        keys."""
+        watch = self.watch_s * fidelity
+        config = sweep_tool.build_config(
+            self.peers, self.segments, True,
+            self.space.fixed.get("degree", 8))
+        n_steps = max(1, int(watch * 1000.0 / config.dt_ms))
+        build = (lambda k, cfg=config, w=watch:
+                 sweep_tool.build_scenario(cfg, k, watch_s=w,
+                                           stagger_s=self.stagger_s,
+                                           seed=self.seed))
+        results = [None] * len(knob_list)
+        stream = stream_groups_chunked(
+            [(config, knob_list, build)], n_steps, watch_s=watch,
+            chunk=self.chunk, exact_chunk=True,
+            warm_start=self.warm_start, faults=self.faults,
+            journal=self.journal, trace=self.trace)
+        for event in stream:
+            if event.metric is None:
+                results[event.index] = {
+                    "offload": None, "rebuffer": None,
+                    "failed": True, "cached": False,
+                    "reason": event.reason}
+            else:
+                results[event.index] = {
+                    "offload": float(event.metric[0]),
+                    "rebuffer": float(event.metric[1]),
+                    "failed": False, "cached": bool(event.cached)}
+        return results
+
+    def __call__(self, proposals, round_index):
+        trials = [None] * len(proposals)
+        by_fidelity = {}
+        for i, prop in enumerate(proposals):
+            by_fidelity.setdefault(float(prop["fidelity"]),
+                                   []).append(i)
+        for fidelity in sorted(by_fidelity):
+            idxs = by_fidelity[fidelity]
+            knob_list = [self.space.materialize(proposals[i]["point"])
+                         for i in idxs]
+            results = self._run_fidelity(fidelity, knob_list)
+            for local, i in enumerate(idxs):
+                trials[i] = {"point": dict(proposals[i]["point"]),
+                             "fidelity": fidelity,
+                             "knobs": knob_list[local],
+                             **results[local]}
+        return trials
+
+
+def build_driver(args, space: SearchSpace, constraint: Constraint):
+    if args.driver == "random":
+        return RandomDriver(space, args.seed)
+    if args.driver == "grid":
+        return GridDriver(space, args.seed, initial=live_lattice())
+    if args.driver == "halving":
+        fidelities = [args.screen_fidelity ** (args.rungs - 1 - r)
+                      for r in range(args.rungs)]
+        return HalvingDriver(space, args.seed,
+                             initial=live_lattice(),
+                             rungs=args.rungs, eta=args.eta,
+                             fidelities=fidelities,
+                             constraint=constraint)
+    if args.driver == "cmaes":
+        pins = {}
+        for pin in args.pin or ():
+            name, _, index = pin.partition("=")
+            pins[name.strip()] = int(index)
+        driver = CmaEsDriver(space, args.seed, popsize=args.popsize,
+                             sigma0=args.sigma0, pins=pins,
+                             constraint=constraint)
+        if args.batch < driver.lam:
+            raise SystemExit(
+                f"--batch {args.batch} is smaller than the CMA-ES "
+                f"population ({driver.lam}): a round must hold a "
+                f"whole generation — raise --batch or lower "
+                f"--popsize")
+        return driver
+    if args.driver == "refine":
+        return GridRefineDriver(space, args.seed,
+                                initial=live_lattice(),
+                                max_per_round=args.batch)
+    raise ValueError(f"unknown driver {args.driver!r}")
+
+
+def run_search(args):
+    """The whole tool as a callable (the gate's and bench's entry
+    point): build the space/driver/loop, run, return the artifact
+    dict.  ``args`` is this module's parsed namespace."""
+    probe = CompileCounter().attach()
+    space = live_space()
+    constraint = Constraint.parse(args.constraint)
+    warm_start = WarmStart(cache_dir=args.cache_dir)
+    enable_persistent_compilation_cache(warm_start.cache_dir)
+    faults = FaultPolicy(
+        plan=(FaultPlan.parse(args.inject_faults)
+              if args.inject_faults else None),
+        registry=warm_start.registry)
+    meta = search_meta(args, space, constraint)
+    jpath = journal_path(warm_start.cache_dir, meta)
+    journal = SweepJournal(jpath, meta,
+                           resume=args.resume and os.path.exists(
+                               jpath))
+    preloaded = len(journal.completed)
+    trace = None
+    if args.trace_dir:
+        trace = FlightRecorder(args.trace_dir, "host00",
+                               run_id=run_id_for(meta),
+                               registry=warm_start.registry)
+    driver = build_driver(args, space, constraint)
+    evaluator = Evaluator(
+        space, peers=args.peers, segments=args.segments,
+        watch_s=args.watch_s, seed=args.seed, chunk=args.chunk,
+        warm_start=warm_start, faults=faults, journal=journal,
+        trace=trace)
+    search = PolicySearch(
+        driver, evaluator, constraint, budget=args.budget,
+        batch=args.batch, registry=warm_start.registry, trace=trace,
+        checkpoint_path=search_checkpoint_path(warm_start.cache_dir,
+                                               meta),
+        checkpoint_meta=meta)
+    resumed = False
+    if args.resume:
+        resumed = search.resume()
+        print(f"# resume: checkpoint holds {search.round} completed "
+              f"rounds ({len(search.trials)} trials, "
+              f"{search.spent:g} budget spent); journal lists "
+              f"{preloaded} completed rows", file=sys.stderr)
+    t0 = time.perf_counter()
+    result = search.run()
+    elapsed = time.perf_counter() - t0
+    failed = result["frontier"]["failed"]
+    if journal is not None and not failed:
+        journal.finalize()
+    journal.close()
+    if trace is not None:
+        trace.close()
+    device = jax.devices()[0]
+    artifact = {
+        "meta": {
+            "tool": "optimize",
+            "peers": args.peers, "segments": args.segments,
+            "watch_s": args.watch_s, "seed": args.seed,
+            "driver": args.driver, "budget": args.budget,
+            "batch": args.batch, "chunk": args.chunk,
+            "constraint": {"metric": constraint.metric,
+                           "bound": constraint.bound},
+            "lattice_points": len(live_lattice()),
+            "elapsed_s": round(elapsed, 2),
+            "platform": device.platform,
+            "device_kind": getattr(device, "device_kind", "?"),
+            "resume": bool(resumed),
+            "journal_preloaded": preloaded,
+            "xla_compiles": probe.compiles,
+            "warm_start": warm_start.summary(),
+            "dispatch_faults": faults.fault_counts(),
+        },
+        "rounds": result["rounds"],
+        "spent": result["spent"],
+        "trials": result["trials"],
+        "frontier": result["frontier"],
+    }
+    for key in ("refined_edges", "interactions", "refine_rounds"):
+        if key in result:
+            artifact[key] = result[key]
+    probe.detach()
+    return artifact
+
+
+def _frontier_table(artifact, constraint: Constraint):
+    """Human frontier view: the Pareto set, best-feasible first,
+    feasibility labeled."""
+    lines = []
+    best = artifact["frontier"]["best"]
+    for trial in artifact["frontier"]["pareto"]:
+        knobs = trial["knobs"]
+        mark = ("*" if best is not None
+                and trial["point"] == best["point"] else " ")
+        feas = "feasible  " if trial["feasible"] else "INFEASIBLE"
+        knob_str = " ".join(
+            f"{k}={knobs[k]:g}" if isinstance(knobs[k], float)
+            else f"{k}={knobs[k]}"
+            for k in sorted(knobs) if k not in ("degree", "ladder"))
+        lines.append(f"{mark} {feas} offload={trial['offload']:.4f} "
+                     f"{constraint.metric}={trial[constraint.metric]:.5f}"
+                     f"  {knob_str}")
+    return lines
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--driver", default="halving",
+                    choices=("halving", "random", "cmaes", "refine",
+                             "grid"))
+    ap.add_argument("--budget", type=float, default=64.0,
+                    help="search budget in FULL-RUN EQUIVALENTS of "
+                         "proposed work (a 1/4-fidelity screen "
+                         "costs 0.25; the 144-pt lattice costs 144 "
+                         "exhaustively; default 64)")
+    ap.add_argument("--batch", type=int, default=144,
+                    help="max proposals per ask/tell round — one "
+                         "round is one chunked dispatch of the "
+                         "misses (default 144: a whole lattice "
+                         "cohort)")
+    ap.add_argument("--constraint", default="rebuffer<=0.02",
+                    help="explicit constraint, metric<=bound "
+                         "(default rebuffer<=0.02); infeasible "
+                         "points are kept and labeled, never "
+                         "dropped")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--peers", type=int, default=1024)
+    ap.add_argument("--segments", type=int, default=128)
+    ap.add_argument("--watch-s", type=float, default=240.0)
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="scenarios per dispatch — PINNED (not "
+                         "autotuned): every search round must reuse "
+                         "one canonical [chunk, P, …] program per "
+                         "fidelity (default 16)")
+    ap.add_argument("--rungs", type=int, default=2,
+                    help="halving rungs (default 2: one screen, one "
+                         "full-length run)")
+    ap.add_argument("--eta", type=float, default=6.0,
+                    help="halving promotion divisor: top 1/eta of a "
+                         "rung survives (default 6)")
+    ap.add_argument("--screen-fidelity", type=float, default=0.25,
+                    help="lowest halving rung's fraction of the "
+                         "watch window (default 0.25)")
+    ap.add_argument("--popsize", type=int, default=None,
+                    help="CMA-ES population (default 4+3ln(n))")
+    ap.add_argument("--sigma0", type=float, default=0.3,
+                    help="CMA-ES initial step size in the unit cube")
+    ap.add_argument("--pin", action="append", metavar="AXIS=INDEX",
+                    help="pin a categorical axis for CMA-ES "
+                         "(repeatable; default index 0)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume a SIGKILL'd search: reload the "
+                         "atomic checkpoint (digest-checked), "
+                         "re-ask the in-flight round "
+                         "deterministically, and serve its "
+                         "journaled rows from the row cache with "
+                         "zero recompute")
+    ap.add_argument("--trace-dir", metavar="DIR",
+                    help="arm the flight recorder: dispatch spans + "
+                         "one search_round mark per ask/tell round")
+    ap.add_argument("--inject-faults", metavar="SPEC",
+                    help="deterministic fault plane (chaos/test "
+                         "hook): kind@group:chunk[xN], kind one of "
+                         "oom/transient/timeout/kill "
+                         "(engine/faults.py FaultPlan)")
+    ap.add_argument("--out", metavar="FILE",
+                    help="write the POLICY_OPT artifact (meta + "
+                         "per-round provenance + trials + frontier "
+                         "+ refined edges) as JSON, atomically")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line per Pareto-front trial")
+    ap.add_argument("--cache-dir", help=argparse.SUPPRESS)  # gate /
+    # test hook: pin the warm-start root (defaults to the standard
+    # cache dir / HLSJS_P2P_TPU_CACHE_DIR)
+    return ap
+
+
+def main(argv=None):
+    ap = build_parser()
+    args = ap.parse_args(argv)
+    try:
+        constraint = Constraint.parse(args.constraint)
+    except ValueError as exc:
+        ap.error(str(exc))
+    if constraint.metric not in TRIAL_METRICS:
+        ap.error(f"unknown constraint metric {constraint.metric!r} "
+                 f"(trials carry: {', '.join(TRIAL_METRICS)})")
+    artifact = run_search(args)
+    frontier = artifact["frontier"]
+    if args.json:
+        for trial in frontier["pareto"]:
+            print(json.dumps(trial))
+    else:
+        for line in _frontier_table(artifact, constraint):
+            print(line)
+    best = frontier["best"]
+    if best is None:
+        least = frontier["least_violating"]
+        print(f"# NO feasible point under "
+              f"{constraint.metric}<={constraint.bound:g} "
+              f"({frontier['infeasible']} infeasible trials kept); "
+              f"least violating: offload={least['offload']:.4f} "
+              f"{constraint.metric}={least[constraint.metric]:.5f}"
+              if least is not None else
+              "# no completed full-fidelity trials",
+              file=sys.stderr)
+    else:
+        print(f"# best feasible: offload={best['offload']:.4f} "
+              f"{constraint.metric}={best[constraint.metric]:.5f} "
+              f"(round {best['round']})", file=sys.stderr)
+    rounds = artifact["rounds"]
+    fresh = sum(r["fresh_dispatches"] for r in rounds)
+    cached = sum(r["row_cache_hits"] for r in rounds)
+    print(f"# {args.driver} search: {len(artifact['trials'])} trials "
+          f"in {len(rounds)} rounds, budget {artifact['spent']:g}/"
+          f"{args.budget:g} full-run equivalents "
+          f"(exhaustive lattice = {artifact['meta']['lattice_points']}"
+          f"), {fresh} fresh dispatches + {cached} row-cache hits, "
+          f"{artifact['meta']['xla_compiles']} XLA compiles, "
+          f"{artifact['meta']['elapsed_s']}s", file=sys.stderr)
+    if artifact["meta"]["dispatch_faults"]:
+        print(f"# dispatch faults: "
+              f"{artifact['meta']['dispatch_faults']}",
+              file=sys.stderr)
+    if args.out:
+        atomic_write_json(args.out, artifact)
+        print(f"# wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,291 @@
+"""Optimize gate: the policy search plane earns its budget claims.
+
+The search plane's pitch (engine/search.py, tools/optimize.py) is
+three claims, and each is only worth shipping if it holds at PROCESS
+granularity on the shipped 144-pt live scenario family:
+
+1. **Budget**: with a budget under 50% of exhaustive evaluation, the
+   discovered config's offload must be ≥ the best feasible
+   uniform-grid point's, with the rebuffer constraint respected —
+   the successive-halving screen plus the constraint-aware promotion
+   must actually find the frontier, not just spend less.
+2. **Determinism**: a same-seed rerun must reproduce the identical
+   frontier AND the identical trial values (the proposal sequence is
+   a pure function of (seed, tells)) — against the warm cache it
+   must do so with ZERO fresh dispatches and ZERO XLA compiles.
+3. **Crash safety**: a search SIGKILLed mid-screen (the fault
+   plane's ``kill`` injection) must leave a journal whose rows the
+   ``--resume`` run serves ENTIRELY from the layer-2 row cache
+   (round-0 cache hits == journaled rows), perform zero XLA compiles
+   on the warm executable cache, and converge to a frontier
+   bit-identical to the uninterrupted run's.
+
+The gate runs ``tools/optimize.py`` in child processes against
+throwaway cache directories:
+
+- ``grid``  — exhaustive lattice evaluation (cache A): the uniform
+  baseline.
+- ``search`` — the budgeted halving search (cache B, fresh: it must
+  not borrow the baseline's rows).
+- ``rerun`` — same seed against cache B: identical frontier, all
+  cache hits, zero compiles.
+- ``kill`` — cache C seeded with B's EXECUTABLE layers only
+  (``aot/`` + ``xla/``; rows deliberately cold so the screen
+  actually dispatches), SIGKILLed at screen chunk 5: must die hard
+  (no artifact), journal holding the drained chunks.
+- ``resume`` — ``--resume`` against cache C: claim 3.
+
+Values are compared at FULL precision modulo the ``cached``
+provenance flag (a resumed row's value is bit-identical; its
+provenance legitimately differs).  Gate-sized swarms by default;
+``OPTIMIZE_GATE_PEERS`` etc. scale it up on accelerator hosts.
+
+Run: ``python tools/optimize_gate.py`` (exit 1 on any violation);
+``make optimize-gate`` wires it into ``make check``.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+#: the kill lands at screen chunk 5: with chunk 16 the 144-pt screen
+#: is 9 chunks, so chunks 0-3 have drained + journaled (the pipelined
+#: drain runs one chunk behind) and the rest have not — the resume
+#: must replay exactly those
+KILL_SPEC = "kill@0:5"
+
+
+from hlsjs_p2p_wrapper_tpu.engine.search import (  # noqa: E402
+    scrub_provenance as scrub)
+
+
+def run_child(mode, cache_dir, sizes, out, *, extra=(),
+              expect_kill=False):
+    cmd = [sys.executable,
+           os.path.join(_REPO, "tools", "optimize.py"),
+           "--peers", str(sizes["peers"]),
+           "--segments", str(sizes["segments"]),
+           "--watch-s", str(sizes["watch_s"]),
+           "--chunk", str(sizes["chunk"]),
+           "--constraint", f"rebuffer<={sizes['bound']}",
+           "--seed", str(sizes["seed"]),
+           "--cache-dir", cache_dir, "--out", out, *extra]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=_REPO)
+    if expect_kill:
+        if proc.returncode != -signal.SIGKILL:
+            raise SystemExit(
+                f"optimize-gate: kill child exited "
+                f"{proc.returncode}, expected SIGKILL "
+                f"({-signal.SIGKILL}):\n{proc.stdout}\n{proc.stderr}")
+        return None
+    if proc.returncode != 0:
+        raise SystemExit(f"optimize-gate child failed ({mode}):\n"
+                         f"{proc.stdout}\n{proc.stderr}")
+    with open(out, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--peers", type=int, default=int(
+        os.environ.get("OPTIMIZE_GATE_PEERS", 48)))
+    ap.add_argument("--segments", type=int, default=int(
+        os.environ.get("OPTIMIZE_GATE_SEGMENTS", 16)))
+    ap.add_argument("--watch-s", type=float, default=float(
+        os.environ.get("OPTIMIZE_GATE_WATCH_S", 60.0)))
+    ap.add_argument("--chunk", type=int, default=int(
+        os.environ.get("OPTIMIZE_GATE_CHUNK", 16)))
+    ap.add_argument("--budget", type=float, default=float(
+        os.environ.get("OPTIMIZE_GATE_BUDGET", 66.0)))
+    ap.add_argument("--bound", type=float, default=float(
+        os.environ.get("OPTIMIZE_GATE_BOUND", 0.02)))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--keep-dirs", action="store_true",
+                    help="keep the throwaway cache dirs for "
+                         "post-mortem")
+    args = ap.parse_args(argv)
+
+    sizes = {"peers": args.peers, "segments": args.segments,
+             "watch_s": args.watch_s, "chunk": args.chunk,
+             "bound": args.bound, "seed": args.seed}
+    work = tempfile.mkdtemp(prefix="optimize-gate-")
+    cache_a = os.path.join(work, "cache_grid")
+    cache_b = os.path.join(work, "cache_search")
+    cache_c = os.path.join(work, "cache_kill")
+    problems = []
+    try:
+        # 1. the exhaustive uniform-grid baseline (its own cache:
+        # the budgeted search must not borrow its rows)
+        grid = run_child(
+            "grid", cache_a, sizes, os.path.join(work, "grid.json"),
+            extra=("--driver", "grid", "--budget", "200"))
+        exhaustive = grid["meta"]["lattice_points"]
+        grid_best = grid["frontier"]["best"]
+        if grid_best is None:
+            problems.append(
+                f"grid: no feasible lattice point under "
+                f"rebuffer<={args.bound} — the gate bound is "
+                f"miscalibrated for this size")
+
+        # 2. the budgeted search: under half the exhaustive cost,
+        # constraint respected, offload >= the grid's best feasible
+        search = run_child(
+            "search", cache_b, sizes,
+            os.path.join(work, "search.json"),
+            extra=("--budget", str(args.budget)))
+        if search["spent"] >= exhaustive / 2:
+            problems.append(
+                f"search: spent {search['spent']} full-run "
+                f"equivalents — the budget claim is < 50% of "
+                f"exhaustive ({exhaustive})")
+        best = search["frontier"]["best"]
+        if best is None:
+            problems.append("search: found no feasible point "
+                            "although the grid has some")
+        elif grid_best is not None:
+            if best["rebuffer"] > args.bound:
+                problems.append(
+                    f"search: discovered config violates the "
+                    f"constraint (rebuffer {best['rebuffer']} > "
+                    f"{args.bound})")
+            if best["offload"] < grid_best["offload"]:
+                problems.append(
+                    f"search: discovered offload {best['offload']} "
+                    f"< best feasible uniform-grid point "
+                    f"{grid_best['offload']} — the budgeted search "
+                    f"must not lose to the grid it undercuts")
+
+        # 3. same-seed determinism against the warm cache: identical
+        # frontier + trial values, zero fresh dispatches, zero
+        # XLA compiles
+        rerun = run_child(
+            "rerun", cache_b, sizes,
+            os.path.join(work, "rerun.json"),
+            extra=("--budget", str(args.budget)))
+        if scrub(rerun["trials"]) != scrub(search["trials"]):
+            problems.append("rerun: same-seed trial values diverged "
+                            "from the first search — the proposal "
+                            "sequence must be a pure function of "
+                            "(seed, tells)")
+        if scrub(rerun["frontier"]) != scrub(search["frontier"]):
+            problems.append("rerun: same-seed frontier diverged")
+        rerun_fresh = sum(r["fresh_dispatches"]
+                          for r in rerun["rounds"])
+        if rerun_fresh != 0:
+            problems.append(f"rerun: {rerun_fresh} fresh dispatches "
+                            f"against the warm row cache — every "
+                            f"revisited point must be a layer-2 hit")
+        if rerun["meta"]["xla_compiles"] != 0:
+            problems.append(
+                f"rerun: {rerun['meta']['xla_compiles']} XLA "
+                f"compiles on the warm cache — expected 0")
+
+        # 4. SIGKILL mid-screen.  Cache C gets B's executable layers
+        # only (aot/ + xla/) — warm programs, cold rows — so the
+        # screen genuinely dispatches and the kill coordinate fires
+        os.makedirs(cache_c, exist_ok=True)
+        for layer in ("aot", "xla"):
+            src = os.path.join(cache_b, layer)
+            if os.path.isdir(src):
+                shutil.copytree(src, os.path.join(cache_c, layer))
+        killed_out = os.path.join(work, "killed.json")
+        run_child("kill", cache_c, sizes, killed_out,
+                  extra=("--budget", str(args.budget),
+                         "--inject-faults", KILL_SPEC),
+                  expect_kill=True)
+        if os.path.exists(killed_out):
+            problems.append("kill: the SIGKILLed child left an "
+                            "artifact — it must die hard")
+        journal_dir = os.path.join(cache_c, "journals")
+        journals = [name for name in
+                    (os.listdir(journal_dir)
+                     if os.path.isdir(journal_dir) else [])
+                    if name.endswith(".jsonl")]
+        journaled = 0
+        if len(journals) != 1:
+            problems.append(f"kill: expected exactly one journal "
+                            f"shard, found {journals}")
+        else:
+            with open(os.path.join(journal_dir, journals[0]),
+                      encoding="utf-8") as fh:
+                records = [json.loads(line) for line in fh
+                           if line.strip()]
+            journaled = sum(1 for r in records
+                            if r.get("kind") == "row")
+            if journaled == 0:
+                problems.append("kill: the journal holds no rows — "
+                                "the kill fired before any chunk "
+                                "drained, so the gate proves "
+                                "nothing")
+            if any(r.get("kind") == "done" for r in records):
+                problems.append("kill: the journal was finalized by "
+                                "a killed run")
+
+        # 5. --resume: bit-identical frontier, journaled rows all
+        # served from the row cache, zero compiles on the warm cache
+        resume = run_child(
+            "resume", cache_c, sizes,
+            os.path.join(work, "resume.json"),
+            extra=("--budget", str(args.budget), "--resume"))
+        if scrub(resume["trials"]) != scrub(search["trials"]):
+            problems.append("resume: trial values diverged from the "
+                            "uninterrupted search — resume must be "
+                            "bit-identical")
+        if scrub(resume["frontier"]) != scrub(search["frontier"]):
+            problems.append("resume: frontier diverged from the "
+                            "uninterrupted search")
+        if resume["meta"]["xla_compiles"] != 0:
+            problems.append(
+                f"resume: {resume['meta']['xla_compiles']} XLA "
+                f"compiles — the warm executable cache must cover "
+                f"every resumed dispatch")
+        preloaded = resume["meta"]["journal_preloaded"]
+        if preloaded != journaled:
+            problems.append(
+                f"resume: read {preloaded} journaled rows, the kill "
+                f"left {journaled}")
+        round0_hits = (resume["rounds"][0]["row_cache_hits"]
+                       if resume["rounds"] else 0)
+        if round0_hits != journaled:
+            problems.append(
+                f"resume: round-0 row-cache hits {round0_hits} != "
+                f"journaled rows {journaled} — every journaled row "
+                f"must be served from the cache, and nothing else "
+                f"can be warm")
+
+        spent = search["spent"]
+        best_off = best["offload"] if best else None
+        grid_off = grid_best["offload"] if grid_best else None
+        print(f"optimize-gate: grid best {grid_off} over "
+              f"{exhaustive} evals; search best {best_off} at "
+              f"{spent} equivalents; rerun "
+              f"{rerun['meta']['xla_compiles']} compiles / "
+              f"{rerun_fresh} fresh; kill journaled {journaled}; "
+              f"resume {resume['meta']['xla_compiles']} compiles -> "
+              f"{'ok' if not problems else 'FAIL'}")
+    finally:
+        if not args.keep_dirs:
+            shutil.rmtree(work, ignore_errors=True)
+        else:
+            print(f"optimize-gate: dirs kept under {work}",
+                  file=sys.stderr)
+    for problem in problems:
+        print(f"optimize-gate: {problem}", file=sys.stderr)
+    print(f"# optimize-gate: {'PASS' if not problems else 'FAIL'} "
+          f"(144-pt live family, {sizes['peers']} peers, watch "
+          f"{sizes['watch_s']}s, budget {args.budget} vs "
+          f"exhaustive 144, 5 processes)", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,7 +44,9 @@ the numbers behind them) and a summary; ``--strict`` exits nonzero
 when anything was flagged, so ``make sweep-live`` can gate on a
 clean grid.  ``--json`` emits findings as JSON lines for downstream
 tooling.  Pure stdlib + host arithmetic — no jax import, so triage
-runs anywhere the artifact does.
+runs anywhere the artifact does (the ``--grid`` joins come from the
+equally stdlib-only ``hlsjs_p2p_wrapper_tpu/core/gridjoin.py``, the
+ONE implementation the search plane's refiner shares).
 
 Usage::
 
@@ -54,7 +56,16 @@ Usage::
 
 import argparse
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# stdlib-only shared grid joins (no jax on this import path): the
+# SAME code engine/search.py's adaptive refiner joins constraint
+# verdicts through — one implementation, two verdict kinds
+from hlsjs_p2p_wrapper_tpu.core.gridjoin import (  # noqa: E402
+    grid_flips as _grid_flips, grid_interactions as _grid_interactions)
 
 #: record keys that are structure, not scenario knobs
 _RESERVED = ("columns", "samples", "record_every", "offload",
@@ -234,6 +245,56 @@ def grid_axes(records):
             if len({repr(r[k]) for r in records}) >= 2]
 
 
+def _flip_summary(flips, key_fn, example_fn):
+    """Aggregate flips into ``{key: {"flips", "examples"}}`` —
+    shared by the 1-D axis view and the pairwise interaction view so
+    the example cap and the most-flipping-first order stay one
+    definition."""
+    summary = {}
+    for flip in flips:
+        entry = summary.setdefault(key_fn(flip),
+                                   {"flips": 0, "examples": []})
+        entry["flips"] += 1
+        if len(entry["examples"]) < 4:
+            entry["examples"].append(example_fn(flip))
+    return dict(sorted(summary.items(),
+                       key=lambda kv: -kv[1]["flips"]))
+
+
+def grid_interactions(records, triaged, axes):
+    """Two-knob INTERACTION flips — the refiner's second input: 2×2
+    blocks where both axes step one adjacent value (every other knob
+    fixed) and ONLY one corner is flagged, so each single-knob move
+    from the flagged corner's diagonal base stays healthy and no 1-D
+    neighbor diff can attribute the flip — the AND-shaped pathology.
+    The block join itself is ``core/gridjoin.grid_interactions``,
+    shared verbatim with engine/search.py's refiner (which runs it
+    on CONSTRAINT verdicts); this wrapper joins pathology verdicts
+    and attaches each flagged point's reasons.
+
+    Returns ``{"pairs": {"a×b": {"flips", "examples"}},
+    "flips": [...]}`` with one entry per block (axes, the healthy
+    diagonal base, the flagged corner, both values, the flagged
+    point's reasons), most-flipping pair first."""
+    flagged = {entry["point"]: [f["reason"]
+                                for f in entry["findings"]]
+               for entry in triaged}
+    flips = [{**flip, "reasons": flagged[flip["flagged_point"]]}
+             for flip in _grid_interactions(records, axes,
+                                            set(flagged))]
+    pairs = _flip_summary(
+        flips,
+        lambda flip: "×".join(flip["axes"]),
+        lambda flip: (
+            f"({flip['base_values'][0]},{flip['base_values'][1]})"
+            f"→({flip['flagged_values'][0]},"
+            f"{flip['flagged_values'][1]}) "
+            f"(point {flip['base_point']}→"
+            f"{flip['flagged_point']}: "
+            f"{','.join(flip['reasons'])})"))
+    return {"pairs": pairs, "flips": flips}
+
+
 def grid_triage(records, triaged):
     """Which knob axis flips a point from healthy to pathological:
     1-D NEIGHBOR DIFFS along each axis.
@@ -254,48 +315,21 @@ def grid_triage(records, triaged):
                                 for f in entry["findings"]]
                for entry in triaged}
     axes = grid_axes(records)
-    flips = []
-    for axis in axes:
-        lines = {}
-        for idx, record in enumerate(records):
-            rest = tuple(sorted(
-                (k, repr(record[k])) for k in axes if k != axis))
-            lines.setdefault(rest, []).append(idx)
-        for idxs in lines.values():
-            # sort the 1-D line by the axis value (mixed/str knob
-            # values order by repr — adjacency just needs a stable,
-            # deterministic walk)
-            idxs = sorted(idxs, key=lambda i: (
-                (0, records[i][axis])
-                if isinstance(records[i][axis], (int, float))
-                else (1, repr(records[i][axis]))))
-            for a, b in zip(idxs, idxs[1:]):
-                a_bad, b_bad = a in flagged, b in flagged
-                if a_bad == b_bad:
-                    continue
-                healthy, sick = (a, b) if b_bad else (b, a)
-                flips.append({
-                    "axis": axis,
-                    "healthy_point": healthy,
-                    "flagged_point": sick,
-                    "healthy_value": records[healthy][axis],
-                    "flagged_value": records[sick][axis],
-                    "reasons": flagged[sick],
-                })
-    summary = {}
-    for flip in flips:
-        entry = summary.setdefault(flip["axis"],
-                                   {"flips": 0, "examples": []})
-        entry["flips"] += 1
-        if len(entry["examples"]) < 4:
-            entry["examples"].append(
-                f"{flip['healthy_value']}→{flip['flagged_value']} "
-                f"(point {flip['healthy_point']}→"
-                f"{flip['flagged_point']}: "
-                f"{','.join(flip['reasons'])})")
-    ordered = dict(sorted(summary.items(),
-                          key=lambda kv: -kv[1]["flips"]))
-    return {"axes": ordered, "flips": flips}
+    # the 1-D line join is core/gridjoin.grid_flips (shared with the
+    # search refiner); attach each flagged point's reasons here
+    flips = [{**flip, "reasons": flagged[flip["flagged_point"]]}
+             for flip in _grid_flips(records, axes, set(flagged))]
+    axes_summary = _flip_summary(
+        flips,
+        lambda flip: flip["axis"],
+        lambda flip: (
+            f"{flip['healthy_value']}→{flip['flagged_value']} "
+            f"(point {flip['healthy_point']}→"
+            f"{flip['flagged_point']}: "
+            f"{','.join(flip['reasons'])})"))
+    return {"axes": axes_summary, "flips": flips,
+            "interactions": grid_interactions(records, triaged,
+                                              axes)}
 
 
 def triage_records(records, *, min_flips=4, osc_frac=0.25,
@@ -364,8 +398,14 @@ def main(argv=None):
                          "against the sweep's knob axes and report "
                          "which axis flips a point from healthy to "
                          "pathological (1-D neighbor diffs along "
-                         "each knob); emitted as a final "
-                         "{\"grid\": ...} JSON line under --json")
+                         "each knob) plus pairwise INTERACTION "
+                         "flips (grid.interactions: 2x2 blocks "
+                         "where only moving BOTH knobs flips — the "
+                         "AND-shaped pathology single-axis diffs "
+                         "cannot attribute; the search plane's "
+                         "refiner consumes the same join); emitted "
+                         "as a final {\"grid\": ...} JSON line "
+                         "under --json")
     ap.add_argument("--min-flips", type=int, default=4,
                     help="dominant-level changes before a point "
                          "counts as oscillating (default 4)")
@@ -425,6 +465,11 @@ def main(argv=None):
             if not grid["axes"]:
                 print("grid: no single-axis flips (pathologies are "
                       "uniform along every knob line)")
+            for pair, entry in grid["interactions"]["pairs"].items():
+                examples = "; ".join(entry["examples"])
+                print(f"grid interaction {pair}: {entry['flips']} "
+                      f"AND-shaped flip(s) — both knobs must move "
+                      f"together [{examples}]")
     reasons = [f["reason"] for e in triaged for f in e["findings"]]
     print(f"# triaged {len(records)} timelines: {len(triaged)} "
           f"flagged ({reasons.count('ladder_oscillation')} "
