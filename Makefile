@@ -5,7 +5,8 @@ PY ?= python
 
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
 	coverage soak scaling-artifact warmstart-gate chaos-gate \
-	fleet-gate trace-gate tracker-gate net-chaos-gate optimize-gate
+	fleet-gate trace-gate tracker-gate net-chaos-gate optimize-gate \
+	twin-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -144,6 +145,24 @@ net-chaos-gate:
 optimize-gate:
 	$(PY) tools/optimize_gate.py
 
+# sim<->real twin calibration proof (engine/twinframe.py,
+# testing/twin.py): the SAME seeded scenario (staggered joins + a
+# join wave; clean AND a loss/latency chaos schedule in the shared
+# NetFaultPlan grammar) through the jnp kernel and the real-protocol
+# swarm must agree within the COMMITTED tolerance bands
+# (TWIN_r10.json) on offload, rebuffer, join convergence, and the
+# delivery rates; frames reconstructed from the flight-recorder
+# event stream alone must equal the registry-derived frames exactly;
+# a deliberately injected sim-fidelity bug (the wave cohort's joins
+# displaced in the sim only) must be localized by the divergence
+# detectors to the membership columns at the wave window; and the
+# Perfetto/console consumers must render the paired frames.
+# Recalibrate bands deliberately via
+# `python tools/twin_gate.py --write-bands`; TWIN_GATE_PEERS etc.
+# scale it up (committed bands only claim the committed shape).
+twin-gate:
+	$(PY) tools/twin_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -153,6 +172,6 @@ examples:
 	$(PY) examples/production_demo.py
 
 check: lint test dryrun warmstart-gate chaos-gate fleet-gate \
-	trace-gate tracker-gate net-chaos-gate optimize-gate
+	trace-gate tracker-gate net-chaos-gate optimize-gate twin-gate
 
 all: check bench

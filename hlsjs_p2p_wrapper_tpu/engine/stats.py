@@ -54,12 +54,27 @@ class AgentStats:
             self._p2p = Counter("agent.p2p_bytes", labels)
             self._upload = Counter("agent.upload_bytes", labels)
             self._peers = Gauge("agent.peers", labels)
+            self._fetch_bytes = {
+                src: Counter("twin.fetch_bytes",
+                             {**labels, "src": src})
+                for src in ("cdn", "p2p")}
+            self._fetches = {
+                src: Counter("twin.fetches", {**labels, "src": src})
+                for src in ("cdn", "p2p")}
         else:
             self._cdn = registry.counter("agent.cdn_bytes", **labels)
             self._p2p = registry.counter("agent.p2p_bytes", **labels)
             self._upload = registry.counter("agent.upload_bytes",
                                             **labels)
             self._peers = registry.gauge("agent.peers", **labels)
+            self._fetch_bytes = {
+                src: registry.counter("twin.fetch_bytes", src=src,
+                                      **labels)
+                for src in ("cdn", "p2p")}
+            self._fetches = {
+                src: registry.counter("twin.fetches", src=src,
+                                      **labels)
+                for src in ("cdn", "p2p")}
 
     @property
     def cdn(self) -> int:
@@ -92,6 +107,31 @@ class AgentStats:
     @peers.setter
     def peers(self, value) -> None:
         self._peers.set(value)
+
+    # -- fetch provenance (the twin observation plane) -----------------
+    # The ``cdn``/``p2p`` setters above MIRROR externally-reconciled
+    # totals (``set_value``), which deliberately stays invisible to
+    # the registry's bump listeners — no event stream could replay an
+    # assignment additively (engine/telemetry.py Counter docs).  The
+    # twin plane needs the additive view: the agent calls these with
+    # the SAME deltas it applies to the totals, so the
+    # ``twin.fetch_bytes{peer,src}`` family converges to the exact
+    # byte totals AND every delta reaches the flight recorder as one
+    # causally-ordered counter event (engine/twinframe.py
+    # reconstructs observation frames from nothing else).
+
+    def note_fetch_bytes(self, src: str, n) -> None:
+        """One per-fetch byte delta (progress or completion
+        reconciliation — may be negative, like the ``cdn`` setter's
+        contract); zero deltas are skipped, not emitted."""
+        if n:
+            self._fetch_bytes[src].inc(n)
+
+    def note_fetch_done(self, src: str) -> None:
+        """One COMPLETED fetch on ``src`` — the companion count that
+        lets tools/soak.py catch an agent reporting bytes without
+        matching fetch events."""
+        self._fetches[src].inc()
 
     def as_dict(self) -> dict:
         return {"cdn": self.cdn, "p2p": self.p2p, "upload": self.upload,

@@ -107,12 +107,33 @@ def run_id_for(meta: dict) -> str:
 def _labels_str(labels) -> str:
     """Canonical ``k=v,...`` (sorted) label rendering — the one
     format the recorder, the replay, and the exported partials
-    share, so equality checks are string equality."""
+    share, so equality checks are string equality.  Registry bumps
+    hand over the registry's interned sorted label TUPLE, so the
+    rendering is memoized per distinct label set — the bump listener
+    is on the swarm data plane's hot path (one event per fetch
+    delta), where re-rendering measured ~25% of the per-event cost."""
     if isinstance(labels, dict):
         items = sorted((k, str(v)) for k, v in labels.items())
-    else:
-        items = [(k, str(v)) for k, v in labels]
-    return ",".join(f"{k}={v}" for k, v in items)
+        return ",".join(f"{k}={v}" for k, v in items)
+    cached = _LABELS_STR_CACHE.get(labels)
+    if cached is None:
+        if len(_LABELS_STR_CACHE) >= _LABELS_STR_CACHE_MAX:
+            # a pure memo, so dropping it only costs re-rendering:
+            # clear-on-cap (the re-module cache pattern) keeps the
+            # hot path one dict.get while bounding a long-lived
+            # host — per-peer tuples outlive registry.prune here,
+            # since the registry drops its keys but not this memo
+            _LABELS_STR_CACHE.clear()
+        cached = ",".join(f"{k}={v}" for k, v in labels)
+        _LABELS_STR_CACHE[labels] = cached
+    return cached
+
+
+#: memoized sorted-tuple renderings, capped so a process that churns
+#: per-peer label sets for days (soak, the live control-plane
+#: service) cannot grow it without bound
+_LABELS_STR_CACHE: dict = {}
+_LABELS_STR_CACHE_MAX = 65536
 
 
 class FlightRecorder:
@@ -126,7 +147,17 @@ class FlightRecorder:
 
     def __init__(self, trace_dir: str, host_id: str = "host00", *,
                  run_id: Optional[str] = None, clock=time.time,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 counter_filter=None):
+        #: optional predicate on the counter FAMILY name: when set,
+        #: only matching bumps become events (explicit emits — spans,
+        #: marks, rows, leases — are never filtered).  For recorders
+        #: scoped to one data plane (the twin sampler records the
+        #: ``twin.*`` provenance families), where recording every
+        #: unrelated family's bumps is measurable hot-path cost; the
+        #: default None keeps the complete-ground-truth contract the
+        #: trace gate replays (counter events == registries exactly).
+        self._counter_filter = counter_filter
         os.makedirs(trace_dir, exist_ok=True)
         self.trace_dir = trace_dir
         self.host_id = host_id
@@ -198,12 +229,21 @@ class FlightRecorder:
             self._buffer.append(json.dumps(record))
         return record
 
-    def flush(self) -> None:
+    def flush(self, fsync: bool = True) -> None:
         """Make every buffered event durable under ONE flush +
         fsync — the journal's per-drained-chunk discipline.  The
         dispatch engine calls this BEFORE the journal fsyncs a
         chunk's row keys, so a journaled row's finalize event can
-        never be lost to a crash the journal survived."""
+        never be lost to a crash the journal survived.
+
+        ``fsync=False`` stops at the OS write: enough for
+        PROCESS-death durability (a SIGKILL'd writer's flushed pages
+        survive in the page cache; only a host crash can lose them),
+        and what high-cadence flushers use — the twin sampler flushes
+        every observation window, where per-window fsyncs were a
+        measured double-digit share of the armed event plane's cost
+        (bench.py ``detail.twin_overhead``) for no additional
+        process-level guarantee."""
         with self._lock:
             if not self._buffer:
                 return
@@ -211,7 +251,8 @@ class FlightRecorder:
                                    for line in self._buffer))
             self._buffer.clear()
             self._fh.flush()
-            os.fsync(self._fh.fileno())
+            if fsync:
+                os.fsync(self._fh.fileno())
 
     @contextmanager
     def span(self, name: str, **attrs):
@@ -270,6 +311,9 @@ class FlightRecorder:
         self._registries.clear()
 
     def _on_bump(self, name: str, labels, n) -> None:
+        if (self._counter_filter is not None
+                and not self._counter_filter(name)):
+            return
         self.emit("counter", name=name, labels=_labels_str(labels),
                   n=n)
 

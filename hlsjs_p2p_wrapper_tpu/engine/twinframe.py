@@ -1,0 +1,539 @@
+"""Twin observation plane: ONE calibration frame across both system
+models.
+
+The repo carries two full implementations of the paper's delivery
+loop — the scanned jnp step kernel (ops/swarm_sim.py: millions of
+peers, bit-exact, warm-startable) and the real-protocol agent swarm
+(engine/mesh.py + engine/p2p_agent.py + engine/tracker.py over a
+shared VirtualClock fabric).  Each had its own telemetry: the kernel
+emits ``record_every`` metrics timelines (``timeline_columns``), the
+swarm exports registry series and flight-recorder events
+(engine/tracer.py).  Nothing compared them — so "digital twin" was a
+name, not a measured quantity (ROADMAP: the twin-calibration gate is
+the credibility prerequisite for the live control plane).
+
+This module is the shared vocabulary plus the machinery that lands
+BOTH planes in it:
+
+- :data:`FRAME_COLUMNS` / :class:`ObservationFrame` — one canonical
+  windowed frame: per-window cumulative offload and rebuffer ratios,
+  interval CDN/P2P byte rates, the interval stalled-peer count, and
+  peer presence with join/leave counts.  Every column is defined
+  once, here, with one window convention (window ``k`` covers
+  ``(t_{k-1}, t_k]``; the first window reaches back to 0 inclusive)
+  so the two extractors can never drift apart silently.
+- :func:`frames_from_timelines` — folds the jnp kernel's
+  ``record_every`` timeline (one sample per record interval) into
+  frames; presence comes from the per-level peer counts, join/leave
+  counts from the scenario's own ``join_s``/``leave_s`` arrays.
+- :class:`FrameBuilder` + :func:`frames_from_events` — the real
+  plane's pair.  The builder is the ONE reducer both real-side
+  extractors drive: the harness's registry sampler feeds it absolute
+  per-peer totals read live from the shared
+  :class:`~.telemetry.MetricsRegistry` (the ``twin.*`` provenance
+  families: per-fetch cdn/p2p bytes, stall accrual, join/leave), and
+  :func:`frames_from_events` feeds it the SAME bumps replayed from a
+  flight-recorder shard, closing a window at each ``twin_window``
+  mark the sampler emitted.  Because both paths accumulate the same
+  deltas in the same order and reduce through the same code, frames
+  reconstructed from the event stream alone are EXACTLY equal to the
+  registry-derived frames — the trace-gate completeness discipline,
+  extended to the swarm data plane (``make twin-gate`` asserts it,
+  through a SIGKILL'd writer included: the shard reader is the
+  torn-tail-tolerant one).
+- divergence detectors in the triage_timelines.py mold:
+  :func:`detect_band_divergence` (per-window bounded relative error:
+  WHICH metric, WHICH window, and which side moved first) and
+  :func:`detect_distribution_divergence` (two-sample KS distance
+  over the window samples); :func:`compare_frames` runs both against
+  a calibrated tolerance-band artifact (the committed
+  ``TWIN_r10.json``), and :func:`frame_errors` is the console's
+  per-metric max-error panel.
+
+Pure stdlib + host arithmetic — no jax import, so frames compare
+anywhere the artifacts travel (the triage-tool discipline).  Frames
+carry VirtualClock-derived timestamps only; this file is under
+tools/lint.py's injectable-clock rule, so a naked wall-clock read
+here is a lint failure by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+#: the canonical frame vocabulary, shared with the jnp kernel's
+#: ``timeline_columns``: sample clock, cumulative north-star pair,
+#: interval byte rates, interval stall count — plus the membership
+#: columns the twin comparison adds (presence and join/leave counts)
+FRAME_COLUMNS = ("t_s", "offload", "rebuffer", "cdn_rate_bps",
+                 "p2p_rate_bps", "stalled_peers", "present_peers",
+                 "joins", "leaves")
+
+
+class ObservationFrame(NamedTuple):
+    """One plane's windowed observation of a scenario run.
+
+    ``samples`` is a tuple of per-window rows over ``columns``
+    (:data:`FRAME_COLUMNS`); ``source`` names the plane ("sim" /
+    "real").  NamedTuple equality is the exactness check the twin
+    gate uses (event-reconstructed == registry-derived)."""
+
+    source: str
+    window_s: float
+    columns: Tuple[str, ...]
+    samples: Tuple[Tuple[float, ...], ...]
+
+    def column(self, name: str) -> List[float]:
+        idx = self.columns.index(name)
+        return [row[idx] for row in self.samples]
+
+    @property
+    def n_windows(self) -> int:
+        return len(self.samples)
+
+    def as_dict(self) -> dict:
+        return {"source": self.source, "window_s": self.window_s,
+                "columns": list(self.columns),
+                "samples": [list(row) for row in self.samples]}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ObservationFrame":
+        return cls(source=data["source"],
+                   window_s=float(data["window_s"]),
+                   columns=tuple(data["columns"]),
+                   samples=tuple(tuple(float(v) for v in row)
+                                 for row in data["samples"]))
+
+
+def _in_window(t: Optional[float], prev_t: float, end_t: float,
+               first: bool) -> bool:
+    """The ONE window-membership convention: ``(prev_t, end_t]``,
+    with the first window reaching back through 0 (a join at the
+    scenario origin belongs to window 0, not to no window)."""
+    if t is None:
+        return False
+    if first:
+        return t <= end_t
+    return prev_t < t <= end_t
+
+
+class FrameBuilder:
+    """The shared real-plane reducer (module docstring): accumulate
+    per-peer provenance totals — incrementally (event replay) or
+    absolutely (registry sampling) — and :meth:`close_window` them
+    into canonical frame rows.  All clocks are in MILLISECONDS (the
+    engine timebase); rows are emitted in seconds."""
+
+    def __init__(self, source: str, window_s: float):
+        self.source = source
+        self.window_s = float(window_s)
+        self._bytes: Dict[Tuple[str, str], float] = {}
+        self._stall_ms: Dict[str, float] = {}
+        self._join_ms: Dict[str, float] = {}
+        self._leave_ms: Dict[str, float] = {}
+        self._stalled: set = set()   # peers whose stall clock moved
+        self._prev_cdn = 0.0
+        self._prev_p2p = 0.0
+        self._prev_t_ms = 0.0
+        self._first = True
+        self._rows: List[Tuple[float, ...]] = []
+
+    # -- incremental feeders (flight-recorder event replay) -----------
+
+    def add_bytes(self, peer: str, src: str, n: float) -> None:
+        key = (peer, src)
+        self._bytes[key] = self._bytes.get(key, 0.0) + n
+
+    def add_stall(self, peer: str, ms: float) -> None:
+        self._stall_ms[peer] = self._stall_ms.get(peer, 0.0) + ms
+        self._stalled.add(peer)
+
+    # -- absolute feeders (live registry sampling) --------------------
+
+    def set_bytes_total(self, peer: str, src: str,
+                        value: float) -> None:
+        self._bytes[(peer, src)] = value
+
+    def set_stall_total(self, peer: str, value: float) -> None:
+        if value != self._stall_ms.get(peer, 0.0):
+            self._stalled.add(peer)
+        self._stall_ms[peer] = value
+
+    # -- membership (both feeders) ------------------------------------
+
+    def set_join(self, peer: str, t_ms: float) -> None:
+        self._join_ms[peer] = t_ms
+
+    def set_leave(self, peer: str, t_ms: float) -> None:
+        self._leave_ms[peer] = t_ms
+
+    # -- reduction ----------------------------------------------------
+
+    def close_window(self, t_ms: float) -> Tuple[float, ...]:
+        """Emit the frame row for the window ending at ``t_ms``.
+        Reductions iterate peers in SORTED order so both feeders sum
+        identical floats in identical order — the exact-equality
+        contract between the registry and event extractions."""
+        cdn = 0.0
+        p2p = 0.0
+        for peer, src in sorted(self._bytes):
+            if src == "cdn":
+                cdn += self._bytes[(peer, src)]
+            elif src == "p2p":
+                p2p += self._bytes[(peer, src)]
+        total = cdn + p2p
+        offload = p2p / total if total > 0 else 0.0
+        stall = 0.0
+        for peer in sorted(self._stall_ms):
+            stall += self._stall_ms[peer]
+        watched = 0.0
+        present = 0
+        joins = 0
+        leaves = 0
+        for peer in sorted(self._join_ms):
+            j = self._join_ms[peer]
+            leave = self._leave_ms.get(peer)
+            end = t_ms if leave is None else min(leave, t_ms)
+            watched += max(end - j, 0.0)
+            if j <= t_ms and (leave is None or leave > t_ms):
+                present += 1
+            if _in_window(j, self._prev_t_ms, t_ms, self._first):
+                joins += 1
+            if _in_window(leave, self._prev_t_ms, t_ms, self._first):
+                leaves += 1
+        rebuffer = stall / watched if watched > 0 else 0.0
+        dt_s = max((t_ms - self._prev_t_ms) / 1000.0, 1e-9)
+        row = (t_ms / 1000.0, offload, rebuffer,
+               (cdn - self._prev_cdn) * 8.0 / dt_s,
+               (p2p - self._prev_p2p) * 8.0 / dt_s,
+               float(len(self._stalled)), float(present),
+               float(joins), float(leaves))
+        self._prev_cdn = cdn
+        self._prev_p2p = p2p
+        self._prev_t_ms = t_ms
+        self._first = False
+        self._stalled = set()
+        self._rows.append(row)
+        return row
+
+    def frame(self) -> ObservationFrame:
+        return ObservationFrame(source=self.source,
+                                window_s=self.window_s,
+                                columns=FRAME_COLUMNS,
+                                samples=tuple(self._rows))
+
+
+def parse_labels(labels: str) -> Dict[str, str]:
+    """Inverse of the recorder's canonical ``k=v,...`` rendering
+    (engine/tracer.py ``_labels_str``) — public because every
+    consumer that joins exported families on their labels (the frame
+    reconstruction here, tools/soak.py's invariants) must share ONE
+    inverse of the one rendering."""
+    out: Dict[str, str] = {}
+    for part in labels.split(","):
+        if "=" in part:
+            key, value = part.split("=", 1)
+            out[key] = value
+    return out
+
+
+#: the provenance counter families the real-plane extractors consume
+#: — emitted by engine/stats.py (per-fetch bytes + completions),
+#: player/sim.py via the harness (stall accrual/edges), and
+#: testing/swarm.py (membership); METRICS.md carries the signatures
+TWIN_EVENT_FAMILIES = ("twin.fetch_bytes", "twin.fetches",
+                       "twin.stall_ms", "twin.stalls", "twin.peer",
+                       "twin.upload_bytes")
+
+#: the sampler's window-boundary mark in the event stream: replaying
+#: a shard closes one frame window per mark, in SHARD ORDER (same-
+#: timestamp bumps landing after the mark belong to the next window,
+#: exactly as the live sampler saw them)
+TWIN_WINDOW_MARK = "twin_window"
+
+
+def frames_from_events(events: Iterable[dict], *,
+                       source: str = "real") -> ObservationFrame:
+    """Reconstruct the canonical frame purely from one host's
+    flight-recorder event stream — no live objects, no registries.
+
+    ``events`` must be in SHARD ORDER (``read_shard`` file order —
+    per-host emission order), not clock-sorted: the ``twin_window``
+    marks partition the stream exactly where the live sampler stood,
+    which is what makes the reconstruction equal the registry-derived
+    frames bit-for-bit.  A torn tail (SIGKILL'd writer) simply ends
+    the stream early: every window whose mark survived reconstructs
+    exactly."""
+    events = list(events)
+    window_ms = next((e.get("window_ms", 0.0) for e in events
+                      if e.get("kind") == "mark"
+                      and e.get("name") == TWIN_WINDOW_MARK), 0.0)
+    builder = FrameBuilder(source, window_ms / 1000.0)
+    for event in events:
+        kind = event.get("kind")
+        if kind == "mark" and event.get("name") == TWIN_WINDOW_MARK:
+            builder.close_window(event.get("t", 0.0))
+            continue
+        if kind != "counter":
+            continue
+        name = event.get("name", "")
+        if not name.startswith("twin."):
+            continue
+        labels = parse_labels(event.get("labels", ""))
+        peer = labels.get("peer", "")
+        n = event.get("n", 0)
+        if name == "twin.fetch_bytes":
+            builder.add_bytes(peer, labels.get("src", ""), n)
+        elif name == "twin.stall_ms":
+            builder.add_stall(peer, n)
+        elif name == "twin.peer":
+            if labels.get("event") == "join":
+                builder.set_join(peer, event.get("t", 0.0))
+            elif labels.get("event") == "leave":
+                builder.set_leave(peer, event.get("t", 0.0))
+    return builder.frame()
+
+
+def frames_from_timelines(columns, samples, *,
+                          join_s: Optional[Iterable[float]] = None,
+                          leave_s: Optional[Iterable[float]] = None,
+                          never_s: float = 1e17,
+                          source: str = "sim") -> ObservationFrame:
+    """Fold one jnp ``record_every`` metrics timeline
+    (``timeline_columns`` columns × per-interval samples) into the
+    canonical frame.  The record interval IS the frame window —
+    the twin adapter picks ``record_every`` so one sample maps to
+    one window, and the offload / rebuffer / rate / stall columns
+    carry over directly (they already share this module's
+    definitions op-for-op; ops/swarm_sim.py ``_timeline_row``).
+
+    Presence is the per-level present-peer mass summed; join/leave
+    counts come from the scenario's own ``join_s``/``leave_s``
+    arrays (seconds) under the shared window convention — the jnp
+    plane has no per-peer event stream, but its scenario arrays ARE
+    its membership ground truth.  ``leave_s`` entries at or above
+    ``never_s`` mean "never departs" (ops/swarm_sim.py NEVER_S)."""
+    columns = list(columns)
+    samples = [list(row) for row in samples]
+    t_col = columns.index("t_s")
+    level_cols = [i for i, c in enumerate(columns)
+                  if c.startswith("level_") and c.endswith("_peers")]
+    copy_cols = [columns.index(c) for c in
+                 ("offload", "rebuffer", "cdn_rate_bps",
+                  "p2p_rate_bps", "stalled_peers")]
+    joins = [float(j) for j in join_s] if join_s is not None else []
+    leaves = ([float(v) for v in leave_s]
+              if leave_s is not None else [])
+    leaves = [v for v in leaves if v < never_s]
+    if len(samples) > 1:
+        window_s = samples[1][t_col] - samples[0][t_col]
+    elif samples:
+        window_s = samples[0][t_col]
+    else:
+        window_s = 0.0
+    rows = []
+    prev_t = 0.0
+    for k, sample in enumerate(samples):
+        t = sample[t_col]
+        first = k == 0
+        n_joins = sum(1 for j in joins
+                      if _in_window(j, prev_t, t, first))
+        n_leaves = sum(1 for v in leaves
+                       if _in_window(v, prev_t, t, first))
+        present = sum(sample[i] for i in level_cols)
+        rows.append((t,) + tuple(sample[i] for i in copy_cols)
+                    + (float(present), float(n_joins),
+                       float(n_leaves)))
+        prev_t = t
+    return ObservationFrame(source=source, window_s=float(window_s),
+                            columns=FRAME_COLUMNS,
+                            samples=tuple(rows))
+
+
+# -- divergence detectors (the triage_timelines.py mold) ---------------
+
+def detect_band_divergence(sim: ObservationFrame,
+                           real: ObservationFrame, metric: str, *,
+                           rtol: float, atol: float):
+    """Per-window bounded-relative-error band: window ``w`` diverges
+    when ``|sim[w] - real[w]| > atol + rtol * max(|sim[w]|,
+    |real[w]|)``.  The finding names WHICH metric, WHICH windows
+    (first and worst, with their sample clocks), and which side
+    moved first — at the first flagged window, the plane whose value
+    changed more since the previous window is the mover (the side
+    that departed from the shared trajectory)."""
+    s = sim.column(metric)
+    r = real.column(metric)
+    t_s = sim.column("t_s")
+    n = min(len(s), len(r))
+    flagged = []
+    for w in range(n):
+        tol = atol + rtol * max(abs(s[w]), abs(r[w]))
+        err = abs(s[w] - r[w])
+        if err > tol:
+            flagged.append((w, err))
+    if not flagged:
+        return None
+    first_w = flagged[0][0]
+    worst_w, worst_err = max(flagged, key=lambda pair: pair[1])
+    d_sim = abs(s[first_w] - (s[first_w - 1] if first_w else 0.0))
+    d_real = abs(r[first_w] - (r[first_w - 1] if first_w else 0.0))
+    moved = ("sim" if d_sim > d_real
+             else "real" if d_real > d_sim else "both")
+    return {"reason": "band_divergence", "metric": metric,
+            "windows": [w for w, _err in flagged],
+            "first_window": first_w,
+            "first_t_s": round(t_s[first_w], 3),
+            "worst_window": worst_w,
+            "worst_abs_err": round(worst_err, 6),
+            "sim_value": round(s[worst_w], 6),
+            "real_value": round(r[worst_w], 6),
+            "moved_first": moved}
+
+
+def _ks_distance(a: List[float], b: List[float]) -> float:
+    """Two-sample Kolmogorov-Smirnov statistic: the max gap between
+    the empirical CDFs (stdlib merge walk, no scipy)."""
+    if not a or not b:
+        return 1.0 if (a or b) else 0.0
+    sa, sb = sorted(a), sorted(b)
+    i = j = 0
+    d = 0.0
+    while i < len(sa) and j < len(sb):
+        x = min(sa[i], sb[j])
+        while i < len(sa) and sa[i] <= x:
+            i += 1
+        while j < len(sb) and sb[j] <= x:
+            j += 1
+        d = max(d, abs(i / len(sa) - j / len(sb)))
+    return max(d, abs(1.0 - j / len(sb)), abs(i / len(sa) - 1.0))
+
+
+def detect_distribution_divergence(sim: ObservationFrame,
+                                   real: ObservationFrame,
+                                   metric: str, *, max_ks: float):
+    """Distributional agreement OVER windows: the two planes' window
+    samples of one metric, compared as distributions (two-sample KS
+    distance).  Catches what per-window bands structurally cannot —
+    e.g. the same values arriving in a different order, or one plane
+    spending systematically more windows in a regime — and fires
+    when the distance exceeds the calibrated ``max_ks``."""
+    ks = _ks_distance(sim.column(metric), real.column(metric))
+    if ks <= max_ks:
+        return None
+    return {"reason": "distribution_divergence", "metric": metric,
+            "ks": round(ks, 4), "max_ks": max_ks}
+
+
+def compare_frames(sim: ObservationFrame, real: ObservationFrame,
+                   bands: Dict[str, dict]) -> List[dict]:
+    """Run every calibrated band against the frame pair; findings in
+    metric order, structural mismatches first.  ``bands`` maps
+    metric → ``{"rtol", "atol", "max_ks"}`` (``max_ks`` optional) —
+    the committed ``TWIN_r10.json`` shape."""
+    findings: List[dict] = []
+    if sim.n_windows != real.n_windows:
+        findings.append({"reason": "window_count_mismatch",
+                         "metric": "t_s",
+                         "sim_windows": sim.n_windows,
+                         "real_windows": real.n_windows})
+    for metric in sorted(bands):
+        band = bands[metric]
+        found = detect_band_divergence(
+            sim, real, metric, rtol=float(band.get("rtol", 0.0)),
+            atol=float(band.get("atol", 0.0)))
+        if found is not None:
+            findings.append(found)
+        if "max_ks" in band:
+            found = detect_distribution_divergence(
+                sim, real, metric, max_ks=float(band["max_ks"]))
+            if found is not None:
+                findings.append(found)
+    return findings
+
+
+#: calibration floors per metric family: the smallest absolute band
+#: worth claiming (float/platform jitter for the ratio columns, "off
+#: by half a peer" for the integer membership columns, one pacing
+#: quantum of rate).  Everything else falls back to the ratio floor.
+_CALIBRATION_FLOORS = {
+    "present_peers": 0.5, "joins": 0.5, "leaves": 0.5,
+    "stalled_peers": 1.5, "cdn_rate_bps": 200_000.0,
+    "p2p_rate_bps": 200_000.0, "offload": 0.01, "rebuffer": 0.005}
+
+
+def calibrate_bands(sim: ObservationFrame, real: ObservationFrame, *,
+                    rtol: float = 0.25,
+                    headroom: float = 1.5) -> Dict[str, dict]:
+    """Measured tolerance bands for a frame pair: with the relative
+    term fixed at ``rtol``, the absolute term is the worst RESIDUAL
+    the measurement actually needed (``max_w(err_w - rtol·scale_w)``)
+    times ``headroom``, floored per metric family; ``max_ks`` is the
+    measured KS distance with the same headroom (plus one window's
+    CDF mass, floored — two same-shape distributions never get a
+    zero-width band).  ``tools/twin_gate.py --write-bands`` persists
+    the result as the committed ``TWIN_r10.json``: the bands are a
+    MEASURED error envelope, recalibrated deliberately, never
+    silently."""
+    bands: Dict[str, dict] = {}
+    n = min(sim.n_windows, real.n_windows)
+    for metric in sim.columns:
+        if metric == "t_s":
+            continue
+        s = sim.column(metric)
+        r = real.column(metric)
+        residual = 0.0
+        for w in range(n):
+            scale = max(abs(s[w]), abs(r[w]))
+            residual = max(residual,
+                           abs(s[w] - r[w]) - rtol * scale)
+        floor = _CALIBRATION_FLOORS.get(metric, 0.01)
+        ks = _ks_distance(s[:n], r[:n])
+        bands[metric] = {
+            "rtol": rtol,
+            "atol": round(max(residual * headroom, floor), 6),
+            "max_ks": round(min(max(ks * headroom + 1.0 / max(n, 1),
+                                    0.15), 1.0), 4)}
+    return bands
+
+
+def frame_errors(sim: ObservationFrame,
+                 real: ObservationFrame) -> Dict[str, dict]:
+    """Per-metric worst-case agreement summary — the fleet console's
+    twin panel and the band-calibration input: max absolute and
+    relative error with the worst window's index and clock, plus the
+    KS distance."""
+    out: Dict[str, dict] = {}
+    t_s = sim.column("t_s")
+    n = min(sim.n_windows, real.n_windows)
+    for metric in sim.columns:
+        if metric == "t_s":
+            continue
+        s = sim.column(metric)
+        r = real.column(metric)
+        worst_abs = 0.0
+        worst_rel = 0.0
+        worst_w = 0
+        worst_rel_w = 0
+        for w in range(n):
+            err = abs(s[w] - r[w])
+            if err > worst_abs:
+                worst_abs = err
+                worst_w = w
+            scale = max(abs(s[w]), abs(r[w]))
+            if scale > 0 and err / scale > worst_rel:
+                worst_rel = err / scale
+                worst_rel_w = w
+        # the two maxima land in DIFFERENT windows whenever the
+        # metric's scale swings (a big abs gap on a big value vs a
+        # big ratio on a small one) — each is reported with its own
+        # window so a consumer never points at the wrong one
+        out[metric] = {
+            "max_abs_err": round(worst_abs, 6),
+            "max_rel_err": round(worst_rel, 4),
+            "worst_window": worst_w,
+            "worst_t_s": round(t_s[worst_w], 3) if n else 0.0,
+            "worst_rel_window": worst_rel_w,
+            "worst_rel_t_s": round(t_s[worst_rel_w], 3) if n else 0.0,
+            "ks": round(_ks_distance(s[:n], r[:n]), 4)}
+    return out

@@ -22,7 +22,7 @@ on a SystemClock it plays "in real time".
 from __future__ import annotations
 
 from types import SimpleNamespace
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from ..core.abr import AbrController, compute_frag_last_kbps
 from ..core.clock import Clock, SystemClock
@@ -115,6 +115,16 @@ class SimPlayer(EventEmitter):
         self.play_ms = 0.0
         self.bytes_loaded = 0
         self.frags_loaded = 0
+
+        #: twin-observability hooks (engine/twinframe.py): the swarm
+        #: harness wires these to ``twin.stall_ms`` / ``twin.stalls``
+        #: registry counters so every rebuffer accrual and stall
+        #: open/close transition reaches the shared event plane with
+        #: the EXACT dt the ``rebuffer_ms`` clock advanced by.  None
+        #: (the default) costs nothing.
+        self.stalled = False
+        self.on_stall_accrue: Optional[Callable[[float], None]] = None
+        self.on_stall_edge: Optional[Callable[[bool], None]] = None
 
         self._loading = False
         self._loader = None
@@ -242,12 +252,37 @@ class SimPlayer(EventEmitter):
         available = self.buffer_end - position
         if available <= 0 and not self.ended:
             self.rebuffer_ms += dt_ms
+            self._note_stall(dt_ms)
             return
         advance = min(dt_s, max(available, 0.0))
         self.media.current_time = position + advance
         self.play_ms += advance * 1000.0
-        if advance < dt_s and not self.ended:
-            self.rebuffer_ms += dt_ms * (1.0 - advance / dt_s)
+        # a partial advance whose accrual rounds to exactly 0.0 ms
+        # (advance/dt_s == 1.0 to the float while advance < dt_s) is
+        # a full tick to every clock consumer: opening the stall
+        # anyway would emit a zero-delta twin.stall_ms event the
+        # registry totals cannot reflect, breaking the twin gate's
+        # event==registry exactness (stats.note_fetch_bytes skips
+        # zero deltas for the same reason)
+        stalled_ms = (dt_ms * (1.0 - advance / dt_s)
+                      if advance < dt_s and not self.ended else 0.0)
+        if stalled_ms > 0.0:
+            self.rebuffer_ms += stalled_ms
+            self._note_stall(stalled_ms)
+        elif self.stalled:
+            self.stalled = False
+            if self.on_stall_edge is not None:
+                self.on_stall_edge(False)
+
+    def _note_stall(self, dt_ms: float) -> None:
+        """One rebuffer accrual: open the stall on the first accruing
+        tick, then report the exact ms the stall clock advanced."""
+        if not self.stalled:
+            self.stalled = True
+            if self.on_stall_edge is not None:
+                self.on_stall_edge(True)
+        if self.on_stall_accrue is not None:
+            self.on_stall_accrue(dt_ms)
 
     def _frags(self, level_index: int):
         return self._levels[level_index].details.fragments

@@ -31,7 +31,8 @@ class SwarmPeer:
     """One participant: wrapper + player + (lazily created) agent."""
 
     def __init__(self, peer_id: str, wrapper: P2PWrapper, player: SimPlayer,
-                 clock: VirtualClock):
+                 clock: VirtualClock,
+                 registry: Optional[MetricsRegistry] = None):
         self.peer_id = peer_id
         self.wrapper = wrapper
         self.player = player
@@ -40,6 +41,17 @@ class SwarmPeer:
         self.left_at_ms: Optional[float] = None
         self.left = False
         self._final_stats: Optional[Dict] = None
+        # twin membership provenance (engine/twinframe.py): one
+        # clock-stamped join/leave bump per lifecycle transition, so
+        # a flight recorder attached to the harness registry carries
+        # presence as events and observation frames reconstruct
+        # membership from the stream alone
+        self._m_leave = None
+        if registry is not None:
+            registry.counter("twin.peer", peer=peer_id,
+                             event="join").inc()
+            self._m_leave = registry.counter("twin.peer", peer=peer_id,
+                                             event="leave")
 
     @property
     def agent(self) -> Optional[P2PAgent]:
@@ -80,6 +92,8 @@ class SwarmPeer:
         if not self.left:
             self.left = True
             self.left_at_ms = self._clock.now()
+            if self._m_leave is not None:
+                self._m_leave.inc()
             self._final_stats = dict(self.stats)
             self.player.destroy()
 
@@ -167,7 +181,25 @@ class SwarmHarness:
         player = wrapper.create_player(
             {"clock": self.clock, "manifest": self.manifest,
              **(player_config or {})}, cfg)
-        peer = SwarmPeer(peer_id, wrapper, player, self.clock)
+        # twin stall provenance: players exposing the stall hooks
+        # (player/sim.py) count every rebuffer accrual and stall
+        # open/close into the shared registry with the exact dt their
+        # rebuffer clock advanced by — the real plane's stall signal
+        # for engine/twinframe.py frames.  Hook-less media engines
+        # simply contribute no stall series (both frame extractors
+        # agree on the absence).
+        if hasattr(player, "on_stall_accrue"):
+            player.on_stall_accrue = self.metrics.counter(
+                "twin.stall_ms", peer=peer_id).inc
+            opened = self.metrics.counter("twin.stalls", peer=peer_id,
+                                          edge="open")
+            closed = self.metrics.counter("twin.stalls", peer=peer_id,
+                                          edge="close")
+            player.on_stall_edge = (
+                lambda is_open, _o=opened, _c=closed:
+                (_o if is_open else _c).inc())
+        peer = SwarmPeer(peer_id, wrapper, player, self.clock,
+                         registry=self.metrics)
         self.peers.append(peer)
         # a peer joining after a crash-partition must not open a fresh
         # link to the "crashed" peer

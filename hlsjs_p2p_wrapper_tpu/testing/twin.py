@@ -1,0 +1,268 @@
+"""Scenario twin adapter: ONE seeded scenario through BOTH planes.
+
+The twin observation plane (engine/twinframe.py) defines the shared
+frame; this module runs the same scenario through the two system
+models and lands each in it:
+
+- the REAL plane: a :class:`~.swarm.SwarmHarness` (full-protocol
+  agents, tracker, shaped CDN, one VirtualClock), with a
+  :class:`TwinSampler` closing one frame window per ``window_s`` of
+  simulated time from the live registry, and — when a flight
+  recorder is attached — a ``twin_window`` mark per boundary so the
+  SAME frames reconstruct from the event shard alone;
+- the SIM plane: the scanned jnp kernel (ops/swarm_sim.py) on the
+  calibrated parity mapping (tests/test_sim_vs_harness_parity.py:
+  tracker topology = full neighbors, foreground + 2 prefetch slots,
+  the "spread" holder policy, shared per-peer CDN rate and uplink),
+  with ``record_every`` chosen so one timeline sample IS one frame
+  window.
+
+A :class:`TwinScenario` is the single source of truth both planes
+consume: seed, audience size, the staggered base join schedule plus
+one join WAVE (the flash-crowd cohort the membership columns track),
+uplink/CDN rates, the watch horizon, the frame window — and an
+optional socket-fault schedule in the shared ``kind@t0-t1`` grammar
+(engine/netfaults.py), which drives the real plane's loopback fabric.
+The jnp kernel deliberately does NOT model the fault windows: the
+twin gate's calibrated chaos bands measure exactly how far the clean
+kernel drifts from a faulted wire — the honest error bar the ROADMAP
+asks the "digital twin" name to carry.
+
+Everything is deterministic per seed: same scenario, same frames.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..engine.twinframe import (FrameBuilder, ObservationFrame,
+                                TWIN_WINDOW_MARK, frames_from_events,
+                                frames_from_timelines)
+from .swarm import SwarmHarness
+
+#: the parity mapping's transfer-slot count: foreground + the agent's
+#: DEFAULT_MAX_CONCURRENT_PREFETCH (tests/test_sim_vs_harness_parity)
+SIM_CONCURRENCY = 3
+
+
+def _is_twin_family(name: str) -> bool:
+    """The twin recorder's counter scope: the provenance families
+    (engine/twinframe.py TWIN_EVENT_FAMILIES all share the prefix)."""
+    return name.startswith("twin.")
+
+
+@dataclass(frozen=True)
+class TwinScenario:
+    """One seeded scenario, expressible in both planes."""
+
+    seed: int = 0
+    #: staggered base audience: peer i joins at
+    #: ``join_offset_s + i * join_spacing_s``
+    n_peers: int = 8
+    join_spacing_s: float = 6.0
+    join_offset_s: float = 0.5
+    #: the join wave: ``wave_peers`` more viewers land together at
+    #: ``wave_at_s`` (keep it off a window boundary)
+    wave_peers: int = 4
+    wave_at_s: float = 52.5
+    frag_count: int = 24
+    seg_duration_s: float = 4.0
+    level_bitrates: Tuple[float, ...] = (800_000.0,)
+    cdn_bps: float = 8_000_000.0
+    uplink_bps: float = 2_400_000.0
+    #: scenario horizon and frame window; ``watch_s`` must be a
+    #: multiple of ``window_s`` so both planes close the same windows
+    watch_s: float = 160.0
+    window_s: float = 8.0
+    #: real-plane chaos in the shared NetFaultPlan grammar
+    #: (``loss@40-70,latency@90-110``); None = clean wire
+    fault_specs: Optional[str] = None
+    fault_kwargs: dict = field(default_factory=dict)
+
+    def join_times_s(self, wave_shift_s: float = 0.0) -> List[float]:
+        """Every peer's join clock (seconds): the staggered base
+        audience then the wave cohort.  ``wave_shift_s`` displaces
+        the wave only — the twin gate's injected sim-fidelity bug
+        (a scenario-mapping error, localized in time)."""
+        base = [self.join_offset_s + i * self.join_spacing_s
+                for i in range(self.n_peers)]
+        wave = [self.wave_at_s + wave_shift_s] * self.wave_peers
+        return base + wave
+
+    @property
+    def total_peers(self) -> int:
+        return self.n_peers + self.wave_peers
+
+    @property
+    def n_windows(self) -> int:
+        return int(round(self.watch_s / self.window_s))
+
+
+class TwinSampler:
+    """The real plane's frame recorder: one VirtualClock timer per
+    ``window_ms`` reads the live registry's ``twin.*`` provenance
+    totals and the harness membership into the shared
+    :class:`FrameBuilder`, closes the window, and — with a recorder —
+    emits the ``twin_window`` mark (flushed, so a console tailing the
+    shard sees calibration windows live and a SIGKILL costs at most
+    the open window)."""
+
+    def __init__(self, harness: SwarmHarness, window_ms: float,
+                 recorder=None, source: str = "real"):
+        self.harness = harness
+        self.window_ms = float(window_ms)
+        self.recorder = recorder
+        self.builder = FrameBuilder(source, window_ms / 1000.0)
+        self.windows = 0
+        self._arm()
+
+    def _arm(self) -> None:
+        self.harness.clock.call_later(self.window_ms, self._tick)
+
+    def _tick(self) -> None:
+        harness = self.harness
+        t_ms = harness.clock.now()
+        builder = self.builder
+        for peer in harness.peers:
+            builder.set_join(peer.peer_id, peer.joined_at_ms)
+            if peer.left_at_ms is not None:
+                builder.set_leave(peer.peer_id, peer.left_at_ms)
+        for labels, value in harness.metrics.series("twin.fetch_bytes"):
+            builder.set_bytes_total(labels["peer"], labels["src"],
+                                    value)
+        for labels, value in harness.metrics.series("twin.stall_ms"):
+            builder.set_stall_total(labels["peer"], value)
+        builder.close_window(t_ms)
+        if self.recorder is not None:
+            self.recorder.mark(TWIN_WINDOW_MARK, window=self.windows,
+                               window_ms=self.window_ms)
+            # OS-write durability is the per-window contract: a
+            # SIGKILL'd writer keeps every flushed window; per-window
+            # fsyncs only guard host crashes and were a measured
+            # double-digit share of the armed cost (tracer.flush)
+            self.recorder.flush(fsync=False)
+        self.windows += 1
+        self._arm()
+
+    def frame(self) -> ObservationFrame:
+        return self.builder.frame()
+
+
+@dataclass
+class TwinRunResult:
+    """One real-plane run's outputs: the registry-derived frame, the
+    event-reconstructed frame (None without a recorder), the shard
+    path, and the harness's final north-star pair."""
+
+    registry_frames: ObservationFrame
+    event_frames: Optional[ObservationFrame]
+    shard_path: Optional[str]
+    offload: float
+    rebuffer: float
+
+
+def run_real_plane(scenario: TwinScenario,
+                   trace_dir: Optional[str] = None,
+                   host_id: str = "twin00",
+                   extract_events: bool = True) -> TwinRunResult:
+    """Run the scenario through the real-protocol swarm and extract
+    frames both ways: sampled live from the registries, and — when
+    ``trace_dir`` is given — reconstructed from the flight-recorder
+    shard alone (``make twin-gate`` asserts the two are exactly
+    equal).  ``extract_events=False`` skips the post-run shard read +
+    reconstruction (``event_frames`` stays None, the shard stays on
+    disk): the overhead bench times the run with ONLY the recorder
+    armed, so extraction cost cannot masquerade as arming cost."""
+    harness = SwarmHarness(
+        seg_duration=scenario.seg_duration_s,
+        frag_count=scenario.frag_count,
+        level_bitrates=tuple(int(b) for b in scenario.level_bitrates),
+        cdn_bandwidth_bps=scenario.cdn_bps, seed=scenario.seed,
+        fault_plan_specs=scenario.fault_specs,
+        fault_plan_kwargs=({"seed": scenario.seed,
+                            **scenario.fault_kwargs}
+                           if scenario.fault_specs else None))
+    recorder = None
+    shard_path = None
+    if trace_dir is not None:
+        from ..engine.tracer import FlightRecorder
+        # the twin recorder is scoped to the twin data plane: only
+        # ``twin.*`` bumps become events (the families the frame
+        # reconstruction and the Perfetto twin tracks consume) —
+        # recording every unrelated family's bumps too was a
+        # measured third of the armed event plane's cost for zero
+        # calibration signal (bench.py ``detail.twin_overhead``)
+        recorder = FlightRecorder(trace_dir, host_id,
+                                  clock=harness.clock.now,
+                                  registry=harness.metrics,
+                                  counter_filter=_is_twin_family)
+        shard_path = recorder.path
+    sampler = TwinSampler(harness, scenario.window_s * 1000.0,
+                          recorder=recorder)
+    # replay joins in TIME order, not list order: the wave cohort sits
+    # after the base audience in join_times_s() but may land before
+    # its tail (n_peers >= 10 at the default spacing), and the clamp
+    # below would silently displace it — peer ids keep the list index
+    # so p{i} still maps to the sim plane's joins[i]
+    joins = scenario.join_times_s()
+    for i in sorted(range(len(joins)), key=lambda i: (joins[i], i)):
+        harness.run(max(joins[i] * 1000.0 - harness.clock.now(), 0.0))
+        harness.add_peer(f"p{i}", uplink_bps=scenario.uplink_bps)
+    harness.run(scenario.watch_s * 1000.0 - harness.clock.now())
+    event_frames = None
+    if recorder is not None:
+        recorder.close()
+        if extract_events:
+            from ..engine.tracer import read_shard
+            _meta, events = read_shard(shard_path)
+            event_frames = frames_from_events(events)
+    return TwinRunResult(registry_frames=sampler.frame(),
+                         event_frames=event_frames,
+                         shard_path=shard_path,
+                         offload=harness.offload_ratio,
+                         rebuffer=harness.rebuffer_ratio)
+
+
+def run_sim_plane(scenario: TwinScenario,
+                  wave_shift_s: float = 0.0) -> ObservationFrame:
+    """Run the scenario through the scanned jnp kernel on the
+    calibrated parity mapping and fold its ``record_every`` timeline
+    into the canonical frame (one timeline sample per window).
+    ``wave_shift_s`` displaces the wave cohort's joins in the SIM
+    ONLY — the deliberately injected fidelity bug the gate's
+    detectors must localize to the membership columns at the wave
+    window."""
+    # jax stays off the import path of the pure-host twin surface;
+    # only the sim plane pays for it
+    import jax.numpy as jnp
+
+    from ..ops.swarm_sim import (SwarmConfig, full_neighbors,
+                                 init_swarm, run_swarm,
+                                 timeline_columns)
+
+    P = scenario.total_peers
+    config = SwarmConfig(
+        n_peers=P, n_segments=scenario.frag_count,
+        n_levels=len(scenario.level_bitrates),
+        seg_duration_s=scenario.seg_duration_s,
+        max_concurrency=SIM_CONCURRENCY, holder_selection="spread")
+    record_every = int(round(scenario.window_s * 1000.0
+                             / config.dt_ms))
+    n_steps = scenario.n_windows * record_every
+    joins = scenario.join_times_s(wave_shift_s)
+    _final, _series, timeline = run_swarm(
+        config,
+        jnp.asarray([float(b) for b in scenario.level_bitrates],
+                    jnp.float32),
+        full_neighbors(P),
+        jnp.full((P,), float(scenario.cdn_bps), jnp.float32),
+        init_swarm(config), n_steps,
+        jnp.asarray(joins, jnp.float32),
+        uplink_bps=jnp.full((P,), float(scenario.uplink_bps),
+                            jnp.float32),
+        record_every=record_every)
+    import numpy as np
+    return frames_from_timelines(
+        timeline_columns(config), np.asarray(timeline).tolist(),
+        join_s=joins, leave_s=None)

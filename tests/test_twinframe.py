@@ -1,0 +1,448 @@
+"""Twin observation plane (engine/twinframe.py + testing/twin.py).
+
+Three layers of coverage, matching the twin gate's claims at unit
+granularity:
+
+- **frame reconstruction ground truth** — observation frames rebuilt
+  from the flight-recorder event shard ALONE must equal the frames
+  derived live from the registries, exactly (NamedTuple equality),
+  including across a SIGKILL'd writer whose shard the torn-tail
+  reader recovers a prefix of;
+- **divergence detectors** — fire/no-fire edges of the band and
+  distributional detectors on synthetic frames: the finding must name
+  the RIGHT metric, the RIGHT window, and the side that moved first;
+- **extractor conventions** — the shared window-membership rule
+  (``(prev, t]``, first window back through 0) applied identically by
+  the timeline folder and the event reducer, and the twin provenance
+  families converging to the authoritative byte/stall totals.
+"""
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+from hlsjs_p2p_wrapper_tpu.engine.tracer import FlightRecorder, read_shard
+from hlsjs_p2p_wrapper_tpu.engine.twinframe import (
+    FRAME_COLUMNS, FrameBuilder, ObservationFrame, _ks_distance,
+    calibrate_bands, compare_frames, detect_band_divergence,
+    detect_distribution_divergence, frame_errors, frames_from_events,
+    frames_from_timelines)
+from hlsjs_p2p_wrapper_tpu.testing.twin import (TwinScenario,
+                                                run_real_plane)
+
+# one small scenario for every harness-backed test in this file: 4
+# peers (3 staggered + a 1-peer wave off a window boundary), 6
+# windows of 8 s — seconds of wall, same code paths as the gate size
+SMALL = TwinScenario(n_peers=3, wave_peers=1, wave_at_s=20.5,
+                     watch_s=48.0, window_s=8.0)
+
+
+def synth_frame(source, metric, values, *, window_s=8.0, **others):
+    """A synthetic frame where ``metric`` walks ``values`` and every
+    other column sits at 0 (or at ``others[name]``'s walk)."""
+    rows = []
+    for w, value in enumerate(values):
+        row = []
+        for name in FRAME_COLUMNS:
+            if name == "t_s":
+                row.append((w + 1) * window_s)
+            elif name == metric:
+                row.append(float(value))
+            elif name in others:
+                row.append(float(others[name][w]))
+            else:
+                row.append(0.0)
+        rows.append(tuple(row))
+    return ObservationFrame(source=source, window_s=window_s,
+                            columns=FRAME_COLUMNS,
+                            samples=tuple(rows))
+
+
+# -- divergence detectors: fire / no-fire edges -------------------------
+
+def test_band_no_fire_within_tolerance():
+    sim = synth_frame("sim", "offload", [0.5, 0.6, 0.7])
+    real = synth_frame("real", "offload", [0.52, 0.58, 0.71])
+    assert detect_band_divergence(sim, real, "offload",
+                                  rtol=0.1, atol=0.01) is None
+
+
+def test_band_boundary_is_no_fire():
+    """err == atol + rtol*scale exactly must NOT fire (strict >):
+    the committed bands are inclusive envelopes."""
+    sim = synth_frame("sim", "offload", [0.5])
+    real = synth_frame("real", "offload", [0.6])
+    # tol = atol 0.04 + rtol 0.1 * max(0.5, 0.6) = 0.1 == err
+    assert detect_band_divergence(sim, real, "offload",
+                                  rtol=0.1, atol=0.04) is None
+    found = detect_band_divergence(sim, real, "offload",
+                                   rtol=0.1, atol=0.039)
+    assert found is not None and found["first_window"] == 0
+
+
+def test_band_names_metric_window_and_mover():
+    """The sim jumps away at window 2; the finding must localize
+    there, name the metric, and blame the sim as the mover."""
+    sim = synth_frame("sim", "offload", [0.5, 0.5, 0.9, 0.91])
+    real = synth_frame("real", "offload", [0.5, 0.5, 0.5, 0.5])
+    found = detect_band_divergence(sim, real, "offload",
+                                   rtol=0.1, atol=0.01)
+    assert found["reason"] == "band_divergence"
+    assert found["metric"] == "offload"
+    assert found["first_window"] == 2
+    assert found["first_t_s"] == pytest.approx(24.0)
+    assert found["windows"] == [2, 3]
+    assert found["moved_first"] == "sim"
+
+
+def test_band_mover_real_and_worst_window():
+    """Mirror case: the REAL plane departs, and the worst window is
+    reported separately from the first."""
+    sim = synth_frame("sim", "joins", [1, 1, 1, 1, 1])
+    real = synth_frame("real", "joins", [1, 1, 3, 6, 1])
+    found = detect_band_divergence(sim, real, "joins",
+                                   rtol=0.0, atol=0.5)
+    assert found["first_window"] == 2
+    assert found["worst_window"] == 3
+    assert found["worst_abs_err"] == pytest.approx(5.0)
+    assert found["moved_first"] == "real"
+
+
+def test_band_mover_both_on_symmetric_departure():
+    sim = synth_frame("sim", "offload", [0.5, 1.0])
+    real = synth_frame("real", "offload", [0.5, 0.0])
+    found = detect_band_divergence(sim, real, "offload",
+                                   rtol=0.0, atol=0.1)
+    assert found["moved_first"] == "both"
+
+
+def test_distribution_fires_where_bands_cannot():
+    """The SAME window values in a different order: every per-window
+    band can fire, but the distributions agree (KS 0) — and the
+    reverse: a systematic regime shift the bands excuse per-window
+    still fails the KS check."""
+    sim = synth_frame("sim", "offload", [0.2, 0.4, 0.6, 0.8])
+    real = synth_frame("real", "offload", [0.8, 0.6, 0.4, 0.2])
+    assert detect_distribution_divergence(sim, real, "offload",
+                                          max_ks=0.01) is None
+    shifted = synth_frame("real", "offload", [0.3, 0.5, 0.7, 0.9])
+    found = detect_distribution_divergence(sim, shifted, "offload",
+                                           max_ks=0.2)
+    assert found["reason"] == "distribution_divergence"
+    assert found["metric"] == "offload"
+    assert found["ks"] == pytest.approx(0.25)
+
+
+def test_ks_distance_edges():
+    assert _ks_distance([], []) == 0.0
+    assert _ks_distance([1.0], []) == 1.0
+    assert _ks_distance([1.0, 2.0], [1.0, 2.0]) == 0.0
+    assert _ks_distance([0.0, 0.0], [1.0, 1.0]) == 1.0
+
+
+def test_compare_frames_window_count_mismatch_leads():
+    sim = synth_frame("sim", "offload", [0.5, 0.5, 0.5])
+    real = synth_frame("real", "offload", [0.5, 0.5])
+    findings = compare_frames(sim, real,
+                              {"offload": {"rtol": 1.0, "atol": 1.0}})
+    assert findings[0]["reason"] == "window_count_mismatch"
+    assert findings[0]["sim_windows"] == 3
+    assert findings[0]["real_windows"] == 2
+
+
+def test_compare_frames_runs_every_band_in_metric_order():
+    sim = synth_frame("sim", "offload", [0.9, 0.9],
+                      joins=[5.0, 0.0])
+    real = synth_frame("real", "offload", [0.1, 0.1],
+                       joins=[0.0, 0.0])
+    bands = {"offload": {"rtol": 0.0, "atol": 0.01, "max_ks": 0.1},
+             "joins": {"rtol": 0.0, "atol": 0.5}}
+    findings = compare_frames(sim, real, bands)
+    assert [f["metric"] for f in findings] == \
+        ["joins", "offload", "offload"]
+    assert {f["reason"] for f in findings} == \
+        {"band_divergence", "distribution_divergence"}
+
+
+def test_calibrated_bands_admit_the_measured_pair():
+    """calibrate_bands is an ENVELOPE: the pair it measured must pass
+    its own bands (this is what --write-bands commits)."""
+    sim = synth_frame("sim", "offload",
+                      [0.1, 0.45, 0.62, 0.71, 0.7],
+                      joins=[3, 1, 0, 4, 0])
+    real = synth_frame("real", "offload",
+                       [0.2, 0.52, 0.55, 0.78, 0.69],
+                       joins=[2, 2, 0, 5, 0])
+    bands = calibrate_bands(sim, real)
+    assert set(bands) == set(FRAME_COLUMNS) - {"t_s"}
+    assert compare_frames(sim, real, bands) == []
+
+
+def test_frame_errors_reports_worst_window_and_ks():
+    sim = synth_frame("sim", "offload", [0.5, 0.5, 0.5])
+    real = synth_frame("real", "offload", [0.5, 0.8, 0.6])
+    errs = frame_errors(sim, real)
+    assert errs["offload"]["max_abs_err"] == pytest.approx(0.3)
+    assert errs["offload"]["worst_window"] == 1
+    assert errs["offload"]["worst_t_s"] == pytest.approx(16.0)
+    assert errs["offload"]["max_rel_err"] == pytest.approx(0.375)
+    assert errs["offload"]["ks"] > 0
+
+
+# -- extractor conventions ----------------------------------------------
+
+def test_timeline_folding_window_convention():
+    """The jnp folder: one timeline sample per window, presence =
+    per-level mass summed, joins/leaves counted under the shared
+    ``(prev, t]``-with-origin rule, never-leaves filtered."""
+    columns = ["t_s", "offload", "rebuffer", "cdn_rate_bps",
+               "p2p_rate_bps", "stalled_peers", "level_0_peers",
+               "level_1_peers"]
+    samples = [[8.0, 0.1, 0.0, 1e6, 2e5, 1.0, 2.0, 1.0],
+               [16.0, 0.3, 0.01, 8e5, 4e5, 0.0, 3.0, 1.0]]
+    frame = frames_from_timelines(
+        columns, samples,
+        join_s=[0.0, 4.0, 8.0, 8.5],   # 0 and the 8.0 boundary -> w0
+        leave_s=[12.0, 1e17, 1e17, 1e17])
+    assert frame.window_s == pytest.approx(8.0)
+    assert frame.column("present_peers") == [3.0, 4.0]
+    assert frame.column("joins") == [3.0, 1.0]
+    assert frame.column("leaves") == [0.0, 1.0]   # 1e17 = never
+    assert frame.column("offload") == [0.1, 0.3]
+    assert frame.column("stalled_peers") == [1.0, 0.0]
+
+
+def test_builder_incremental_equals_absolute_feeders():
+    """The one-reducer contract: deltas (event replay) and absolute
+    totals (registry sampling) land in IDENTICAL rows."""
+    inc = FrameBuilder("real", 8.0)
+    ab = FrameBuilder("real", 8.0)
+    for b in (inc, ab):
+        b.set_join("a", 0.0)
+        b.set_join("b", 3000.0)
+    inc.add_bytes("a", "cdn", 1000)
+    inc.add_bytes("a", "p2p", 500)
+    inc.add_bytes("b", "cdn", 200)
+    inc.add_stall("b", 120.0)
+    ab.set_bytes_total("a", "cdn", 1000)
+    ab.set_bytes_total("a", "p2p", 500)
+    ab.set_bytes_total("b", "cdn", 200)
+    ab.set_stall_total("b", 120.0)
+    assert inc.close_window(8000.0) == ab.close_window(8000.0)
+    inc.add_bytes("a", "p2p", 700)
+    ab.set_bytes_total("a", "p2p", 1200)
+    ab.set_stall_total("b", 120.0)   # unchanged total: not stalled
+    inc.set_leave("b", 9000.0)
+    ab.set_leave("b", 9000.0)
+    assert inc.close_window(16000.0) == ab.close_window(16000.0)
+    assert inc.frame() == ab.frame()
+    row = inc.frame().samples[1]
+    cols = dict(zip(FRAME_COLUMNS, row))
+    assert cols["stalled_peers"] == 0.0   # per-window, it reset
+    assert cols["present_peers"] == 1.0   # b left inside window 1
+    assert cols["leaves"] == 1.0
+    assert cols["p2p_rate_bps"] == pytest.approx(700 * 8.0 / 8.0)
+
+
+def test_frames_from_events_synthetic_shard(tmp_path):
+    """Counter bumps + ``twin_window`` marks through a REAL recorder
+    shard reconstruct exactly the frame a parallel builder derives —
+    including a same-stamp bump AFTER the mark landing in the next
+    window (shard order, not clock order)."""
+    t = [0.0]
+    registry = MetricsRegistry()
+    rec = FlightRecorder(str(tmp_path), "h", clock=lambda: t[0],
+                         registry=registry)
+    fetch = registry.counter("twin.fetch_bytes", peer="a", src="cdn")
+    builder = FrameBuilder("real", 8.0)
+    registry.counter("twin.peer", peer="a", event="join").inc()
+    builder.set_join("a", 0.0)
+    t[0] = 5000.0
+    fetch.inc(1000)
+    builder.add_bytes("a", "cdn", 1000)
+    t[0] = 8000.0
+    rec.mark("twin_window", window=0, window_ms=8000.0)
+    builder.close_window(8000.0)
+    fetch.inc(50)            # same stamp as the mark, emitted after
+    builder.add_bytes("a", "cdn", 50)
+    t[0] = 16000.0
+    rec.mark("twin_window", window=1, window_ms=8000.0)
+    builder.close_window(16000.0)
+    rec.close()
+    _meta, events = read_shard(os.path.join(str(tmp_path), "h.jsonl"))
+    frame = frames_from_events(events)
+    assert frame == builder.frame()
+    assert frame.window_s == pytest.approx(8.0)
+    assert frame.column("cdn_rate_bps")[1] == \
+        pytest.approx(50 * 8.0 / 8.0)
+
+
+def test_counter_filter_scopes_the_recorder(tmp_path):
+    """A recorder with ``counter_filter`` records only matching
+    families' bumps; explicit emits (marks) always pass — the twin
+    recorder's scoping knob."""
+    registry = MetricsRegistry()
+    rec = FlightRecorder(str(tmp_path), "h", clock=lambda: 1.0,
+                         registry=registry,
+                         counter_filter=lambda n:
+                         n.startswith("twin."))
+    registry.counter("twin.fetch_bytes", peer="a", src="cdn").inc(10)
+    registry.counter("tracker.announces").inc()
+    rec.mark("twin_window", window=0, window_ms=8000.0)
+    rec.close()
+    _meta, events = read_shard(os.path.join(str(tmp_path), "h.jsonl"))
+    names = [e["name"] for e in events]
+    assert "twin.fetch_bytes" in names
+    assert "twin_window" in names
+    assert "tracker.announces" not in names
+
+
+# -- frame reconstruction ground truth (the harness-backed layer) -------
+
+def test_event_frames_equal_registry_frames_exactly(tmp_path):
+    """The gate's core claim at test size: frames reconstructed from
+    the shard alone == frames sampled live, NamedTuple-exact, and
+    the sampler closed every scheduled window."""
+    result = run_real_plane(SMALL, trace_dir=str(tmp_path))
+    assert result.registry_frames.n_windows == SMALL.n_windows
+    assert result.event_frames == result.registry_frames
+    # the run did real work (a vacuously-empty frame also "agrees")
+    assert sum(result.registry_frames.column("joins")) == \
+        SMALL.total_peers
+    assert max(result.registry_frames.column("p2p_rate_bps")) > 0
+
+
+def test_event_frames_equal_under_chaos(tmp_path):
+    """Same exactness through a faulted wire: the loss window changes
+    WHAT happened, never the two extractions' agreement."""
+    chaos = dataclasses.replace(
+        SMALL, fault_specs="loss@10-20",
+        fault_kwargs={"loss_rate": 0.3})
+    result = run_real_plane(chaos, trace_dir=str(tmp_path))
+    assert result.event_frames == result.registry_frames
+
+
+def test_same_seed_reruns_are_frame_identical(tmp_path):
+    a = run_real_plane(SMALL, trace_dir=str(tmp_path / "a"))
+    b = run_real_plane(SMALL, trace_dir=str(tmp_path / "b"))
+    assert a.registry_frames == b.registry_frames
+    assert a.event_frames == b.event_frames
+
+
+def test_torn_shard_reconstructs_surviving_windows(tmp_path):
+    """A shard torn mid-record (the SIGKILL disk state): the
+    torn-tail reader yields the durable prefix and every window whose
+    mark survived reconstructs EXACTLY."""
+    result = run_real_plane(SMALL, trace_dir=str(tmp_path))
+    with open(result.shard_path, encoding="utf-8") as fh:
+        lines = fh.readlines()
+    # keep everything through the 3rd window mark, then a torn tail
+    marks = [i for i, line in enumerate(lines)
+             if '"twin_window"' in line]
+    assert len(marks) == SMALL.n_windows
+    torn = lines[:marks[2] + 1] + ['{"t": 99, "kind": "coun']
+    with open(result.shard_path, "w", encoding="utf-8") as fh:
+        fh.writelines(torn)
+    _meta, events = read_shard(result.shard_path)
+    frame = frames_from_events(events)
+    assert frame.n_windows == 3
+    assert frame.samples == result.registry_frames.samples[:3]
+
+
+def test_sigkilled_writer_frames_match_uninterrupted_run(tmp_path):
+    """A REAL SIGKILL'd writer process: the parent kills the child
+    mid-scenario, reads its shard with the torn-tail reader, and the
+    reconstructed windows must equal the same-seed uninterrupted
+    run's frames prefix-exactly (determinism + per-window flush)."""
+    child = (
+        "import sys\n"
+        f"sys.path.insert(0, {repr(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))})\n"
+        "from hlsjs_p2p_wrapper_tpu.testing.twin import (TwinScenario,"
+        " run_real_plane)\n"
+        "sc = TwinScenario(n_peers=3, wave_peers=1, wave_at_s=20.5,"
+        " watch_s=4000.0, window_s=8.0)\n"
+        f"run_real_plane(sc, trace_dir={repr(str(tmp_path / 'kill'))})\n")
+    proc = subprocess.Popen([sys.executable, "-c", child])
+    shard = tmp_path / "kill" / "twin00.jsonl"
+    try:
+        deadline = time.time() + 120.0
+        marks = 0
+        while time.time() < deadline and marks < 4:
+            if shard.exists():
+                with open(shard, encoding="utf-8") as fh:
+                    marks = fh.read().count('"twin_window"')
+            if proc.poll() is not None:
+                pytest.fail("child finished before the kill")
+            time.sleep(0.05)
+        assert marks >= 4, "child never flushed 4 windows"
+        os.kill(proc.pid, signal.SIGKILL)
+        assert proc.wait(timeout=60) == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+    _meta, events = read_shard(str(shard))
+    frame = frames_from_events(events)
+    assert frame.n_windows >= 4
+    # ground truth: the same seed run uninterrupted (shorter horizon
+    # covering the survived windows is the same deterministic prefix)
+    horizon = frame.n_windows * SMALL.window_s
+    ref = run_real_plane(dataclasses.replace(
+        SMALL, watch_s=horizon, wave_at_s=20.5))
+    assert frame.samples == \
+        ref.registry_frames.samples[:frame.n_windows]
+
+
+def test_provenance_families_converge_to_totals():
+    """The soak invariant at unit scale: the additive ``twin.*``
+    event families equal the authoritative AgentStats / player totals
+    at quiesce, per peer — bytes never arrive without fetch events."""
+    from hlsjs_p2p_wrapper_tpu.testing.swarm import SwarmHarness
+    harness = SwarmHarness(seg_duration=SMALL.seg_duration_s,
+                           frag_count=SMALL.frag_count,
+                           cdn_bandwidth_bps=SMALL.cdn_bps, seed=3)
+    for i in range(3):
+        harness.add_peer(f"p{i}", uplink_bps=SMALL.uplink_bps)
+        harness.run(4000.0)
+    # play the whole VOD out plus the serve TTL: at true quiesce no
+    # serve is mid-flight, so every provenance flush has landed
+    harness.run(150_000.0)
+    by_peer = {}
+    for labels, value in harness.metrics.series("twin.fetch_bytes"):
+        by_peer[(labels["peer"], labels["src"])] = value
+    fetches = {(labels["peer"], labels["src"]): value for labels, value
+               in harness.metrics.series("twin.fetches")}
+    for peer in harness.peers:
+        stats = peer.stats
+        assert by_peer.get((peer.peer_id, "cdn"), 0) == stats["cdn"]
+        assert by_peer.get((peer.peer_id, "p2p"), 0) == stats["p2p"]
+        for src in ("cdn", "p2p"):
+            if by_peer.get((peer.peer_id, src), 0) > 0:
+                assert fetches.get((peer.peer_id, src), 0) > 0, \
+                    f"{peer.peer_id} has {src} bytes but no fetches"
+        twin_stall = next(
+            (v for labels, v in harness.metrics.series("twin.stall_ms")
+             if labels["peer"] == peer.peer_id), 0.0)
+        assert twin_stall == peer.player.rebuffer_ms
+    # upload provenance: at quiesce no serve is mid-flight, so the
+    # per-serve-exit flush has converged to the mesh totals
+    twin_up = {labels["peer"]: v for labels, v
+               in harness.metrics.series("twin.upload_bytes")}
+    for peer in harness.peers:
+        if peer.agent is not None:
+            assert twin_up.get(peer.peer_id, 0) == \
+                peer.agent.mesh.upload_bytes
+    # stall edges pair up: open count - close count is 0 or 1 (a
+    # stall can be open at the horizon, never closed twice)
+    edges = {}
+    for labels, value in harness.metrics.series("twin.stalls"):
+        edges.setdefault(labels["peer"], {})[labels["edge"]] = value
+    for peer_id, counts in edges.items():
+        gap = counts.get("open", 0) - counts.get("close", 0)
+        assert gap in (0, 1), f"{peer_id} stall edges unbalanced"

@@ -16,7 +16,12 @@ refresh:
   throughput over the trailing window, retry/backoff and bisection
   counts (``dispatch_faults``), row-cache hit rate
   (``aot_cache_events``), and the age of each host's last event
-  (a heartbeat: a silent shard is a dead or wedged host).
+  (a heartbeat: a silent shard is a dead or wedged host);
+- **twin calibration panel** (``--twin TWIN_FRAMES_local.json``, the
+  ``tools/twin_gate.py`` artifact) — per scenario, each frame
+  metric's max relative error between the sim and real planes with
+  the worst window's index and clock (engine/twinframe.py
+  ``frame_errors``): where the digital twin diverges, at a glance.
 
 Both sources are append-only and torn-tail tolerant
 (``read_jsonl_tolerant``), so tailing a LIVE fleet mid-write is safe
@@ -35,6 +40,7 @@ Usage::
 """
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -46,6 +52,13 @@ from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
     read_jsonl_tolerant)
 from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
     merge_trace)
+from hlsjs_p2p_wrapper_tpu.engine.twinframe import (  # noqa: E402
+    ObservationFrame, frame_errors)
+
+#: the twin panel's headline metrics, in display order (the gate's
+#: agreement trio plus the delivery rates)
+TWIN_PANEL_METRICS = ("offload", "rebuffer", "present_peers",
+                      "p2p_rate_bps", "cdn_rate_bps")
 
 #: trailing window for the rows/s throughput read
 RATE_WINDOW_S = 30.0
@@ -145,7 +158,53 @@ def host_activity(events, now):
     return hosts
 
 
-def render_frame(fabric_dir=None, trace_dir=None, now=None) -> str:
+def twin_panel(twin_path) -> list:
+    """Twin-calibration panel lines from a twin-frames artifact:
+    per scenario, each headline metric's max relative error and the
+    worst window (engine/twinframe.py ``frame_errors``)."""
+    try:
+        with open(twin_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as exc:
+        return [f"twin {twin_path}: unreadable ({exc})"]
+    lines = []
+    scenarios = doc.get("scenarios", {})
+    if not isinstance(scenarios, dict):
+        return [f"twin {twin_path}: not a twin-frames artifact"]
+    for name in sorted(scenarios):
+        planes = scenarios[name]
+        # a valid-JSON artifact of the wrong shape (the bands file
+        # lives right next to the frames file) degrades to a line,
+        # not a traceback killing a --follow console
+        try:
+            sim = ObservationFrame.from_dict(planes["sim"])
+            real = ObservationFrame.from_dict(planes["real"])
+            # frame_errors is inside the guard too: frames that
+            # parse but carry foreign/mismatched columns raise from
+            # tuple.index in there, not just in from_dict
+            errors = frame_errors(sim, real)
+        except (KeyError, TypeError, ValueError) as exc:
+            lines.append(f"twin {name}: not a sim/real frame pair "
+                         f"({exc.__class__.__name__}: {exc})")
+            continue
+        parts = []
+        for metric in TWIN_PANEL_METRICS:
+            err = errors.get(metric)
+            if err is None:
+                continue
+            parts.append(
+                f"{metric} {err['max_rel_err']:.1%} @ "
+                f"w{err['worst_rel_window']} "
+                f"(t={err['worst_rel_t_s']:g}s)")
+        lines.append(f"twin {name}: {sim.n_windows} windows — "
+                     + "; ".join(parts))
+    if not lines:
+        lines.append(f"twin {twin_path}: no scenarios in artifact")
+    return lines
+
+
+def render_frame(fabric_dir=None, trace_dir=None, now=None,
+                 twin_path=None) -> str:
     """One console frame as text (the testable surface)."""
     now = time.time() if now is None else now
     lines = []
@@ -213,8 +272,11 @@ def render_frame(fabric_dir=None, trace_dir=None, now=None) -> str:
                         f"{t.get('shard_sweeps', 0)}")
         else:
             lines.append(f"trace {trace_dir}: no event shards yet")
+    if twin_path:
+        lines.extend(twin_panel(twin_path))
     if not lines:
-        lines.append("nothing to watch (pass --fabric and/or --trace)")
+        lines.append("nothing to watch (pass --fabric, --trace "
+                     "and/or --twin)")
     return "\n".join(lines)
 
 
@@ -224,6 +286,11 @@ def main(argv=None) -> int:
                     help="fabric directory (claim files) to tail")
     ap.add_argument("--trace", metavar="DIR",
                     help="flight-recorder trace directory to tail")
+    ap.add_argument("--twin", metavar="FILE",
+                    help="twin calibration frames artifact "
+                         "(tools/twin_gate.py TWIN_FRAMES_local"
+                         ".json) — adds the per-metric divergence "
+                         "panel")
     ap.add_argument("--follow", action="store_true",
                     help="refresh continuously (default: one "
                          "post-mortem frame)")
@@ -234,12 +301,13 @@ def main(argv=None) -> int:
                     help="stop after N frames under --follow "
                          "(0 = until interrupted; test hook)")
     args = ap.parse_args(argv)
-    if not (args.fabric or args.trace):
-        ap.error("nothing to watch: pass --fabric DIR and/or "
-                 "--trace DIR")
+    if not (args.fabric or args.trace or args.twin):
+        ap.error("nothing to watch: pass --fabric DIR, --trace DIR "
+                 "and/or --twin FILE")
     frames = 0
     while True:
-        print(render_frame(args.fabric, args.trace))
+        print(render_frame(args.fabric, args.trace,
+                           twin_path=args.twin))
         frames += 1
         if not args.follow or (args.max_frames
                                and frames >= args.max_frames):

@@ -291,6 +291,10 @@ CLOCK_FILES = (
     os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "tracker.py"),
     os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "mesh.py"),
     os.path.join("hlsjs_p2p_wrapper_tpu", "ops", "swarm_sim.py"),
+    # the twin observation plane: frames are VirtualClock-stamped by
+    # construction — a naked wall-clock read here would let the two
+    # planes' windows drift apart undetectably
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "twinframe.py"),
 )
 
 #: the transports (round 10): these ALSO flag naked

@@ -20,6 +20,14 @@ rebuffer from the ``peer.rebuffer_ms`` / ``peer.watched_ms`` gauges.
 A metric the exporter dropped would fail the run, which is exactly
 the point: the export path is complete or the soak is red.
 
+The twin provenance families (engine/twinframe.py) are held to the
+same standard: per peer, ``twin.fetch_bytes{src}`` must equal the
+authoritative ``agent.{cdn,p2p}_bytes`` totals (swarm-wide, bytes
+imply ``twin.fetches`` completions), ``twin.stall_ms`` must equal the
+player's rebuffer clock, and ``twin.upload_bytes`` plus the exported
+in-flight residual must reproduce ``agent.upload_bytes`` — an agent
+reporting bytes without matching fetch events fails the soak.
+
 Deterministic (seeded RNG + VirtualClock; exported timestamps are
 simulated ms).  ~35 s of wall clock for ~5 simulated minutes with
 ~36 churned viewers.
@@ -47,6 +55,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
     read_jsonl_tolerant)
+from hlsjs_p2p_wrapper_tpu.engine.twinframe import (  # noqa: E402
+    parse_labels)
 from hlsjs_p2p_wrapper_tpu.testing import SwarmHarness  # noqa: E402
 
 
@@ -56,6 +66,19 @@ def series_sum(metrics: dict, name: str) -> float:
     return sum(v for k, v in metrics.items()
                if (k == name or k.startswith(name + "{"))
                and isinstance(v, (int, float)))
+
+
+def labeled_series(metrics: dict, name: str) -> list:
+    """One family's ``(labels dict, value)`` pairs parsed back out of
+    an exported snapshot's ``name{k=v,...}`` keys — strips the key
+    wrapper, then delegates the inner parse to the canonical inverse
+    (engine/twinframe.py ``parse_labels``), so invariants can join
+    families on their labels (per peer, per src) from the artifact
+    alone without a second, drift-prone parser."""
+    prefix = name + "{"
+    return [(parse_labels(key[len(prefix):-1]), value)
+            for key, value in metrics.items()
+            if key.startswith(prefix) and key.endswith("}")]
 
 
 def main() -> int:
@@ -135,6 +158,17 @@ def main() -> int:
     m.gauge("soak.seed_stale_downloads").set(len(stale_downloads))
     m.gauge("soak.seed_banned").set(len(mesh._banned))
     m.gauge("soak.seed_stale_penalties").set(len(stale_penalties))
+    # twin provenance residual (engine/twinframe.py): bytes a LIVE
+    # mesh has accepted for still-open serves but not yet flushed
+    # into ``twin.upload_bytes`` (the flush is per serve EXIT, and a
+    # live-mode swarm legitimately holds serves open at the horizon;
+    # departed peers flushed everything at mesh close) — exported so
+    # the upload-conservation check below reads ONLY the artifact
+    inflight = sum(u.offset - u.reported
+                   for p in swarm.peers
+                   if not p.left and p.agent is not None
+                   for u in p.agent.mesh._uploads.values())
+    m.gauge("soak.upload_inflight_bytes").set(inflight)
     swarm.record_metrics()
     exporter.export(round=args.rounds, final=True)
     exporter.close()
@@ -212,6 +246,67 @@ def main() -> int:
           "tracker.announces missing from the export")
     check(any(k.startswith("mesh.reaps") for k in final),
           "mesh reap counters missing from the export")
+
+    # ---- twin provenance conservation (engine/twinframe.py) --------
+    # the additive twin.* event families must re-derive the
+    # authoritative byte/stall totals from the artifact alone: an
+    # agent reporting bytes WITHOUT matching fetch events (or a
+    # provenance path dropping a delta) shows up as a per-peer
+    # mismatch here, with the peer and source named
+    fetch_bytes = {(lbl["peer"], lbl["src"]): v
+                   for lbl, v in labeled_series(final,
+                                                "twin.fetch_bytes")}
+    fetch_done = {(lbl["peer"], lbl["src"]): v
+                  for lbl, v in labeled_series(final, "twin.fetches")}
+    for src, family in (("cdn", "agent.cdn_bytes"),
+                        ("p2p", "agent.p2p_bytes")):
+        for lbl, total in labeled_series(final, family):
+            peer_id = lbl["peer"]
+            prov = fetch_bytes.get((peer_id, src), 0)
+            check(prov == total,
+                  f"twin.fetch_bytes{{peer={peer_id},src={src}}} = "
+                  f"{prov} but {family} = {total} — the provenance "
+                  f"event plane dropped a delta")
+            # NOTE bytes do NOT imply a completion per peer: a churned
+            # viewer's aborted first fetch (or one still in flight at
+            # the horizon) accrues on_progress deltas without ever
+            # firing note_fetch_done — the conservation check above is
+            # the real "bytes without events" detector.  The sound
+            # direction: a counted completion must have moved bytes.
+            check(fetch_done.get((peer_id, src), 0) == 0 or total > 0,
+                  f"peer {peer_id} counts "
+                  f"{fetch_done.get((peer_id, src), 0)} twin.fetches"
+                  f"{{src={src}}} completions but zero {src} bytes")
+    # swarm level the implication DOES hold: a healthy soak cannot
+    # move bytes while completing no fetch anywhere, for either source
+    for src in ("cdn", "p2p"):
+        total_bytes = sum(v for (_, s), v in fetch_bytes.items()
+                          if s == src)
+        total_done = sum(v for (_, s), v in fetch_done.items()
+                         if s == src)
+        check(total_bytes == 0 or total_done > 0,
+              f"swarm moved {total_bytes} {src} bytes but completed "
+              f"zero twin.fetches{{src={src}}}")
+    # stall provenance: the twin.stall_ms counter accrues the exact
+    # dt the player's rebuffer clock advanced by, so the two agree
+    # per peer to the float
+    stall_ms = {lbl["peer"]: v
+                for lbl, v in labeled_series(final, "twin.stall_ms")}
+    for lbl, rebuffer_ms in labeled_series(final, "peer.rebuffer_ms"):
+        check(stall_ms.get(lbl["peer"], 0.0) == rebuffer_ms,
+              f"twin.stall_ms{{peer={lbl['peer']}}} = "
+              f"{stall_ms.get(lbl['peer'], 0.0)} but the player "
+              f"accrued {rebuffer_ms} — stall provenance leaked")
+    # upload conservation: per-serve-exit flushes + the exported
+    # in-flight residual must reproduce the mesh totals exactly
+    twin_upload = series_sum(final, "twin.upload_bytes")
+    agent_upload = series_sum(final, "agent.upload_bytes")
+    check(twin_upload + final["soak.upload_inflight_bytes"]
+          == agent_upload,
+          f"twin.upload_bytes {twin_upload} + in-flight "
+          f"{final['soak.upload_inflight_bytes']} != "
+          f"agent.upload_bytes {agent_upload} — a serve exit path "
+          f"skipped its provenance flush")
     if args.chaos:
         # the schedule must have RUN (a chaos soak whose windows
         # never fired proves nothing), observable from the artifact:

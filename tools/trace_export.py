@@ -18,7 +18,14 @@ causally-ordered timeline:
   duplicate) on the ``lease`` thread;
 - counter TRACKS (``ph="C"``) per host: cumulative retries,
   row-cache hits/misses, and rows completed — the at-a-glance
-  "is recovery or the cache doing the work" view.
+  "is recovery or the cache doing the work" view — plus cumulative
+  ``twin_cdn_bytes`` / ``twin_p2p_bytes`` tracks when a shard
+  carries the swarm provenance events (engine/twinframe.py);
+- with ``--twin-frames TWIN_FRAMES.json`` (the ``tools/twin_gate.py``
+  artifact), PAIRED twin calibration tracks: per scenario, one
+  counter track per frame metric carrying BOTH planes' window
+  values as two series (``sim`` / ``real``) — a sim↔real divergence
+  renders as two visibly separating lines in ui.perfetto.dev.
 
 Timestamps are microseconds relative to the earliest event across
 all shards; span events use their recorded start stamp + measured
@@ -48,6 +55,8 @@ from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
     atomic_write_text)
 from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
     read_shard, shard_paths)
+from hlsjs_p2p_wrapper_tpu.engine.twinframe import (  # noqa: E402
+    parse_labels)
 
 #: thread ids within each host's process (named via thread_name
 #: metadata): spans + fault instants on DISPATCH, lease steps on
@@ -114,7 +123,8 @@ def export_trace(events, host_meta=None) -> dict:
                     "tid": TID_LEASE, "args": {"name": "lease"}})
     # cumulative per-host counter tracks
     counts = {host: {"retries": 0, "cache_hits": 0, "cache_misses": 0,
-                     "rows": 0} for host in hosts}
+                     "rows": 0, "twin_cdn_bytes": 0,
+                     "twin_p2p_bytes": 0} for host in hosts}
     for event in events:
         host = event.get("host", "?")
         pid = pids[host]
@@ -151,11 +161,72 @@ def export_trace(events, host_meta=None) -> dict:
                                 "ts": _micros(event["t"], t0),
                                 "args": {bucket:
                                          counts[host][bucket]}})
+            elif name == "twin.fetch_bytes":
+                # swarm data-plane provenance (engine/twinframe.py):
+                # cumulative delivered bytes by source, one track per
+                # host — the offload ramp as a picture
+                # the canonical label inverse, not a substring test:
+                # peer ids are arbitrary strings and may contain a
+                # literal "src=..." that would mis-bucket the event
+                src = parse_labels(labels).get("src")
+                bucket = ("twin_cdn_bytes" if src == "cdn"
+                          else "twin_p2p_bytes"
+                          if src == "p2p" else None)
+                if bucket:
+                    counts[host][bucket] += int(event.get("n", 0))
+                    out.append({"ph": "C", "pid": pid,
+                                "name": bucket,
+                                "ts": _micros(event["t"], t0),
+                                "args": {bucket:
+                                         counts[host][bucket]}})
     return {"traceEvents": out, "displayTimeUnit": "ms",
             "otherData": {
                 "source": "hlsjs_p2p_wrapper_tpu flight recorder",
                 "hosts": hosts,
                 **({"runs": host_meta} if host_meta else {})}}
+
+
+def export_twin_frames(doc: dict) -> list:
+    """Chrome trace events for a twin-frames artifact
+    (``tools/twin_gate.py`` ``TWIN_FRAMES_local.json``): one process
+    per scenario, one counter track per frame metric, each track
+    carrying BOTH planes' per-window values as two series (``sim`` /
+    ``real``) — the paired-lines view of a calibration window.
+    Timestamps are the frames' own window clocks (simulated
+    seconds → trace microseconds)."""
+    out = []
+    scenarios = sorted(doc.get("scenarios", {}).items())
+    for sc_i, (name, planes) in enumerate(scenarios):
+        pid = 1000 + sc_i
+        out.append({"ph": "M", "name": "process_name", "pid": pid,
+                    "tid": 0,
+                    "args": {"name": f"twin {name} (sim vs real)"}})
+        if (not isinstance(planes, dict) or "sim" not in planes
+                or "real" not in planes):
+            # the bands artifact (TWIN_r10.json) lives right next to
+            # the frames artifact and also has a "scenarios" key —
+            # name the mix-up instead of dying on a KeyError
+            raise ValueError(
+                f"scenario {name!r} is not a sim/real frame pair — "
+                f"pass the twin-frames artifact "
+                f"(TWIN_FRAMES_local.json), not the bands file")
+        sim = planes["sim"]
+        real = planes["real"]
+        t_col = sim["columns"].index("t_s")
+        n = min(len(sim["samples"]), len(real["samples"]))
+        for metric in sim["columns"]:
+            if metric == "t_s":
+                continue
+            col = sim["columns"].index(metric)
+            rcol = real["columns"].index(metric)
+            for w in range(n):
+                out.append({
+                    "ph": "C", "pid": pid,
+                    "name": f"twin:{name}:{metric}",
+                    "ts": round(sim["samples"][w][t_col] * 1e6, 3),
+                    "args": {"sim": sim["samples"][w][col],
+                             "real": real["samples"][w][rcol]}})
+    return out
 
 
 def export_dir(trace_dir: str) -> dict:
@@ -180,17 +251,44 @@ def export_dir(trace_dir: str) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("trace_dir", metavar="DIR",
+    ap.add_argument("trace_dir", metavar="DIR", nargs="?",
                     help="flight-recorder trace directory "
                          "(per-host *.jsonl event shards)")
+    ap.add_argument("--twin-frames", metavar="FILE",
+                    help="twin calibration frames artifact "
+                         "(tools/twin_gate.py TWIN_FRAMES_local.json)"
+                         " — adds paired sim/real counter tracks")
     ap.add_argument("--out", metavar="FILE",
-                    help="output path (default: DIR/trace.json)")
+                    help="output path (default: DIR/trace.json, or "
+                         "twin_trace.json next to --twin-frames)")
     args = ap.parse_args(argv)
-    out_path = args.out or os.path.join(args.trace_dir, "trace.json")
-    trace = export_dir(args.trace_dir)
+    if not args.trace_dir and not args.twin_frames:
+        ap.error("nothing to export: pass DIR and/or --twin-frames")
+    if args.trace_dir:
+        trace = export_dir(args.trace_dir)
+        out_path = args.out or os.path.join(args.trace_dir,
+                                            "trace.json")
+    else:
+        trace = {"traceEvents": [], "displayTimeUnit": "ms",
+                 "otherData": {"source": "hlsjs_p2p_wrapper_tpu "
+                                         "twin frames",
+                               "hosts": []}}
+        out_path = args.out or os.path.join(
+            os.path.dirname(os.path.abspath(args.twin_frames)),
+            "twin_trace.json")
+    if args.twin_frames:
+        with open(args.twin_frames, encoding="utf-8") as fh:
+            try:
+                trace["traceEvents"].extend(
+                    export_twin_frames(json.load(fh)))
+            except ValueError as exc:
+                print(f"trace_export: {args.twin_frames}: {exc}",
+                      file=sys.stderr)
+                return 1
     n = len(trace["traceEvents"])
     if not n:
-        print(f"trace_export: no events under {args.trace_dir}",
+        sources = [s for s in (args.trace_dir, args.twin_frames) if s]
+        print(f"trace_export: no events in {', '.join(sources)}",
               file=sys.stderr)
         return 1
     atomic_write_text(out_path, json.dumps(trace) + "\n")
