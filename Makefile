@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
 	coverage soak scaling-artifact warmstart-gate chaos-gate \
 	fleet-gate trace-gate tracker-gate net-chaos-gate optimize-gate \
-	twin-gate
+	twin-gate control-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -163,6 +163,25 @@ optimize-gate:
 twin-gate:
 	$(PY) tools/twin_gate.py
 
+# Live control plane (round 13): the forecast-driven controller must
+# CLOSE the observe→predict→actuate loop under chaos, measurably —
+# (A) on a loopback swarm with an injected regional loss window, the
+# controller's banded knob change beats the static config on the
+# constrained objective by MORE than the committed chaos-band
+# envelope (TWIN_r10.json — the win must exceed anything the twin
+# could call noise), every decision names the band it cleared or
+# held inside, the swarm converges to the published knob epoch, and
+# a same-seed rerun reproduces identical decisions with the forecast
+# served entirely from the row cache; (B) SET_KNOBS/KNOB_UPDATE
+# survive the real TCP PSK wire through a blackhole window (stale
+# epochs refused + counted, late joiners converge on first
+# announce); (C) a controller SIGKILLed between actuation and
+# checkpoint must --resume to the identical decision sequence with
+# every epoch actuated EXACTLY once.  CONTROL_GATE_SEED /
+# CONTROL_GATE_PEERS / CONTROL_GATE_WAVE resize it.
+control-gate:
+	$(PY) tools/control_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -172,6 +191,7 @@ examples:
 	$(PY) examples/production_demo.py
 
 check: lint test dryrun warmstart-gate chaos-gate fleet-gate \
-	trace-gate tracker-gate net-chaos-gate optimize-gate twin-gate
+	trace-gate tracker-gate net-chaos-gate optimize-gate twin-gate \
+	control-gate
 
 all: check bench

@@ -1027,6 +1027,134 @@ def policy_opt_benchmark():
     }
 
 
+def control_tick_benchmark():
+    """``detail.control_tick``: per-phase wall breakdown of a live
+    control tick (engine/controller.py) at control-gate size, cold
+    vs warm row cache, plus the twin-band narrowing this round's
+    CDN-pacing parity fix bought (the envelope the controller's
+    do-no-harm rule inherits).
+
+    One real-plane run of the gate scenario records the observation
+    shard; the ControlLoop then replays it OFFLINE twice against one
+    throwaway warm-start cache.  The COLD pass pays the forecast
+    lattice's compiles and every row dispatch; the WARM pass (a
+    fresh loop, same cache) must forecast entirely from the layer-2
+    row cache with ZERO XLA compiles — asserted via CompileCounter
+    and the ``control.forecast_rows{source=dispatch}`` counter —
+    which is the marginal steady-state cost of a controller tick.
+    Phase walls (engine/controller.py TICK_PHASES) are medians over
+    the post-warmup ticks; both passes must derive the identical
+    decision sequence (the replay-determinism the gate proves at
+    process level)."""
+    import tempfile
+
+    from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (
+        CompileCounter, WarmStart)
+    from hlsjs_p2p_wrapper_tpu.engine.controller import (
+        TICK_PHASES, ControlConfig, ControlLoop, LogActuator)
+    from hlsjs_p2p_wrapper_tpu.engine.search import Constraint
+    from hlsjs_p2p_wrapper_tpu.testing.twin import (TwinScenario,
+                                                    run_real_plane)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(repo, "TWIN_r10.json"),
+              encoding="utf-8") as fh:
+        bands_doc = json.load(fh)
+    spec = TwinScenario(
+        seed=0, n_peers=8, wave_peers=4,
+        uplink_bps=900_000.0, cdn_bps=1_200_000.0,
+        fault_specs="loss@40-120", fault_kwargs={"loss_rate": 0.4})
+    config = ControlConfig(
+        spec=spec,
+        knob_grid={"p2p_budget_cap_ms": [500.0, 6000.0],
+                   "p2p_budget_fraction": [0.5, 0.9]},
+        initial_knobs={"p2p_budget_cap_ms": 6000.0,
+                       "p2p_budget_fraction": 0.9},
+        constraint=Constraint.parse("rebuffer<=0.05"),
+        bands=bands_doc["scenarios"]["chaos"]["bands"],
+        band_set="chaos")
+
+    with tempfile.TemporaryDirectory() as root:
+        trace_dir = os.path.join(root, "trace")
+        observed = run_real_plane(spec, trace_dir=trace_dir,
+                                  extract_events=False)
+        cache = os.path.join(root, "cache")
+
+        def run_pass(tag):
+            warm = WarmStart(cache_dir=cache)
+            loop = ControlLoop(
+                config, observed.shard_path,
+                LogActuator(os.path.join(root, f"{tag}.jsonl")),
+                warm_start=warm, registry=warm.registry)
+            start = time.perf_counter()
+            with CompileCounter() as probe:
+                loop.run_available()
+            return loop, probe, time.perf_counter() - start
+
+        cold_loop, cold_probe, cold_wall = run_pass("cold")
+        warm_loop, warm_probe, warm_wall = run_pass("warm")
+
+    assert warm_probe.compiles == 0, \
+        "warm control tick compiled XLA programs — layer-1 reuse " \
+        "broken"
+    warm_fresh = sum(
+        v for labels, v in
+        warm_loop.registry.series("control.forecast_rows")
+        if labels.get("source") == "dispatch")
+    assert warm_fresh == 0, \
+        "warm control tick dispatched fresh forecast rows — " \
+        "layer-2 reuse broken"
+    assert [d["action"] for d in warm_loop.decisions] \
+        == [d["action"] for d in cold_loop.decisions], \
+        "cold and warm replays derived different decisions"
+
+    def phase_medians(loop):
+        ticks = [t for t in loop.tick_stats
+                 if t["tick"] >= config.warmup_windows]
+        return {phase: round(statistics.median(
+            t[phase] for t in ticks), 5) for phase in TICK_PHASES}
+
+    def rows_by_source(loop):
+        out = {"cache": 0, "dispatch": 0}
+        for labels, v in loop.registry.series(
+                "control.forecast_rows"):
+            out[labels.get("source", "?")] = \
+                out.get(labels.get("source", "?"), 0) + v
+        return out
+
+    chaos_cdn_atol = \
+        bands_doc["scenarios"]["chaos"]["bands"]["cdn_rate_bps"]["atol"]
+    return {
+        "what": "offline ControlLoop replay of the control-gate "
+                "scenario's observation shard: per-phase tick walls "
+                "(medians over post-warmup ticks), cold row cache "
+                "vs warm (same cache, fresh loop; 0 XLA compiles + "
+                "0 fresh dispatches asserted)",
+        "peers": spec.total_peers,
+        "ticks": len(cold_loop.decisions),
+        "lattice_points": len(config.lattice()),
+        "cold_wall_s": round(cold_wall, 3),
+        "warm_wall_s": round(warm_wall, 3),
+        "cold_xla_compiles": cold_probe.compiles,
+        "warm_xla_compiles": warm_probe.compiles,
+        "cold_phase_median_s": phase_medians(cold_loop),
+        "warm_phase_median_s": phase_medians(warm_loop),
+        "cold_forecast_rows": rows_by_source(cold_loop),
+        "warm_forecast_rows": rows_by_source(warm_loop),
+        "twin_band_narrowing": {
+            "what": "round-13 CDN-pacing parity fix (progressive "
+                    "CDN byte accrual in the kernel to match the "
+                    "real plane's per-progress-chunk accounting, + "
+                    "latency/chunk-quantized effective_cdn_bps in "
+                    "the parity mapping); TWIN_r10.json "
+                    "recalibrated via --write-bands",
+            "band": "chaos.cdn_rate_bps",
+            "atol_before": 5625000.0,
+            "atol_after": chaos_cdn_atol,
+        },
+    }
+
+
 def fabric_benchmark():
     """``detail.sweep_grid.fabric``: the 48-point VOD grid through
     the multi-host work ledger (tools/sweep.py ``--fabric``,
@@ -1494,6 +1622,12 @@ def main():
     # bench leave the heap fragmented
     policy_opt = policy_opt_benchmark()
 
+    # the control-tick rider rides the same warm-start engine tier
+    # (small forecast programs against a throwaway cache), so it
+    # runs with the grid/search measurements, before the 1M-peer
+    # benchmarks fragment the heap
+    control_tick = control_tick_benchmark()
+
     P, S, T, repeats = scenario_sizes()
     # circulant ring topology → the roll/stencil fast path (the
     # flagship formulation; see ops/swarm_sim.py neighbor_offsets)
@@ -1543,6 +1677,7 @@ def main():
         detail["hbm_util"] = round(achieved_hbm / peak_hbm, 4)
     detail["sweep_grid"] = sweep_grid
     detail["policy_opt"] = policy_opt
+    detail["control_tick"] = control_tick
     # hoist the flight-recorder rider to the top level: it is its
     # own acceptance bar (< 3% warm-wall overhead, bit-identical
     # rows), not a property of the grid comparison it rode along

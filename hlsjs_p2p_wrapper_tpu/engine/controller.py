@@ -1,0 +1,643 @@
+"""Live control plane: the forecast-driven controller that closes the
+observe → predict → actuate loop.
+
+The reference wrapper steers a P2P swarm's delivery policy one
+browser tab at a time (PAPER.md §0); everything this repo built since
+exists to do it GLOBALLY — the flight-recorder event stream to
+observe with (round 7), the sharded tracker to push knobs through
+(round 9), the self-healing wire to survive on (round 10), the
+warm-started dispatch engine to forecast with (rounds 4/11), and the
+calibrated twin (round 12) that gives every forecast a MEASURED error
+bar.  This module is the loop itself.  Each control tick:
+
+1. **observe** — :class:`ObservationIngest` tail-follows the live
+   flight-recorder shard (torn-tail tolerant, the journal reader's
+   discipline) and reduces the ``twin.*`` provenance + membership
+   events through :class:`~.twinframe.EventFrameFeeder` — EXACTLY
+   :func:`~.twinframe.frames_from_events`' window partitioning,
+   incrementally: one closed observation window is one control tick.
+2. **predict** — observed membership becomes a forecast scenario
+   (``testing/twin.scenario_from_observation``: observed joins AND
+   departures on the calibrated parity mapping's lanes, absent lanes
+   parked past the horizon so the compiled program shape never
+   changes), and the
+   candidate-knob lattice around the current config becomes ONE
+   ``stream_groups_chunked`` dispatch of the row-cache misses — a
+   warm tick whose membership stopped changing dispatches nothing.
+3. **decide** — :func:`decide_tick`, a pure function: candidates are
+   ranked under the explicit :class:`~.search.Constraint` (round
+   11's grammar), and the DO-NO-HARM rule holds the current config
+   unless the forecast improvement clears the committed twin band
+   (``TWIN_r10.json``): the deciding metric's delta must exceed
+   ``atol + rtol·max(|a|, |b|)`` — the twin's own divergence
+   tolerance, so the controller never acts on a difference the twin
+   cannot measure.  Every decision NAMES the band it cleared (or
+   held inside); hysteresis additionally vetoes actuations closer
+   than ``hysteresis_ticks`` to the previous one.
+4. **actuate** — the knob update rides the tracker's Announce/Peers
+   channel as a ``SET_KNOBS`` publish (engine/protocol.py): epochs
+   are strictly monotone, the tracker piggybacks the current epoch
+   on every answered announce, clients apply idempotently by epoch,
+   and the reconnect listener's immediate re-announce converges
+   healed links automatically (round 10).
+
+Every tick bumps the ``control.*`` registry families, emits a flushed
+``control_tick`` flight-recorder mark, and checkpoints the controller
+state atomically (digest-checked, the search-checkpoint discipline) —
+a SIGKILL'd controller resumes by replaying the shard through the
+same reducers, re-derives the identical decision sequence, and never
+re-actuates a stale epoch (the checkpoint's epoch floor, the
+actuator's idempotency, and the tracker's monotonicity each
+independently refuse it).  ``tools/control.py`` is the service CLI;
+``tools/control_gate.py`` / ``make control-gate`` is the proof.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .artifact_cache import _digest, atomic_write_json
+from .protocol import KnobUpdate, SetKnobs, decode, encode
+from .search import Constraint, rank_key
+from .telemetry import MetricsRegistry
+from .twinframe import FRAME_COLUMNS, EventFrameFeeder
+
+#: the tick phases whose walls the loop records (bench.py
+#: ``detail.control_tick`` reads them): observe → predict → decide →
+#: actuate, plus the checkpoint write
+TICK_PHASES = ("ingest", "reconstruct", "forecast", "decide",
+               "actuate", "checkpoint")
+
+
+class ShardFollower:
+    """Tolerant tail-follow of one flight-recorder shard: each
+    :meth:`poll` yields the records that became COMPLETE since the
+    last poll — only whole lines are consumed (a torn tail stays
+    buffered in the file until its newline lands), and a line that
+    fails to parse is skipped, the ``read_jsonl_tolerant``
+    discipline applied to a growing file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+
+    def poll(self) -> List[dict]:
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(self._offset)
+                data = fh.read()
+        except OSError:
+            return []
+        end = data.rfind(b"\n")
+        if end < 0:
+            return []
+        chunk = data[:end + 1]
+        self._offset += len(chunk)
+        records = []
+        for line in chunk.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # torn/corrupt line: skip, never raise
+        return records
+
+
+class ObservationIngest:
+    """The observe leg: shard tail-follow + the incremental frame
+    reducer.  ``poll()`` returns the frame rows whose windows closed
+    since the last poll (``twin_window`` marks partition the stream
+    exactly where the live sampler stood), and :meth:`membership`
+    exposes the observed join/leave clocks the forecast scenario is
+    reconstructed from."""
+
+    def __init__(self, shard_path: str, source: str = "real"):
+        self.follower = ShardFollower(shard_path)
+        self.feeder = EventFrameFeeder(source)
+        self.rows: List[Tuple[float, ...]] = []
+        #: per-window ``(join_ms, leave_ms)`` snapshots, captured the
+        #: moment each window's mark was fed — NOT the live builder
+        #: state, so a batch replay of a finished shard reconstructs
+        #: the same per-tick view an incremental tail-follow saw (the
+        #: resume-determinism contract)
+        self.memberships: List[Tuple[Dict[str, float],
+                                     Dict[str, float]]] = []
+
+    def poll(self) -> List[Tuple[float, ...]]:
+        new = []
+        for event in self.follower.poll():
+            row = self.feeder.feed(event)
+            if row is not None:
+                new.append(row)
+                self.memberships.append(
+                    self.feeder.builder.membership())
+        self.rows.extend(new)
+        return new
+
+    def membership_at(self, window: int) \
+            -> Tuple[Dict[str, float], Dict[str, float]]:
+        return self.memberships[window]
+
+
+@dataclass
+class ControlConfig:
+    """Everything one controller identity is: the world model the
+    forecasts run on (a ``testing/twin.TwinScenario``), the
+    candidate-knob lattice, the constraint, and the committed twin
+    bands the do-no-harm rule inherits.  JSON round-trippable — the
+    CLI ships it as a spec file, and the checkpoint digest covers it
+    so a resumed controller can never replay a different
+    controller's decisions."""
+
+    spec: object                      # testing/twin.TwinScenario
+    knob_grid: Dict[str, List[float]]
+    initial_knobs: Dict[str, float]
+    constraint: Constraint
+    bands: Dict[str, dict]            # metric -> {rtol, atol, ...}
+    band_set: str = "clean"           # which TWIN_r10 scenario's bands
+    swarm_id: str = ""
+    warmup_windows: int = 2
+    hysteresis_ticks: int = 2
+    forecast_chunk: int = 8
+
+    def lattice(self) -> List[Dict[str, float]]:
+        """The candidate-knob lattice: the cartesian product of the
+        grid axes, in deterministic axis-sorted order.  Fixed across
+        ticks, so revisited candidates are layer-2 row-cache hits."""
+        names = sorted(self.knob_grid)
+        points = []
+        for values in itertools.product(
+                *(self.knob_grid[n] for n in names)):
+            points.append({n: float(v)
+                           for n, v in zip(names, values)})
+        return points
+
+    def identity(self) -> dict:
+        """The digest material (what changes a decision)."""
+        spec = self.spec
+        spec_dict = {f: getattr(spec, f)
+                     for f in ("seed", "n_peers", "wave_peers",
+                               "frag_count", "seg_duration_s",
+                               "cdn_bps", "uplink_bps", "watch_s",
+                               "window_s", "cdn_latency_ms")}
+        spec_dict["level_bitrates"] = list(spec.level_bitrates)
+        return {
+            "kind": "control-loop", "spec": spec_dict,
+            "knob_grid": {k: list(v)
+                          for k, v in sorted(self.knob_grid.items())},
+            "initial_knobs": dict(sorted(self.initial_knobs.items())),
+            "constraint": [self.constraint.metric,
+                           self.constraint.bound,
+                           self.constraint.objective],
+            "bands": self.bands, "band_set": self.band_set,
+            "swarm_id": self.swarm_id,
+            "warmup_windows": self.warmup_windows,
+            "hysteresis_ticks": self.hysteresis_ticks,
+        }
+
+
+def band_halfwidth(bands: Dict[str, dict], metric: str,
+                   a: float, b: float) -> float:
+    """The twin's own divergence tolerance between two values of one
+    metric (``detect_band_divergence``'s formula): the smallest
+    difference the calibrated twin can distinguish from sim/real
+    disagreement.  A forecast improvement below this is noise by the
+    twin's OWN measurement, and the do-no-harm rule refuses it."""
+    band = bands.get(metric, {})
+    return (float(band.get("atol", 0.0))
+            + float(band.get("rtol", 0.0)) * max(abs(a), abs(b)))
+
+
+def decide_tick(trials: List[dict], current_knobs: Dict[str, float],
+                constraint: Constraint, bands: Dict[str, dict],
+                band_set: str) -> dict:
+    """The pure decision function: one tick's forecast trials →
+    ``{action, knobs, band, ...}``.  ``trials`` carry ``knobs`` +
+    the metric fields (the Evaluator contract); exactly one trial's
+    knobs must equal ``current_knobs`` (the lattice always contains
+    the current config).
+
+    The do-no-harm rule: the best-ranked candidate is actuated ONLY
+    when its improvement over the current config — on the deciding
+    metric the constraint grammar implies — clears the committed
+    twin band (:func:`band_halfwidth`).  A candidate that would
+    trade the current config's feasibility away is refused outright.
+    The returned decision always names the band it cleared or held
+    inside."""
+    current = next(t for t in trials
+                   if t["knobs"] == current_knobs)
+    if current.get("failed"):
+        # the current config's OWN forecast failed: there is no
+        # baseline to measure a banded improvement against, and
+        # violation() on its None metrics would be infinite — an
+        # unconditional actuation.  Do-no-harm degrades to a hold.
+        return {
+            "action": "hold", "reason": "current_forecast_failed",
+            "knobs": dict(current_knobs),
+            "band": {"set": band_set, "metric": None,
+                     "halfwidth": None, "delta": None},
+            "headroom": None,
+        }
+    ranked = sorted(
+        (t for t in trials if not t.get("failed")),
+        key=lambda t: rank_key(t, constraint))
+    best = ranked[0] if ranked else current
+    cur_feas = constraint.feasible(current)
+    best_feas = constraint.feasible(best)
+    infeasible_best = False
+    if best_feas and cur_feas:
+        metric = constraint.objective
+        delta = (best.get(metric) or 0.0) - (current.get(metric)
+                                             or 0.0)
+    elif best_feas or not cur_feas:
+        # feasibility gained, or both infeasible: the constrained
+        # metric decides (violation must measurably shrink)
+        metric = constraint.metric
+        delta = constraint.violation(current) \
+            - constraint.violation(best)
+    else:
+        # best is infeasible while current is feasible: never trade
+        # feasibility away, whatever the objective promises
+        metric = constraint.metric
+        delta = 0.0
+        infeasible_best = True
+    halfwidth = band_halfwidth(bands, metric,
+                               best.get(metric) or 0.0,
+                               current.get(metric) or 0.0)
+    cleared = delta > halfwidth and best["knobs"] != current_knobs
+    headroom = constraint.bound - ((best if cleared else current)
+                                   .get(constraint.metric) or 0.0)
+    return {
+        "action": "actuate" if cleared else "hold",
+        "reason": None if cleared else (
+            "best_is_current" if best["knobs"] == current_knobs
+            else ("infeasible_best" if infeasible_best else "band")),
+        "knobs": dict(best["knobs"]) if cleared
+        else dict(current_knobs),
+        "band": {"set": band_set, "metric": metric,
+                 "rtol": float(bands.get(metric, {}).get("rtol", 0.0)),
+                 "atol": float(bands.get(metric, {}).get("atol", 0.0)),
+                 "halfwidth": round(halfwidth, 6),
+                 "delta": round(delta, 6)},
+        "forecast": {
+            "best": {"knobs": dict(best["knobs"]),
+                     "offload": best.get("offload"),
+                     "rebuffer": best.get("rebuffer")},
+            "current": {"offload": current.get("offload"),
+                        "rebuffer": current.get("rebuffer")},
+        },
+        "headroom": round(headroom, 6),
+    }
+
+
+class TransportActuator:
+    """Actuation over the live tracker channel: SET_KNOBS frames from
+    the controller's own transport endpoint, acked by KNOB_UPDATE.
+    Idempotent by construction — the tracker refuses stale epochs —
+    and non-blocking: :meth:`actuate`'s True means the frame was
+    handed to the transport, NOT that the tracker accepted it.  The
+    loop's convergence republish closes that gap for lost frames; a
+    tracker REFUSAL is visible too — the ack then carries an epoch
+    below the one we published (stale publish, or the knob-swarm cap)
+    and is counted ``control.publish_refusals`` with
+    :attr:`refused_epoch` recording the publish it rejected."""
+
+    def __init__(self, endpoint, swarm_id: str,
+                 tracker_peer_id: str = "tracker",
+                 registry: Optional[MetricsRegistry] = None):
+        self.endpoint = endpoint
+        self.swarm_id = swarm_id
+        self.tracker_peer_id = tracker_peer_id
+        self.registry = registry
+        self.acked_epoch = 0
+        self.acked_knobs: tuple = ()
+        self.published_epoch = 0
+        self.refused_epoch = 0
+        endpoint.on_receive = self._on_frame
+
+    def _on_frame(self, src_id: str, frame: bytes) -> None:
+        if src_id != self.tracker_peer_id:
+            return
+        try:
+            msg = decode(frame)
+        except Exception:  # fault-ok: a malformed ack is ignorable
+            return
+        if not isinstance(msg, KnobUpdate) \
+                or msg.swarm_id != self.swarm_id:
+            return
+        if msg.epoch >= self.acked_epoch:
+            # a stale ack (reordered across a heal/republish window)
+            # must not pair an old knob tuple with a newer epoch
+            self.acked_epoch = msg.epoch
+            self.acked_knobs = msg.knobs
+        if msg.epoch < self.published_epoch \
+                and self.refused_epoch < self.published_epoch:
+            # the tracker answered a publish with an OLDER epoch:
+            # that publish was refused (stale or cap), counted once
+            self.refused_epoch = self.published_epoch
+            if self.registry is not None:
+                self.registry.counter(
+                    "control.publish_refusals").inc()
+
+    def actuate(self, epoch: int, knobs: Dict[str, float]) -> bool:
+        wire = tuple(sorted((name, float(value))
+                            for name, value in knobs.items()))
+        self.published_epoch = max(self.published_epoch, epoch)
+        return bool(self.endpoint.send(
+            self.tracker_peer_id,
+            encode(SetKnobs(self.swarm_id, epoch, wire))))
+
+
+class LogActuator:
+    """Actuation into an append-only fsync'd JSONL log — the replay
+    mode's externally visible effect (and the gate's duplicate
+    detector).  Idempotent by epoch: an epoch already in the log is
+    NOT re-appended, which is exactly the guard that makes a
+    SIGKILL between actuation and checkpoint safe to resume
+    through."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._seen = set()
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        self._seen.add(int(
+                            json.loads(line)["epoch"]))
+                    except (ValueError, KeyError):
+                        continue
+
+    def actuate(self, epoch: int, knobs: Dict[str, float]) -> bool:
+        if epoch in self._seen:
+            return True  # already durably actuated: idempotent
+        with open(self.path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps({"epoch": epoch,
+                                 "knobs": dict(sorted(knobs.items()))})
+                     + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        self._seen.add(epoch)
+        return True
+
+    def epochs(self) -> List[int]:
+        with open(self.path, encoding="utf-8") as fh:
+            return [int(json.loads(line)["epoch"])
+                    for line in fh if line.strip()]
+
+
+def control_checkpoint_path(cache_dir: str,
+                            config: "ControlConfig") -> str:
+    """Checkpoint location for one controller identity: co-located
+    with the search checkpoints under the warm-start root,
+    content-addressed by the controller identity — two different
+    controllers can never clobber each other's state."""
+    digest = _digest(config.identity())
+    return os.path.join(cache_dir, "controllers", digest + ".json")
+
+
+class ControlLoop:
+    """The service (module docstring).  Drive it with
+    :meth:`run_available` after advancing the world (the gate's
+    window-locked loop), or let the CLI poll it.  ``warm_start`` is
+    the two-layer cache the forecast dispatches run against;
+    ``recorder`` arms the flight-recorder marks; ``wall`` is the
+    injectable phase-timing clock (tools/lint.py discipline)."""
+
+    def __init__(self, config: ControlConfig, shard_path: str,
+                 actuator, *, warm_start=None,
+                 registry: Optional[MetricsRegistry] = None,
+                 recorder=None, checkpoint_path: Optional[str] = None,
+                 wall: Callable[[], float] = time.perf_counter):
+        self.config = config
+        self.ingest = ObservationIngest(shard_path)
+        self.actuator = actuator
+        self.warm_start = warm_start
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.recorder = recorder
+        self.checkpoint_path = checkpoint_path
+        self.digest = _digest(config.identity())
+        self._wall = wall
+        self.current_knobs = dict(config.initial_knobs)
+        self.epoch = 0
+        self.decisions: List[dict] = []
+        self.last_actuation_tick = -10**9
+        self.tick_stats: List[dict] = []
+        self._lattice = config.lattice()
+        if not any(p == config.initial_knobs for p in self._lattice):
+            raise ValueError("initial_knobs must be a lattice point "
+                             "(the controller only ever actuates "
+                             "lattice points)")
+        self._m_ticks = self.registry.counter("control.ticks")
+        self._m_windows = self.registry.counter("control.windows")
+        self._m_actuations = self.registry.counter(
+            "control.actuations")
+        self._g_epoch = self.registry.gauge("control.knob_epoch")
+        self._g_headroom = self.registry.gauge("control.headroom")
+
+    # -- persistence ----------------------------------------------------
+
+    def checkpoint(self) -> None:
+        if self.checkpoint_path is None:
+            return
+        atomic_write_json(self.checkpoint_path, {
+            "digest": self.digest,
+            "tick": len(self.decisions),
+            "epoch": self.epoch,
+            "current_knobs": self.current_knobs,
+            "last_actuation_tick": self.last_actuation_tick,
+            "decisions": self.decisions,
+        })
+
+    def resume(self) -> bool:
+        """Restore from the checkpoint (digest-checked: a checkpoint
+        written by a different controller configuration is refused,
+        the search-resume contract).  The observation reducers are
+        NOT checkpointed — the shard is replayed through them from
+        the start, so the restored decision prefix is re-derived
+        state, not trusted state."""
+        if (self.checkpoint_path is None
+                or not os.path.exists(self.checkpoint_path)):
+            return False
+        with open(self.checkpoint_path, encoding="utf-8") as fh:
+            state = json.load(fh)
+        if state.get("digest") != self.digest:
+            raise ValueError(
+                f"controller checkpoint {self.checkpoint_path} was "
+                f"written by a different controller configuration — "
+                f"not resuming against it")
+        self.epoch = int(state["epoch"])
+        self.current_knobs = dict(state["current_knobs"])
+        self.decisions = [dict(d) for d in state["decisions"]]
+        self.last_actuation_tick = int(state["last_actuation_tick"])
+        self._g_epoch.set(self.epoch)
+        return True
+
+    # -- the loop -------------------------------------------------------
+
+    def run_available(self) -> List[dict]:
+        """Ingest everything new and tick once per closed window;
+        returns the decisions made (resumed-prefix windows replay
+        the recorded decision without re-forecasting — their
+        decisions are already derived state, and their epochs are
+        already actuated)."""
+        t0 = self._wall()
+        new_rows = self.ingest.poll()
+        ingest_s = self._wall() - t0
+        made = []
+        base = len(self.ingest.rows) - len(new_rows)
+        for i, row in enumerate(new_rows):
+            window = base + i
+            if window < len(self.decisions):
+                continue  # resumed prefix: decision already derived
+            made.append(self._tick(window, row, ingest_s))
+            ingest_s = 0.0  # charged to the first tick of the batch
+        return made
+
+    def _tick(self, window: int, row: Tuple[float, ...],
+              ingest_s: float) -> dict:
+        phases = {"ingest": ingest_s}
+        self._m_ticks.inc()
+        self._m_windows.inc()
+        t_s = row[FRAME_COLUMNS.index("t_s")]
+
+        if window < self.config.warmup_windows:
+            phases.update(reconstruct=0.0, forecast=0.0, decide=0.0)
+            decision = {
+                "action": "hold", "reason": "warmup",
+                "knobs": dict(self.current_knobs),
+                "band": {"set": self.config.band_set, "metric": None,
+                         "halfwidth": None, "delta": None},
+                "headroom": None,
+            }
+        else:
+            t0 = self._wall()
+            from ..testing.twin import (forecast_group,
+                                        scenario_from_observation)
+            join_ms, leave_ms = self.ingest.membership_at(window)
+            join_s, leave_s = scenario_from_observation(
+                self.config.spec, join_ms, leave_ms)
+            group = forecast_group(self.config.spec, join_s,
+                                   self._lattice, leave_s=leave_s)
+            phases["reconstruct"] = self._wall() - t0
+
+            t0 = self._wall()
+            trials = self._forecast(group)
+            phases["forecast"] = self._wall() - t0
+
+            t0 = self._wall()
+            decision = decide_tick(trials, self.current_knobs,
+                                   self.config.constraint,
+                                   self.config.bands,
+                                   self.config.band_set)
+            if decision["action"] == "actuate" and \
+                    window - self.last_actuation_tick \
+                    < self.config.hysteresis_ticks:
+                # hysteresis veto: the forecast cleared the band but
+                # the previous actuation is too recent — the swarm
+                # has not converged enough to observe its effect
+                decision["action"] = "veto"
+                decision["reason"] = "hysteresis"
+                decision["knobs"] = dict(self.current_knobs)
+            phases["decide"] = self._wall() - t0
+
+        decision["tick"] = window
+        decision["t_s"] = round(t_s, 3)
+
+        t0 = self._wall()
+        if decision["action"] == "actuate":
+            epoch = self.epoch + 1
+            if self.actuator.actuate(epoch, decision["knobs"]):
+                self.epoch = epoch
+                self.current_knobs = dict(decision["knobs"])
+                self.last_actuation_tick = window
+                self._m_actuations.inc()
+            else:
+                decision["action"] = "veto"
+                decision["reason"] = "actuator_refused"
+                self.registry.counter("control.vetoes",
+                                      reason="actuator_refused").inc()
+        elif self.epoch > 0 and getattr(self.actuator, "acked_epoch",
+                                        self.epoch) < self.epoch:
+            # convergence republish: the last publish has no tracker
+            # ack yet (a chaos window may have eaten the SET_KNOBS
+            # frame).  Re-sending the SAME epoch is idempotent end to
+            # end — the tracker refuses it if the original landed,
+            # clients gate on epoch — so this is pure repair, never a
+            # new decision.
+            self.actuator.actuate(self.epoch, self.current_knobs)
+            self.registry.counter("control.republishes").inc()
+        if decision["action"] == "hold":
+            self.registry.counter("control.holds",
+                                  reason=decision["reason"]).inc()
+        elif decision["action"] == "veto" \
+                and decision["reason"] == "hysteresis":
+            self.registry.counter("control.vetoes",
+                                  reason="hysteresis").inc()
+        decision["epoch"] = self.epoch
+        phases["actuate"] = self._wall() - t0
+
+        self._g_epoch.set(self.epoch)
+        if decision.get("headroom") is not None:
+            self._g_headroom.set(decision["headroom"])
+        self.decisions.append(decision)
+
+        t0 = self._wall()
+        self.checkpoint()
+        phases["checkpoint"] = self._wall() - t0
+
+        if self.recorder is not None:
+            self.recorder.mark(
+                "control_tick", tick=window,
+                action=decision["action"], epoch=self.epoch,
+                headroom=decision.get("headroom"),
+                t_s=decision["t_s"])
+            self.recorder.flush(fsync=False)
+        self.tick_stats.append({"tick": window,
+                                "action": decision["action"],
+                                **{k: round(v, 6)
+                                   for k, v in phases.items()}})
+        return decision
+
+    def _forecast(self, group) -> List[dict]:
+        """One candidate-lattice forecast sweep: one
+        ``stream_groups_chunked`` dispatch of the row-cache misses
+        (the Evaluator contract from tools/optimize.py, inlined for
+        the one-fidelity case)."""
+        from ..ops.swarm_sim import stream_groups_chunked
+
+        config, items, build = group
+        spec = self.config.spec
+        n_steps = int(round(spec.watch_s * 1000.0 / config.dt_ms))
+        results: List[Optional[dict]] = [None] * len(items)
+        stream = stream_groups_chunked(
+            [group], n_steps, watch_s=spec.watch_s,
+            chunk=min(self.config.forecast_chunk, len(items)),
+            exact_chunk=True, warm_start=self.warm_start,
+            trace=self.recorder)
+        for event in stream:
+            if event.metric is None:
+                results[event.index] = {
+                    "knobs": items[event.index], "offload": None,
+                    "rebuffer": None, "failed": True,
+                    "cached": False}
+            else:
+                results[event.index] = {
+                    "knobs": items[event.index],
+                    "offload": float(event.metric[0]),
+                    "rebuffer": float(event.metric[1]),
+                    "failed": False, "cached": bool(event.cached)}
+            self.registry.counter(
+                "control.forecast_rows",
+                source="cache" if event.cached else "dispatch").inc()
+        return [r for r in results if r is not None]

@@ -179,6 +179,17 @@ class NetFaultPlan:
             if self._epoch_ms is None:
                 self._epoch_ms = self._now_ms()
 
+    def rearm(self) -> None:
+        """FORCE a fresh window epoch, even if a query already
+        auto-armed the plan.  For drivers whose setup traffic runs on
+        the faulted fabric (every socket op queries the windows, so
+        the first handshake arms the plan): call this when setup is
+        done and the chaos windows should actually begin.  Windows
+        already fired keep their counted record; their ``t0``/``t1``
+        now measure from here."""
+        with self._lock:
+            self._epoch_ms = self._now_ms()
+
     def _elapsed_s(self) -> float:
         with self._lock:
             if self._epoch_ms is None:

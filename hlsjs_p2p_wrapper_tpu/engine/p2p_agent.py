@@ -40,8 +40,10 @@ public stats ``{cdn, p2p, upload, peers}`` and the
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import logging
+import math
 import uuid
 from typing import Callable, Dict, Optional
 
@@ -62,6 +64,19 @@ log = logging.getLogger(__name__)
 
 DEFAULT_MAX_CONCURRENT_PREFETCH = 2
 DEFAULT_PREFETCH_INTERVAL_MS = 1_000.0
+
+#: scheduling-policy fields a live KNOB_UPDATE may retune, with the
+#: coercion each applies (the wire carries every value as f64).  The
+#: allowlist is the actuation trust boundary: a controller can move
+#: the scheduler's published tunables and NOTHING else — no epoch can
+#: rewire transports, identities, or cache budgets.
+LIVE_KNOB_FIELDS = {
+    "urgent_margin_s": float,
+    "p2p_budget_fraction": float,
+    "p2p_budget_cap_ms": float,
+    "p2p_budget_floor_ms": float,
+    "max_p2p_attempts": int,
+}
 
 
 class _GetSegmentRequest:
@@ -205,7 +220,8 @@ class P2PAgent:
                 tracker_peer_id=cfg.get("tracker_peer_id", TRACKER_PEER_ID),
                 announce_interval_ms=cfg.get("announce_interval_ms",
                                              DEFAULT_ANNOUNCE_INTERVAL_MS),
-                on_peers=lambda peers: self.mesh.on_tracker_peers(peers))
+                on_peers=lambda peers: self.mesh.on_tracker_peers(peers),
+                on_knobs=self._apply_knobs)
             # frames claiming to be FROM the tracker are trusted
             # (TrackerClient matches on src id); on a fabric where
             # inbound identity is self-declared, forbid peers from
@@ -246,6 +262,37 @@ class P2PAgent:
         if self.tracker_client.handle_frame(src_id, msg):
             return
         self.mesh.handle_frame(src_id, msg)
+
+    # -- live knob actuation (control plane) ---------------------------
+    def _apply_knobs(self, epoch: int, knobs: Dict[str, float]) -> None:
+        """One KNOB_UPDATE epoch, applied to the scheduling policy.
+        The TrackerClient already gated on epoch monotonicity, so
+        this runs EXACTLY once per epoch regardless of how many
+        announces piggybacked it.  Unknown names are skipped (a newer
+        controller may publish knobs this build does not have) and
+        non-finite values are refused — a hostile or buggy SET_KNOBS
+        must not poison the scheduler's arithmetic."""
+        updates = {}
+        skipped = 0
+        for name, value in knobs.items():
+            if name not in LIVE_KNOB_FIELDS \
+                    or not math.isfinite(value):
+                skipped += 1
+                continue
+            updates[name] = LIVE_KNOB_FIELDS[name](value)
+        if updates:
+            self.policy = dataclasses.replace(self.policy, **updates)
+        log.debug("peer %s applied knob epoch %d: %s (%d skipped)",
+                  self.peer_id, epoch, updates, skipped)
+        if self.metrics_registry is not None:
+            if updates:
+                self.metrics_registry.counter(
+                    "control.knob_applies", peer=self.peer_id,
+                    result="applied").inc()
+            if skipped:
+                self.metrics_registry.counter(
+                    "control.knob_applies", peer=self.peer_id,
+                    result="skipped").inc(skipped)
 
     # -- §2.10 data plane ----------------------------------------------
     def get_segment(self, req_info: Dict, callbacks: Dict[str, Callable],

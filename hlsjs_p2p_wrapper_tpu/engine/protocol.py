@@ -50,6 +50,8 @@ class MsgType:
     ANNOUNCE = 0x10   # tracker: join/refresh swarm membership
     PEERS = 0x11      # tracker: current member list
     LEAVE = 0x12      # tracker: orderly departure
+    SET_KNOBS = 0x13  # controller → tracker: publish a knob epoch
+    KNOB_UPDATE = 0x14  # tracker → peer: current knob epoch
 
 
 class DenyReason:
@@ -136,6 +138,36 @@ class Peers:
 class Leave:
     swarm_id: str
     peer_id: str
+
+
+@dataclass(frozen=True)
+class SetKnobs:
+    """Controller → tracker: publish a new policy-knob epoch for one
+    swarm.  ``knobs`` is a tuple of ``(name, value)`` pairs — value
+    is an f64 so any scheduler scalar travels; names the receiving
+    agent does not recognize are skipped there (forward compat).
+    Epochs are STRICTLY monotone per swarm: the tracker refuses
+    ``epoch <= current`` (a resumed controller can never re-actuate
+    a stale decision) and clients apply idempotently by epoch."""
+
+    swarm_id: str
+    epoch: int
+    knobs: Tuple[Tuple[str, float], ...]
+
+
+@dataclass(frozen=True)
+class KnobUpdate:
+    """Tracker → peer (and tracker → controller, as the SET_KNOBS
+    ack): the swarm's CURRENT knob epoch.  Piggybacked on the
+    Announce/Peers channel — every answered announce of a swarm with
+    published knobs is followed by one of these, so periodic
+    re-announce (and the reconnect-listener's immediate re-announce
+    on a healed link) IS the knob-convergence path; no new timer, no
+    new channel."""
+
+    swarm_id: str
+    epoch: int
+    knobs: Tuple[Tuple[str, float], ...]
 
 
 class ProtocolError(ValueError):
@@ -231,7 +263,41 @@ def encode(msg) -> bytes:
     if t is Leave:
         return _frame(MsgType.LEAVE,
                       _pack_str(msg.swarm_id) + _pack_str(msg.peer_id))
+    if t is SetKnobs:
+        return _frame(MsgType.SET_KNOBS, _pack_knob_body(msg))
+    if t is KnobUpdate:
+        return _frame(MsgType.KNOB_UPDATE, _pack_knob_body(msg))
     raise ProtocolError(f"cannot encode {t.__name__}")
+
+
+def _pack_knob_body(msg) -> bytes:
+    """Shared SET_KNOBS / KNOB_UPDATE body: swarm id, u32 epoch, u16
+    knob count, then ``(name, f64 value)`` pairs."""
+    if not 0 <= msg.epoch <= 0xFFFFFFFF:
+        raise ProtocolError(f"knob epoch {msg.epoch} outside u32")
+    if len(msg.knobs) > 0xFFFF:
+        raise ProtocolError("too many knobs for wire format")
+    body = _pack_str(msg.swarm_id)
+    body += struct.pack("<IH", msg.epoch, len(msg.knobs))
+    for name, value in msg.knobs:
+        body += _pack_str(name) + struct.pack("<d", float(value))
+    return body
+
+
+def _unpack_knob_body(body: memoryview) -> Tuple[str, int, tuple]:
+    swarm_id, off = _unpack_str(body, 0)
+    epoch, count = struct.unpack_from("<IH", body, off)
+    off += 6
+    knobs = []
+    for _ in range(count):
+        name, off = _unpack_str(body, off)
+        if off + 8 > len(body):
+            raise ProtocolError("truncated knob value")
+        (value,) = struct.unpack_from("<d", body, off)
+        off += 8
+        knobs.append((name, value))
+    _consumed(off, body)
+    return swarm_id, epoch, tuple(knobs)
 
 
 def _frame(msg_type: int, body: bytes) -> bytes:
@@ -324,6 +390,10 @@ def _decode_body(msg_type: int, body: memoryview):
         peer_id, off = _unpack_str(body, off)
         _consumed(off, body)
         return Leave(swarm_id, peer_id)
+    if msg_type == MsgType.SET_KNOBS:
+        return SetKnobs(*_unpack_knob_body(body))
+    if msg_type == MsgType.KNOB_UPDATE:
+        return KnobUpdate(*_unpack_knob_body(body))
     raise ProtocolError(f"unknown message type 0x{msg_type:02x}")
 
 

@@ -70,7 +70,8 @@ from typing import Callable, Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from ..core.clock import Clock
-from .protocol import Announce, Leave, Peers, ProtocolError, decode, encode
+from .protocol import (Announce, KnobUpdate, Leave, Peers, ProtocolError,
+                       SetKnobs, decode, encode)
 from .telemetry import MetricsRegistry
 from .transport import Endpoint
 
@@ -313,6 +314,53 @@ class Tracker:
         # source host -> gid | {gid: None} in least-recently-
         # refreshed order (dict insertion order IS the LRU, seed-like)
         self._buckets: Dict[str, Union[int, Dict[int, None]]] = {}
+        # live control plane (round 13): per-swarm policy-knob state
+        # the controller publishes through SET_KNOBS and the adapter
+        # piggybacks onto every answered announce.  Deliberately NOT
+        # lease-coupled — knobs are operator configuration and must
+        # survive a swarm whose members all churned out — but capped
+        # like every other attacker-mintable table.
+        self._knob_lock = threading.Lock()
+        self._knobs: Dict[str, Tuple[int, tuple]] = {}
+        self._m_knob_sets = {
+            result: self.metrics.counter("tracker.knob_sets",
+                                         result=result)
+            for result in ("accepted", "stale", "cap")}
+
+    # -- policy knobs (live control plane) -----------------------------
+
+    #: ceiling on distinct swarms holding knob state — SET_KNOBS
+    #: bodies are as unauthenticated as ANNOUNCE's, so the table must
+    #: not be mintable without bound
+    MAX_KNOB_SWARMS = 1_024
+
+    def set_knobs(self, swarm_id: str, epoch: int,
+                  knobs: tuple) -> Tuple[bool, int, tuple]:
+        """Publish a knob epoch for one swarm.  Accepted only when
+        ``epoch`` is STRICTLY greater than the current one — the
+        monotonicity that makes controller resume safe (a re-sent
+        stale decision is counted and refused, never re-applied).
+        Returns ``(accepted, current_epoch, current_knobs)`` — the
+        current state either way, which is what the adapter answers
+        as the :class:`~.protocol.KnobUpdate` ack."""
+        with self._knob_lock:
+            current = self._knobs.get(swarm_id)
+            if current is None and \
+                    len(self._knobs) >= self.MAX_KNOB_SWARMS:
+                self._m_knob_sets["cap"].inc()
+                return False, 0, ()
+            if current is not None and epoch <= current[0]:
+                self._m_knob_sets["stale"].inc()
+                return False, current[0], current[1]
+            self._knobs[swarm_id] = (epoch, tuple(knobs))
+            self._m_knob_sets["accepted"].inc()
+            return True, epoch, tuple(knobs)
+
+    def knobs_for(self, swarm_id: str) -> Optional[Tuple[int, tuple]]:
+        """The swarm's current ``(epoch, knobs)``, or None when no
+        controller ever published any."""
+        with self._knob_lock:
+            return self._knobs.get(swarm_id)
 
     # -- sharding ------------------------------------------------------
 
@@ -1041,8 +1089,26 @@ class TrackerEndpoint:
                                           source=src_id)
             self.endpoint.send(src_id,
                                encode(Peers(msg.swarm_id, tuple(peers))))
+            # knob piggyback (live control plane): every answered
+            # announce of a swarm with published knobs is followed by
+            # the current epoch, so re-announce cadence — including
+            # the reconnect listener's immediate re-announce on a
+            # healed link — IS the knob-convergence path.  Idempotent
+            # at the client (applied only when the epoch advances).
+            current = self.tracker.knobs_for(msg.swarm_id)
+            if current is not None:
+                self.endpoint.send(src_id, encode(
+                    KnobUpdate(msg.swarm_id, current[0], current[1])))
         elif isinstance(msg, Leave):
             self.tracker.leave(msg.swarm_id, msg.peer_id, source=src_id)
+        elif isinstance(msg, SetKnobs):
+            _accepted, epoch, knobs = self.tracker.set_knobs(
+                msg.swarm_id, msg.epoch, msg.knobs)
+            # ack with the CURRENT state either way — a refused stale
+            # publish tells the (possibly resumed) controller where
+            # the epoch actually stands
+            self.endpoint.send(src_id, encode(
+                KnobUpdate(msg.swarm_id, epoch, knobs)))
 
 
 class TrackerClient:
@@ -1060,7 +1126,8 @@ class TrackerClient:
                  clock: Clock, *,
                  tracker_peer_id: str = TRACKER_PEER_ID,
                  announce_interval_ms: float = DEFAULT_ANNOUNCE_INTERVAL_MS,
-                 on_peers: Optional[Callable[[Tuple[str, ...]], None]] = None):
+                 on_peers: Optional[Callable[[Tuple[str, ...]], None]] = None,
+                 on_knobs: Optional[Callable[[int, dict], None]] = None):
         self.endpoint = endpoint
         self.swarm_id = swarm_id
         self.peer_id = peer_id
@@ -1068,7 +1135,13 @@ class TrackerClient:
         self.tracker_peer_id = tracker_peer_id
         self.announce_interval_ms = announce_interval_ms
         self.on_peers = on_peers
+        self.on_knobs = on_knobs
         self.known_peers: Tuple[str, ...] = ()
+        #: last APPLIED knob epoch — the idempotency floor: the
+        #: tracker piggybacks the current epoch on every answered
+        #: announce, so the same update arrives many times and must
+        #: apply exactly once
+        self.knob_epoch = 0
         self._timer = None
         self._stopped = False
         hook = getattr(endpoint, "add_reconnect_listener", None)
@@ -1091,13 +1164,23 @@ class TrackerClient:
     def handle_frame(self, src_id: str, frame_msg) -> bool:
         """Feed a decoded message; returns True if it was tracker
         traffic (the agent's dispatch calls this first)."""
-        if src_id != self.tracker_peer_id or not isinstance(frame_msg, Peers):
+        if src_id != self.tracker_peer_id:
             return False
-        if frame_msg.swarm_id == self.swarm_id:
-            self.known_peers = frame_msg.peer_ids
-            if self.on_peers is not None:
-                self.on_peers(frame_msg.peer_ids)
-        return True
+        if isinstance(frame_msg, Peers):
+            if frame_msg.swarm_id == self.swarm_id:
+                self.known_peers = frame_msg.peer_ids
+                if self.on_peers is not None:
+                    self.on_peers(frame_msg.peer_ids)
+            return True
+        if isinstance(frame_msg, KnobUpdate):
+            if frame_msg.swarm_id == self.swarm_id \
+                    and frame_msg.epoch > self.knob_epoch:
+                self.knob_epoch = frame_msg.epoch
+                if self.on_knobs is not None:
+                    self.on_knobs(frame_msg.epoch,
+                                  dict(frame_msg.knobs))
+            return True
+        return False
 
     def _announce(self) -> None:
         if self._stopped:

@@ -167,6 +167,13 @@ class FrameBuilder:
     def set_leave(self, peer: str, t_ms: float) -> None:
         self._leave_ms[peer] = t_ms
 
+    def membership(self) -> Tuple[Dict[str, float], Dict[str, float]]:
+        """Snapshot of the observed join/leave clocks (engine ms) per
+        peer — the control plane reconstructs its forecast scenario
+        from exactly what the reducer has seen, never from a second
+        bookkeeping path that could drift from the frames."""
+        return dict(self._join_ms), dict(self._leave_ms)
+
     # -- reduction ----------------------------------------------------
 
     def close_window(self, t_ms: float) -> Tuple[float, ...]:
@@ -252,6 +259,55 @@ TWIN_EVENT_FAMILIES = ("twin.fetch_bytes", "twin.fetches",
 TWIN_WINDOW_MARK = "twin_window"
 
 
+class EventFrameFeeder:
+    """The event-replay extractor as an INCREMENTAL reducer: feed
+    flight-recorder events one at a time (in SHARD ORDER) and a
+    canonical frame row comes back at every ``twin_window`` mark —
+    exactly :func:`frames_from_events`' window partitioning, exposed
+    so a live consumer (the control plane's tail-follow ingest) can
+    reduce a growing shard without re-reading it.  The batch
+    function below is this class applied to a finished stream, so
+    the two can never drift."""
+
+    def __init__(self, source: str = "real"):
+        # window_s is learned from the first mark (every mark of one
+        # sampler carries the same window_ms)
+        self.builder = FrameBuilder(source, 0.0)
+        self.windows = 0
+
+    def feed(self, event: dict) -> Optional[Tuple[float, ...]]:
+        """One event; returns the closed frame row when ``event`` is
+        a window mark, else None."""
+        kind = event.get("kind")
+        if kind == "mark" and event.get("name") == TWIN_WINDOW_MARK:
+            if self.windows == 0:
+                self.builder.window_s = \
+                    event.get("window_ms", 0.0) / 1000.0
+            self.windows += 1
+            return self.builder.close_window(event.get("t", 0.0))
+        if kind != "counter":
+            return None
+        name = event.get("name", "")
+        if not name.startswith("twin."):
+            return None
+        labels = parse_labels(event.get("labels", ""))
+        peer = labels.get("peer", "")
+        n = event.get("n", 0)
+        if name == "twin.fetch_bytes":
+            self.builder.add_bytes(peer, labels.get("src", ""), n)
+        elif name == "twin.stall_ms":
+            self.builder.add_stall(peer, n)
+        elif name == "twin.peer":
+            if labels.get("event") == "join":
+                self.builder.set_join(peer, event.get("t", 0.0))
+            elif labels.get("event") == "leave":
+                self.builder.set_leave(peer, event.get("t", 0.0))
+        return None
+
+    def frame(self) -> ObservationFrame:
+        return self.builder.frame()
+
+
 def frames_from_events(events: Iterable[dict], *,
                        source: str = "real") -> ObservationFrame:
     """Reconstruct the canonical frame purely from one host's
@@ -264,34 +320,10 @@ def frames_from_events(events: Iterable[dict], *,
     frames bit-for-bit.  A torn tail (SIGKILL'd writer) simply ends
     the stream early: every window whose mark survived reconstructs
     exactly."""
-    events = list(events)
-    window_ms = next((e.get("window_ms", 0.0) for e in events
-                      if e.get("kind") == "mark"
-                      and e.get("name") == TWIN_WINDOW_MARK), 0.0)
-    builder = FrameBuilder(source, window_ms / 1000.0)
+    feeder = EventFrameFeeder(source)
     for event in events:
-        kind = event.get("kind")
-        if kind == "mark" and event.get("name") == TWIN_WINDOW_MARK:
-            builder.close_window(event.get("t", 0.0))
-            continue
-        if kind != "counter":
-            continue
-        name = event.get("name", "")
-        if not name.startswith("twin."):
-            continue
-        labels = parse_labels(event.get("labels", ""))
-        peer = labels.get("peer", "")
-        n = event.get("n", 0)
-        if name == "twin.fetch_bytes":
-            builder.add_bytes(peer, labels.get("src", ""), n)
-        elif name == "twin.stall_ms":
-            builder.add_stall(peer, n)
-        elif name == "twin.peer":
-            if labels.get("event") == "join":
-                builder.set_join(peer, event.get("t", 0.0))
-            elif labels.get("event") == "leave":
-                builder.set_leave(peer, event.get("t", 0.0))
-    return builder.frame()
+        feeder.feed(event)
+    return feeder.frame()
 
 
 def frames_from_timelines(columns, samples, *,

@@ -1286,6 +1286,22 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         step_bytes = (jnp.where(s["is_p2p"], p2p_step,
                                 scenario.cdn_bps * dt_s / 8.0)
                       if c == 0 else p2p_step)
+        if c == 0:
+            # CDN bytes accrue PROGRESSIVELY, capped at the segment
+            # total — the real plane counts each transport progress
+            # chunk as it lands (engine/cdn_agent.py on_progress),
+            # so the metric plane must not dump a whole segment into
+            # the completion tick's window (the twin calibration's
+            # flagged CDN-pacing divergence).  Purely observational:
+            # completion, scheduling, and the final cumulative total
+            # are unchanged (the clip makes the increments sum to
+            # exactly ``total``).  P2P bytes stay completion-counted
+            # in BOTH planes (one Chunk message = one payload).
+            cdn_accrue = jnp.where(
+                progressing & ~s["is_p2p"],
+                jnp.minimum(step_bytes,
+                            jnp.maximum(s["total"] - s["done"], 0.0)),
+                0.0)
         done = s["done"] + jnp.where(progressing, step_bytes, 0.0)
         completed = progressing & (done >= s["total"])
         active = s["active"] & ~completed
@@ -1323,8 +1339,9 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
             is_p2p = is_p2p & ~expired
             done = jnp.where(expired, 0.0, done)
             elapsed = jnp.where(expired, 0.0, elapsed)
-            cdn_bytes = cdn_bytes + jnp.where(completed & ~is_p2p,
-                                              s["total"], 0.0)
+            # progressive accrual above replaces the completion-tick
+            # dump for the CDN leg; p2p stays completion-counted
+            cdn_bytes = cdn_bytes + cdn_accrue
             p2p_bytes = p2p_bytes + jnp.where(completed & is_p2p,
                                               s["total"], 0.0)
             buffer_add = buffer_add + jnp.where(completed, seg, 0.0)

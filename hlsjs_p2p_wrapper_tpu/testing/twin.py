@@ -45,6 +45,33 @@ from .swarm import SwarmHarness
 #: DEFAULT_MAX_CONCURRENT_PREFETCH (tests/test_sim_vs_harness_parity)
 SIM_CONCURRENCY = 3
 
+#: join clock assigned to a forecast lane whose peer has NOT been
+#: observed yet: far past any horizon (zero watch time, zero demand)
+#: while staying well under the kernel's NEVER_S leave sentinel
+ABSENT_JOIN_S = 1e9
+
+
+def effective_cdn_bps(scenario: "TwinScenario") -> float:
+    """The parity mapping's CDN-PACING correction (round 13, the
+    ROADMAP's flagged twin-band contributor): the real plane's
+    :class:`~.mock_cdn.MockCdnTransport` delivers a segment in
+    ``latency_ms`` time-to-first-byte plus whole ``CHUNK_MS`` pacing
+    quanta, while the kernel's CDN leg accrues ``cdn_bps`` from the
+    first tick — so the raw rate overstates what a real fetch
+    achieves by the latency + quantization share of its wall.  The
+    corrected rate is the nominal segment's bits over its actual
+    mock-CDN wall, which is what the sim's continuous accrual needs
+    to finish a segment in the same time the harness does."""
+    from .mock_cdn import MockCdnTransport
+
+    seg_bytes = max(1.0, float(int(
+        scenario.level_bitrates[0] * scenario.seg_duration_s / 8)))
+    bytes_per_chunk = (scenario.cdn_bps / 8000.0
+                       * MockCdnTransport.CHUNK_MS)
+    chunks = max(1, int(-(-seg_bytes // bytes_per_chunk)))
+    wall_ms = scenario.cdn_latency_ms + chunks * MockCdnTransport.CHUNK_MS
+    return seg_bytes * 8.0 * 1000.0 / wall_ms
+
 
 def _is_twin_family(name: str) -> bool:
     """The twin recorder's counter scope: the provenance families
@@ -75,6 +102,9 @@ class TwinScenario:
     #: multiple of ``window_s`` so both planes close the same windows
     watch_s: float = 160.0
     window_s: float = 8.0
+    #: the mock origin's time-to-first-byte (the harness default);
+    #: part of the parity mapping via :func:`effective_cdn_bps`
+    cdn_latency_ms: float = 15.0
     #: real-plane chaos in the shared NetFaultPlan grammar
     #: (``loss@40-70,latency@90-110``); None = clean wire
     fault_specs: Optional[str] = None
@@ -178,7 +208,8 @@ def run_real_plane(scenario: TwinScenario,
         seg_duration=scenario.seg_duration_s,
         frag_count=scenario.frag_count,
         level_bitrates=tuple(int(b) for b in scenario.level_bitrates),
-        cdn_bandwidth_bps=scenario.cdn_bps, seed=scenario.seed,
+        cdn_bandwidth_bps=scenario.cdn_bps,
+        cdn_latency_ms=scenario.cdn_latency_ms, seed=scenario.seed,
         fault_plan_specs=scenario.fault_specs,
         fault_plan_kwargs=({"seed": scenario.seed,
                             **scenario.fault_kwargs}
@@ -224,6 +255,24 @@ def run_real_plane(scenario: TwinScenario,
                          rebuffer=harness.rebuffer_ratio)
 
 
+def parity_sim_config(scenario: TwinScenario,
+                      n_peers: Optional[int] = None):
+    """The calibrated parity mapping's STATIC half: the kernel config
+    every sim-plane consumer (the frame extractor above, the control
+    plane's forecast sweep) must share — tracker topology = full
+    neighbors, foreground + 2 prefetch slots, the "spread" holder
+    policy.  One definition, so a parity fix lands in every
+    consumer at once."""
+    from ..ops.swarm_sim import SwarmConfig
+
+    return SwarmConfig(
+        n_peers=n_peers or scenario.total_peers,
+        n_segments=scenario.frag_count,
+        n_levels=len(scenario.level_bitrates),
+        seg_duration_s=scenario.seg_duration_s,
+        max_concurrency=SIM_CONCURRENCY, holder_selection="spread")
+
+
 def run_sim_plane(scenario: TwinScenario,
                   wave_shift_s: float = 0.0) -> ObservationFrame:
     """Run the scenario through the scanned jnp kernel on the
@@ -237,16 +286,11 @@ def run_sim_plane(scenario: TwinScenario,
     # only the sim plane pays for it
     import jax.numpy as jnp
 
-    from ..ops.swarm_sim import (SwarmConfig, full_neighbors,
-                                 init_swarm, run_swarm,
-                                 timeline_columns)
+    from ..ops.swarm_sim import (full_neighbors, init_swarm,
+                                 run_swarm, timeline_columns)
 
     P = scenario.total_peers
-    config = SwarmConfig(
-        n_peers=P, n_segments=scenario.frag_count,
-        n_levels=len(scenario.level_bitrates),
-        seg_duration_s=scenario.seg_duration_s,
-        max_concurrency=SIM_CONCURRENCY, holder_selection="spread")
+    config = parity_sim_config(scenario)
     record_every = int(round(scenario.window_s * 1000.0
                              / config.dt_ms))
     n_steps = scenario.n_windows * record_every
@@ -256,7 +300,7 @@ def run_sim_plane(scenario: TwinScenario,
         jnp.asarray([float(b) for b in scenario.level_bitrates],
                     jnp.float32),
         full_neighbors(P),
-        jnp.full((P,), float(scenario.cdn_bps), jnp.float32),
+        jnp.full((P,), effective_cdn_bps(scenario), jnp.float32),
         init_swarm(config), n_steps,
         jnp.asarray(joins, jnp.float32),
         uplink_bps=jnp.full((P,), float(scenario.uplink_bps),
@@ -266,3 +310,78 @@ def run_sim_plane(scenario: TwinScenario,
     return frames_from_timelines(
         timeline_columns(config), np.asarray(timeline).tolist(),
         join_s=joins, leave_s=None)
+
+
+def scenario_from_observation(spec: TwinScenario, join_ms,
+                              leave_ms=None):
+    """OBSERVED membership → the forecast kernel's join AND leave
+    schedules.
+
+    ``join_ms`` / ``leave_ms`` map peer id → observed clock (engine
+    ms, the frame builder's ``membership()`` view); the result is a
+    ``(join_s, leave_s)`` pair of ``[P_total]`` vectors in SECONDS on
+    the parity mapping's lanes: observed joins in time order first
+    (deterministic tie-break on peer id, each lane carrying its own
+    peer's observed departure — ``NEVER_S`` while it stays), then
+    :data:`ABSENT_JOIN_S` / ``NEVER_S`` for every not-yet-observed
+    lane — keeping the lane count (and so the compiled forecast
+    program) CONSTANT as membership changes.  A departed peer must
+    NOT keep forecasting as an active uplink supplier — exactly the
+    degraded-membership regimes the controller reacts to.
+    Observation beyond the spec's audience is a hard error: the
+    forecast program's shape is the spec's contract, and silently
+    dropping observed peers would bias every forecast low."""
+    from ..ops.swarm_sim import NEVER_S
+
+    if len(join_ms) > spec.total_peers:
+        raise ValueError(
+            f"observed {len(join_ms)} peers exceeds the forecast "
+            f"spec's audience of {spec.total_peers}")
+    leave_ms = leave_ms or {}
+    joins = sorted((float(t_ms) / 1000.0, peer)
+                   for peer, t_ms in join_ms.items())
+    join_out = [t for t, _peer in joins]
+    leave_out = [float(leave_ms[peer]) / 1000.0
+                 if peer in leave_ms else NEVER_S
+                 for _t, peer in joins]
+    pad = spec.total_peers - len(join_out)
+    join_out += [ABSENT_JOIN_S] * pad
+    leave_out += [NEVER_S] * pad
+    return join_out, leave_out
+
+
+def forecast_group(spec: TwinScenario, join_s, knob_list,
+                   leave_s=None):
+    """One control-tick forecast sweep as the dispatch engine's unit
+    of work: a ``(config, items, build)`` triple for
+    ``stream_groups_chunked``, on the SAME parity mapping as
+    :func:`run_sim_plane` — full neighbors, corrected CDN pacing,
+    shared uplink — with every candidate's scheduler knobs landing
+    as dynamic ``SwarmScenario`` data (one compile group for the
+    whole lattice, every tick, forever)."""
+    import jax.numpy as jnp
+
+    from ..ops.swarm_sim import full_neighbors, make_scenario
+
+    P = spec.total_peers
+    config = parity_sim_config(spec)
+    bitrates = jnp.asarray([float(b) for b in spec.level_bitrates],
+                           jnp.float32)
+    neighbors = full_neighbors(P)
+    cdn = jnp.full((P,), effective_cdn_bps(spec), jnp.float32)
+    uplink = jnp.full((P,), float(spec.uplink_bps), jnp.float32)
+    join = jnp.asarray(list(join_s), jnp.float32)
+    leave = (jnp.asarray(list(leave_s), jnp.float32)
+             if leave_s is not None else None)
+
+    def build(knobs):
+        scenario = make_scenario(
+            config, bitrates, neighbors, cdn, join,
+            uplink_bps=uplink, leave_s=leave,
+            urgent_margin_s=knobs.get("urgent_margin_s"),
+            p2p_budget_fraction=knobs.get("p2p_budget_fraction"),
+            p2p_budget_cap_ms=knobs.get("p2p_budget_cap_ms"),
+            p2p_budget_floor_ms=knobs.get("p2p_budget_floor_ms"))
+        return scenario, join
+
+    return config, list(knob_list), build

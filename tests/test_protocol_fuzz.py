@@ -14,6 +14,7 @@ All fuzzing is seeded and deterministic — a failure reproduces.
 """
 
 import hashlib
+import math
 import random
 import struct
 
@@ -43,6 +44,8 @@ VALID = [
     P.Announce("swarm-abc", "peer-1"),
     P.Peers("swarm-abc", ("a", "b", "c")),
     P.Leave("swarm-abc", "peer-1"),
+    P.SetKnobs("swarm-abc", 3, (("urgent_margin_s", 6.5),)),
+    P.KnobUpdate("swarm-abc", 3, (("p2p_budget_cap_ms", 500.0),)),
 ]
 
 
@@ -343,5 +346,187 @@ def test_agent_counts_mesh_decode_rejects():
         clock.advance(20.0)
         assert "evil" in agent.mesh.peers
         assert agent.mesh.peers["evil"].handshaked
+    finally:
+        agent.dispose()
+
+
+# -- control-plane knob messages (round 13) -----------------------------
+# SET_KNOBS / KNOB_UPDATE carry the live controller's actuations over
+# the same unauthenticated channel ANNOUNCE rides, and both ends
+# dispatch them on transport threads (tracker: concurrent reader
+# threads; client: the agent's frame dispatch) — so the pair gets the
+# directed exhaustive treatment of rounds 9/10: round-trip over edge
+# shapes, every-prefix truncation rejection, forged epoch/count
+# fields, and COUNTED reject paths on both dispatchers.
+
+KNOB_MSGS = [
+    P.SetKnobs("swarm-abc", 1, (("urgent_margin_s", 6.5),)),
+    P.SetKnobs("", 0, ()),                     # empty swarm, no knobs
+    P.SetKnobs("s" * 300, 0xFFFFFFFF,          # u32-edge epoch
+               (("k" * 200, 1e308), ("tiny", 5e-324),
+                ("negzero", -0.0))),           # f64 extremes
+    P.SetKnobs("ümlaut-☃", 7, (("péer_knob", -1e308),)),
+    P.KnobUpdate("swarm-abc", 2, (("p2p_budget_cap_ms", 500.0),
+                                  ("p2p_budget_fraction", 0.5))),
+    P.KnobUpdate("", 1, ()),
+]
+
+
+@pytest.mark.parametrize("msg", KNOB_MSGS,
+                         ids=lambda m: f"{type(m).__name__}-e{m.epoch}")
+def test_knob_messages_round_trip(msg):
+    """encode → decode is the identity for every knob-message shape:
+    empty/unicode/long names, zero knobs, u32-edge epochs, and f64
+    extreme values (max-magnitude, denormal, negative zero)."""
+    frame = P.encode(msg)
+    assert P.decode(frame) == msg
+    assert P.encode(P.decode(frame)) == frame  # canonical both ways
+
+
+@pytest.mark.parametrize("msg", KNOB_MSGS,
+                         ids=lambda m: f"{type(m).__name__}-e{m.epoch}")
+def test_knob_messages_every_truncation_rejected(msg):
+    """EVERY proper prefix of every knob frame must raise
+    ProtocolError — never struct.error (the epoch/count words and
+    each knob's f64 tail are all boundary-checked or translated),
+    and never decode to a message."""
+    frame = P.encode(msg)
+    for cut in range(len(frame)):
+        with pytest.raises(P.ProtocolError):
+            P.decode(frame[:cut])
+
+
+@pytest.mark.parametrize("make", [
+    lambda: P._frame(P.MsgType.SET_KNOBS,          # forged count: 3
+                     P._pack_str("s") + struct.pack("<IH", 1, 3)
+                     + P._pack_str("k") + struct.pack("<d", 1.0)),
+    lambda: P._frame(P.MsgType.KNOB_UPDATE,        # count 0xFFFF
+                     P._pack_str("s")
+                     + struct.pack("<IH", 1, 0xFFFF)),
+    lambda: P._frame(P.MsgType.SET_KNOBS,          # truncated value
+                     P._pack_str("s") + struct.pack("<IH", 1, 1)
+                     + P._pack_str("k") + b"\x00" * 7),
+    lambda: P.encode(P.SetKnobs("s", 1, (("k", 1.0),))) + b"\x00",
+    lambda: P._frame(P.MsgType.KNOB_UPDATE,        # undeclared knob
+                     P._pack_str("s") + struct.pack("<IH", 1, 0)
+                     + P._pack_str("k") + struct.pack("<d", 1.0)),
+], ids=["count-exceeds-body", "count-forged-high", "value-truncated",
+        "trailing-garbage", "undeclared-trailing-knob"])
+def test_knob_forged_fields_rejected(make):
+    """Forged knob-count fields, truncated f64 values, and trailing
+    bytes reject at a boundary check — never via allocation, silent
+    acceptance, or a non-ProtocolError escape."""
+    with pytest.raises(P.ProtocolError):
+        P.decode(make())
+
+
+def test_knob_epoch_outside_u32_refused_at_encode():
+    """The wire carries epochs as u32; the encoder refuses anything
+    it could not represent faithfully (silent wrap would break the
+    strict-monotonicity contract the tracker enforces)."""
+    for epoch in (-1, 0x1_0000_0000):
+        with pytest.raises(P.ProtocolError):
+            P.encode(P.SetKnobs("s", epoch, ()))
+    with pytest.raises(P.ProtocolError):
+        P.encode(P.KnobUpdate("s", -1, ()))
+
+
+def test_knob_count_outside_u16_refused_at_encode():
+    with pytest.raises(P.ProtocolError):
+        P.encode(P.SetKnobs(
+            "s", 1, tuple((f"k{i}", 0.0) for i in range(0x10000))))
+
+
+def test_tracker_endpoint_counts_knob_decode_rejects():
+    """A hostile/truncated SET_KNOBS on the tracker dispatch is a
+    counted ``tracker.decode_rejects`` drop — and the knob store is
+    untouched, so a later well-formed publish starts at a clean
+    epoch."""
+    from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+    from hlsjs_p2p_wrapper_tpu.engine.tracker import (Tracker,
+                                                      TrackerEndpoint)
+    from hlsjs_p2p_wrapper_tpu.engine.transport import LoopbackNetwork
+
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=1.0)
+    registry = MetricsRegistry()
+    tracker = Tracker(clock, registry=registry)
+    TrackerEndpoint(tracker, net.register("tracker"))
+    ctrl = net.register("ctrl")
+    acks = []
+    ctrl.on_receive = lambda src, frame: acks.append(P.decode(frame))
+    hostile = [
+        P.encode(P.SetKnobs("s", 1, (("k", 1.0),)))[:-3],
+        P._frame(P.MsgType.SET_KNOBS, b"\xff\xff"),
+        P._frame(P.MsgType.KNOB_UPDATE, b""),
+    ]
+    for frame in hostile:
+        ctrl.send("tracker", frame)
+    clock.advance(20.0)
+    assert registry.counter("tracker.decode_rejects").value \
+        == len(hostile)
+    assert tracker.knobs_for("s") is None  # store untouched
+    # the dispatch survived: a valid publish lands and is acked
+    ctrl.send("tracker", P.encode(P.SetKnobs("s", 1, (("k", 2.0),))))
+    clock.advance(20.0)
+    assert tracker.knobs_for("s") == (1, (("k", 2.0),))
+    assert acks and acks[-1] == P.KnobUpdate("s", 1, (("k", 2.0),))
+
+
+def test_agent_counts_knob_decode_rejects_and_applies_by_epoch():
+    """The CLIENT dispatch path: a truncated KNOB_UPDATE claiming to
+    come from the tracker is a counted ``mesh.decode_rejects`` drop;
+    a well-formed one applies exactly once per epoch (replays and
+    stale epochs move nothing), and only allowlisted finite knobs
+    reach the policy."""
+    from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+    from hlsjs_p2p_wrapper_tpu.engine.p2p_agent import P2PAgent
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+    from hlsjs_p2p_wrapper_tpu.engine.transport import LoopbackNetwork
+    from hlsjs_p2p_wrapper_tpu.testing.seed_process import (
+        InstantCdn, NullBridge, NullMediaMap)
+
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=1.0)
+    registry = MetricsRegistry()
+    tracker_ep = net.register("tracker")
+    agent = P2PAgent(
+        NullBridge(), "http://cdn.example/master.m3u8", NullMediaMap(),
+        {"network": net, "clock": clock,
+         "cdn_transport": InstantCdn(16), "peer_id": "victim",
+         "content_id": "fuzz-knobs", "metrics_registry": registry},
+        SegmentView, "hls", "v2")
+    try:
+        before = agent.policy.urgent_margin_s
+        cap_default = agent.policy.p2p_budget_cap_ms
+        # truncated KNOB_UPDATE from the trusted src: counted drop
+        tracker_ep.send(
+            "victim",
+            P.encode(P.KnobUpdate(agent.swarm_id, 1,
+                                  (("urgent_margin_s", 9.0),)))[:-2])
+        clock.advance(20.0)
+        assert registry.counter("mesh.decode_rejects").value == 1
+        assert agent.policy.urgent_margin_s == before
+        # valid epoch 1: applied once; replay + stale move nothing
+        update = P.KnobUpdate(
+            agent.swarm_id, 1,
+            (("urgent_margin_s", 9.0), ("not_a_knob", 3.0),
+             ("p2p_budget_cap_ms", float("inf"))))
+        for _ in range(3):
+            tracker_ep.send("victim", P.encode(update))
+        tracker_ep.send("victim", P.encode(P.KnobUpdate(
+            agent.swarm_id, 1, (("urgent_margin_s", 2.0),))))
+        clock.advance(20.0)
+        assert agent.policy.urgent_margin_s == 9.0
+        assert agent.tracker_client.knob_epoch == 1
+        # unknown name + non-finite value were skipped, not applied
+        assert agent.policy.p2p_budget_cap_ms == cap_default
+        assert math.isfinite(agent.policy.p2p_budget_cap_ms)
+        applies = sum(
+            v for labels, v in
+            registry.series("control.knob_applies")
+            if labels.get("result") == "applied")
+        assert applies == 1  # one epoch, one apply — replays gated
     finally:
         agent.dispose()

@@ -1,0 +1,175 @@
+"""Live control plane service CLI: observe → predict → actuate.
+
+Runs an :class:`~hlsjs_p2p_wrapper_tpu.engine.controller.ControlLoop`
+over a flight-recorder shard: tail-follow the ``twin.*`` provenance
+stream, close one control tick per observation window, forecast the
+candidate-knob lattice on the warm-started engine (one
+``stream_groups_chunked`` dispatch of the row-cache misses per tick),
+decide under the explicit constraint with the committed-twin-band
+do-no-harm rule, and actuate — either into an append-only fsync'd
+actuation log (``--actuate-log``, the replay/offline mode the gate's
+kill/resume proof drives) or through a live tracker via the caller
+embedding the loop (tools/control_gate.py part A does exactly that).
+
+The controller state checkpoints atomically after every tick
+(digest-checked, under the warm-start root), so a SIGKILL'd service
+``--resume``-s: the shard is replayed through the same reducers, the
+recorded decision prefix is re-derived (never trusted), and already-
+actuated epochs are refused by the actuation log's idempotency — no
+duplicate actuations, epochs strictly monotone.
+
+Spec file (``--spec``, JSON)::
+
+    {"scenario": {... TwinScenario fields ...},
+     "knob_grid": {"urgent_margin_s": [0.5, 2.0, 4.0, 6.0, 8.0]},
+     "initial_knobs": {"urgent_margin_s": 0.5},
+     "constraint": "rebuffer<=0.02",
+     "bands_path": "TWIN_r10.json", "band_set": "chaos",
+     "swarm_id": "...", "warmup_windows": 2, "hysteresis_ticks": 2}
+
+Usage::
+
+    python tools/control.py --spec SPEC.json --shard SHARD.jsonl \
+        --actuate-log ACTS.jsonl --cache-dir CACHE --out DECISIONS.json
+    python tools/control.py ... --resume          # after a SIGKILL
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
+    WarmStart, atomic_write_json,
+    enable_persistent_compilation_cache)
+from hlsjs_p2p_wrapper_tpu.engine.controller import (  # noqa: E402
+    ControlConfig, ControlLoop, LogActuator, control_checkpoint_path)
+from hlsjs_p2p_wrapper_tpu.engine.search import Constraint  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.testing.twin import TwinScenario  # noqa: E402
+
+
+def load_config(spec_path: str) -> ControlConfig:
+    """Spec JSON → :class:`ControlConfig` (bands resolved from the
+    committed artifact the spec names)."""
+    with open(spec_path, encoding="utf-8") as fh:
+        spec = json.load(fh)
+    scenario = TwinScenario(**spec["scenario"])
+    bands_path = spec["bands_path"]
+    if not os.path.isabs(bands_path):
+        bands_path = os.path.join(os.path.dirname(
+            os.path.abspath(spec_path)), bands_path)
+    with open(bands_path, encoding="utf-8") as fh:
+        artifact = json.load(fh)
+    band_set = spec.get("band_set", "clean")
+    return ControlConfig(
+        spec=scenario,
+        knob_grid=spec["knob_grid"],
+        initial_knobs=spec["initial_knobs"],
+        constraint=Constraint.parse(spec["constraint"]),
+        bands=artifact["scenarios"][band_set]["bands"],
+        band_set=band_set,
+        swarm_id=spec.get("swarm_id", ""),
+        warmup_windows=int(spec.get("warmup_windows", 2)),
+        hysteresis_ticks=int(spec.get("hysteresis_ticks", 2)),
+        forecast_chunk=int(spec.get("forecast_chunk", 8)))
+
+
+class _KillingActuator:
+    """Chaos hook: behave as the wrapped actuator, then SIGKILL the
+    process after the N-th actuation — AFTER the actuation became
+    durable, BEFORE the tick checkpoints (the nastiest point: a
+    naive resume would re-derive the decision and actuate it
+    twice)."""
+
+    def __init__(self, inner, kill_at: int):
+        self.inner = inner
+        self.kill_at = kill_at
+        self.count = 0
+
+    def actuate(self, epoch: int, knobs) -> bool:
+        ok = self.inner.actuate(epoch, knobs)
+        self.count += 1
+        if self.count >= self.kill_at:
+            os.kill(os.getpid(), signal.SIGKILL)
+        return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--spec", required=True,
+                    help="controller spec JSON (module docstring)")
+    ap.add_argument("--shard", required=True,
+                    help="flight-recorder shard to ingest")
+    ap.add_argument("--actuate-log", required=True,
+                    help="append-only fsync'd actuation JSONL (the "
+                         "idempotent-by-epoch external effect)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="warm-start cache root (forecast row cache "
+                         "+ AOT executables + checkpoint)")
+    ap.add_argument("--resume", action="store_true",
+                    help="restore the digest-checked checkpoint and "
+                         "re-derive the decision prefix from the "
+                         "shard")
+    ap.add_argument("--out", default=None,
+                    help="write the decisions artifact here "
+                         "(atomic)")
+    ap.add_argument("--sigkill-at-actuation", type=int, default=0,
+                    metavar="N",
+                    help="chaos hook: SIGKILL self after the N-th "
+                         "actuation lands in the log, before the "
+                         "tick checkpoints")
+    args = ap.parse_args()
+
+    config = load_config(args.spec)
+    warm = WarmStart(cache_dir=args.cache_dir)
+    enable_persistent_compilation_cache(warm.cache_dir)
+    actuator = LogActuator(args.actuate_log)
+    if args.sigkill_at_actuation > 0:
+        actuator = _KillingActuator(actuator,
+                                    args.sigkill_at_actuation)
+    loop = ControlLoop(
+        config, args.shard, actuator, warm_start=warm,
+        registry=warm.registry,
+        checkpoint_path=control_checkpoint_path(warm.cache_dir,
+                                                config))
+    resumed = False
+    if args.resume:
+        resumed = loop.resume()
+    loop.run_available()
+
+    doc = {
+        "meta": {
+            "spec": os.path.abspath(args.spec),
+            "shard": os.path.abspath(args.shard),
+            "resumed": resumed,
+            "scenario": dataclasses.asdict(config.spec),
+            "constraint": [config.constraint.metric,
+                           config.constraint.bound,
+                           config.constraint.objective],
+            "band_set": config.band_set,
+        },
+        "ticks": len(loop.decisions),
+        "epoch": loop.epoch,
+        "current_knobs": loop.current_knobs,
+        "decisions": loop.decisions,
+        "tick_stats": loop.tick_stats,
+    }
+    if args.out:
+        atomic_write_json(args.out, doc)
+    actions = [d["action"] for d in loop.decisions]
+    print(f"# control: {len(loop.decisions)} ticks, "
+          f"epoch {loop.epoch}, "
+          f"{actions.count('actuate')} actuations / "
+          f"{actions.count('hold')} holds / "
+          f"{actions.count('veto')} vetoes"
+          + (" (resumed)" if resumed else ""), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,605 @@
+"""Control-plane gate: the forecast-driven controller closes the
+observe → predict → actuate loop under chaos, measurably and
+deterministically.
+
+This is the proof for engine/controller.py + tools/control.py — the
+first subsystem that exercises every previous plane in ONE loop: the
+flight-recorder stream is the observation plane (round 7), the
+tracker carries the actuation channel (round 9), the self-healing
+wire keeps it converging under faults (round 10), the warm-started
+dispatch engine runs the forecasts (rounds 4/11), and the committed
+twin bands (round 12, ``TWIN_r10.json``) are the error bar the
+do-no-harm rule inherits.  Three parts:
+
+**A — the closed loop wins under chaos (deterministic plane).**  A
+scarce-supply swarm scenario (uplink just above the bitrate, a slow
+per-fetch CDN) with an injected regional degradation — a
+``NetFaultPlan`` loss window over the P2P fabric — runs twice on the
+loopback harness: once with a STATIC aggressive config (long P2P
+budgets: high offload when the wire is clean, heavy stalls when it
+is not), once with the live controller closing the loop each
+observation window (tail-follow ingest of the twin provenance shard,
+a candidate-knob-lattice forecast dispatch on the warm engine, the
+banded do-no-harm decision, SET_KNOBS actuation through the
+tracker).  Asserted: the controller actuates (epochs strictly
+monotone, every live agent converges to the final epoch), every
+recorded decision names the twin band it cleared or held inside
+(in-band decisions are counted holds, never actuations), and the
+controlled run BEATS the static run on the constrained objective by
+more than the committed chaos-band envelope — the same
+``atol + rtol·max(|a|,|b|)`` tolerance the twin's own divergence
+detector uses, so the win is bigger than anything the twin could
+call noise.  A same-seed rerun (same cache) must reproduce the
+identical decision sequence and identical frames.
+
+**B — actuation survives the real wire.**  A real-TCP PSK swarm
+(socket tracker, ``concurrent=True``, full agents) takes a knob
+epoch through SET_KNOBS → piggybacked KNOB_UPDATE; a stale epoch is
+refused and counted; a late joiner converges on its FIRST announce;
+and a blackhole window (engine/netfaults.py) severing every link
+mid-epoch heals — the controller republishes until acked, the
+healed agents' reconnect re-announce picks the epoch up, and
+convergence is reached with the recovery counted in
+``net.reconnects``.
+
+**C — SIGKILL mid-tick, resume, same decisions.**  ``tools/
+control.py`` replays part A's recorded shard offline twice: an
+uninterrupted reference, and a run SIGKILLed at the nastiest point —
+after its first actuation lands in the fsync'd actuation log,
+BEFORE the tick checkpoints.  The resumed run must re-derive the
+IDENTICAL decision sequence (equal to the reference AND to part A's
+live loop), with the actuation log holding each epoch EXACTLY once
+(the log actuator's idempotency is the duplicate-actuation guard the
+checkpoint alone cannot be).
+
+Gate-sized by default; ``CONTROL_GATE_SEED`` / ``CONTROL_GATE_PEERS``
+/ ``CONTROL_GATE_WAVE`` resize it.  Run: ``python
+tools/control_gate.py`` (exit 1 on any violation); ``make
+control-gate`` wires it into ``make check``.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
+    WarmStart)
+from hlsjs_p2p_wrapper_tpu.engine.controller import (  # noqa: E402
+    ControlConfig, ControlLoop, TransportActuator, band_halfwidth,
+    control_checkpoint_path)
+from hlsjs_p2p_wrapper_tpu.engine.search import Constraint  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
+    FlightRecorder)
+from hlsjs_p2p_wrapper_tpu.engine.tracker import swarm_id_for  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.testing.swarm import SwarmHarness  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.testing.twin import (  # noqa: E402
+    TwinScenario, TwinSampler, _is_twin_family)
+
+BANDS_PATH = os.path.join(_REPO, "TWIN_r10.json")
+
+#: the injected regional degradation: a loss band over the P2P
+#: fabric through the middle of the watch window (the wave cohort
+#: lands inside it)
+CHAOS_SPECS = "loss@40-120"
+CHAOS_KWARGS = {"loss_rate": 0.4}
+
+#: the static config under test: long P2P budgets — high offload on
+#: a clean wire, heavy stalls when transfers crawl or die
+STATIC_KNOBS = {"p2p_budget_cap_ms": 6000.0,
+                "p2p_budget_fraction": 0.9}
+
+#: the candidate lattice around it (the controller only ever
+#: actuates lattice points; the static config is one of them)
+KNOB_GRID = {"p2p_budget_cap_ms": [500.0, 6000.0],
+             "p2p_budget_fraction": [0.5, 0.9]}
+
+CONSTRAINT = "rebuffer<=0.05"
+BAND_SET = "chaos"
+
+CHECKS = []
+
+
+def check(ok, what):
+    CHECKS.append((bool(ok), what))
+    print(f"  [{'ok ' if ok else 'FAIL'}] {what}")
+
+
+def gate_spec() -> TwinScenario:
+    """The gate scenario: scarce supply (uplink just above the
+    bitrate, per-fetch CDN barely real-time) where the P2P budget
+    knobs genuinely trade offload against rebuffer — in BOTH
+    planes — plus the chaos window on the real wire."""
+    return TwinScenario(
+        seed=int(os.environ.get("CONTROL_GATE_SEED", 0)),
+        n_peers=int(os.environ.get("CONTROL_GATE_PEERS", 8)),
+        wave_peers=int(os.environ.get("CONTROL_GATE_WAVE", 4)),
+        uplink_bps=900_000.0, cdn_bps=1_200_000.0,
+        fault_specs=CHAOS_SPECS, fault_kwargs=dict(CHAOS_KWARGS))
+
+
+def control_config(spec: TwinScenario) -> ControlConfig:
+    with open(BANDS_PATH, encoding="utf-8") as fh:
+        artifact = json.load(fh)
+    return ControlConfig(
+        spec=spec, knob_grid={k: list(v)
+                              for k, v in KNOB_GRID.items()},
+        initial_knobs=dict(STATIC_KNOBS),
+        constraint=Constraint.parse(CONSTRAINT),
+        bands=artifact["scenarios"][BAND_SET]["bands"],
+        band_set=BAND_SET,
+        swarm_id=swarm_id_for(None, {"content_id": "swarm-content"}))
+
+
+def run_plane(spec: TwinScenario, knobs: dict, trace_dir=None,
+              cache_dir=None, controlled=False,
+              checkpoint_path=None):
+    """One harness run, window-locked: joins replayed in time order,
+    one TwinSampler window per ``window_s``, and — when
+    ``controlled`` — one ControlLoop poll after every closed window
+    (the live service's cadence, driven synchronously so the run is
+    deterministic).  Every peer starts from ``knobs`` (the static
+    config; the controller moves them from there)."""
+    harness = SwarmHarness(
+        seg_duration=spec.seg_duration_s, frag_count=spec.frag_count,
+        level_bitrates=tuple(int(b) for b in spec.level_bitrates),
+        cdn_bandwidth_bps=spec.cdn_bps,
+        cdn_latency_ms=spec.cdn_latency_ms, seed=spec.seed,
+        fault_plan_specs=spec.fault_specs,
+        fault_plan_kwargs=({"seed": spec.seed, **spec.fault_kwargs}
+                           if spec.fault_specs else None))
+    recorder = None
+    shard_path = None
+    if trace_dir is not None:
+        recorder = FlightRecorder(trace_dir, "twin00",
+                                  clock=harness.clock.now,
+                                  registry=harness.metrics,
+                                  counter_filter=_is_twin_family)
+        shard_path = recorder.path
+    sampler = TwinSampler(harness, spec.window_s * 1000.0,
+                          recorder=recorder)
+    loop = None
+    ctrl_recorder = None
+    if controlled:
+        config = control_config(spec)
+        warm = WarmStart(cache_dir=cache_dir)
+        ctrl_recorder = FlightRecorder(trace_dir, "ctrl00",
+                                       clock=harness.clock.now,
+                                       registry=warm.registry)
+        endpoint = harness.network.register("controller")
+        actuator = TransportActuator(endpoint, config.swarm_id,
+                                     registry=warm.registry)
+        loop = ControlLoop(
+            config, shard_path, actuator, warm_start=warm,
+            registry=warm.registry, recorder=ctrl_recorder,
+            checkpoint_path=(checkpoint_path
+                             or control_checkpoint_path(
+                                 warm.cache_dir, config)))
+    joins = spec.join_times_s()
+    order = sorted(range(len(joins)), key=lambda i: (joins[i], i))
+    next_join = 0
+    try:
+        for k in range(1, spec.n_windows + 1):
+            target = k * spec.window_s * 1000.0
+            while next_join < len(order) and \
+                    joins[order[next_join]] * 1000.0 <= target:
+                i = order[next_join]
+                harness.run(max(joins[i] * 1000.0
+                                - harness.clock.now(), 0.0))
+                harness.add_peer(f"p{i}",
+                                 uplink_bps=spec.uplink_bps,
+                                 p2p_config=dict(knobs))
+                next_join += 1
+            harness.run(target - harness.clock.now())
+            if loop is not None:
+                loop.run_available()
+    finally:
+        if recorder is not None:
+            recorder.close()
+        if ctrl_recorder is not None:
+            ctrl_recorder.close()
+    return {
+        "offload": harness.offload_ratio,
+        "rebuffer": harness.rebuffer_ratio,
+        "frames": sampler.frame(),
+        "harness": harness,
+        "loop": loop,
+        "shard": shard_path,
+        "ctrl_shard": (ctrl_recorder.path
+                       if ctrl_recorder is not None else None),
+    }
+
+
+def decision_fingerprint(decisions):
+    """The comparable view of a decision sequence (strips the
+    per-run timing fields none of which exist in decisions — the
+    decisions ARE pure — so this is just a stable JSON render)."""
+    return json.dumps(decisions, sort_keys=True)
+
+
+def part_a(root):
+    """The closed loop beats the static config under chaos."""
+    spec = gate_spec()
+    config = control_config(spec)
+    constraint = config.constraint
+    cache_dir = os.path.join(root, "cache")
+
+    print(f"control-gate A: static run ({spec.total_peers} peers, "
+          f"chaos {spec.fault_specs})")
+    static = run_plane(spec, STATIC_KNOBS)
+    print(f"  static: offload={static['offload']:.4f} "
+          f"rebuffer={static['rebuffer']:.5f}")
+
+    print("control-gate A: controlled run")
+    trace_dir = os.path.join(root, "controlled")
+    controlled = run_plane(spec, STATIC_KNOBS, trace_dir=trace_dir,
+                           cache_dir=cache_dir, controlled=True)
+    loop = controlled["loop"]
+    print(f"  controlled: offload={controlled['offload']:.4f} "
+          f"rebuffer={controlled['rebuffer']:.5f}, "
+          f"epoch={loop.epoch}, "
+          f"{sum(1 for d in loop.decisions if d['action'] == 'actuate')}"
+          f" actuations / {len(loop.decisions)} ticks")
+
+    # the loop ran and actuated
+    check(len(loop.decisions) == spec.n_windows,
+          f"one control tick per window "
+          f"({len(loop.decisions)}/{spec.n_windows})")
+    actuations = [d for d in loop.decisions
+                  if d["action"] == "actuate"]
+    check(len(actuations) >= 1,
+          f"controller actuated ({len(actuations)} actuations)")
+    epochs = [d["epoch"] for d in actuations]
+    check(epochs == list(range(1, len(epochs) + 1)),
+          f"knob epochs strictly monotone from 1: {epochs}")
+
+    # every decision names its band; in-band decisions are holds
+    check(all("band" in d and d["band"]["set"] == BAND_SET
+              for d in loop.decisions),
+          "every decision names the TWIN_r10 band set it was "
+          "measured against")
+    for d in loop.decisions:
+        if d["action"] == "actuate":
+            if not (d["band"]["delta"] is not None
+                    and d["band"]["delta"] > d["band"]["halfwidth"]):
+                check(False, f"actuation at tick {d['tick']} did "
+                             f"not clear its band: {d['band']}")
+                break
+    else:
+        check(True, "every actuation cleared its named band "
+                    "(delta > halfwidth)")
+    check(all(d.get("reason") for d in loop.decisions
+              if d["action"] in ("hold", "veto")),
+          "every hold/veto carries its reason (band / warmup / "
+          "hysteresis)")
+    holds = loop.registry.series("control.holds")
+    check(sum(v for _l, v in holds) ==
+          sum(1 for d in loop.decisions if d["action"] == "hold"),
+          "holds counted in control.holds exactly")
+    check(int(loop.registry.counter("control.actuations").value)
+          == len(actuations),
+          "actuations counted in control.actuations exactly")
+
+    # the swarm converged to the controller's final epoch
+    agents = [p.agent for p in controlled["harness"].peers
+              if p.agent is not None]
+    final_knobs = loop.current_knobs
+    converged = [a for a in agents
+                 if a.tracker_client.knob_epoch == loop.epoch
+                 and all(getattr(a.policy, k) == v
+                         for k, v in final_knobs.items())]
+    check(len(converged) == len(agents),
+          f"every live agent converged to epoch {loop.epoch} "
+          f"({len(converged)}/{len(agents)})")
+
+    # the WIN: controlled beats static on the constrained objective
+    # by more than the committed chaos-band envelope
+    s_trial = {"offload": static["offload"],
+               "rebuffer": static["rebuffer"]}
+    c_trial = {"offload": controlled["offload"],
+               "rebuffer": controlled["rebuffer"]}
+    s_feas = constraint.feasible(s_trial)
+    c_feas = constraint.feasible(c_trial)
+    check(c_feas,
+          f"controlled run satisfies {CONSTRAINT}: "
+          f"rebuffer={c_trial['rebuffer']:.5f}")
+    if c_feas and not s_feas:
+        metric = constraint.metric
+        delta = s_trial[metric] - c_trial[metric]
+    else:
+        metric = constraint.objective
+        delta = c_trial[metric] - s_trial[metric]
+    hw = band_halfwidth(config.bands, metric, s_trial[metric],
+                        c_trial[metric])
+    check(delta > hw,
+          f"controlled beats static on {metric} beyond the "
+          f"committed {BAND_SET} band: delta={delta:.5f} > "
+          f"halfwidth={hw:.5f}")
+
+    # determinism: same seed + same cache, identical decisions and
+    # identical frames
+    print("control-gate A: same-seed controlled rerun")
+    rerun = run_plane(spec, STATIC_KNOBS,
+                      trace_dir=os.path.join(root, "rerun"),
+                      cache_dir=cache_dir, controlled=True)
+    check(decision_fingerprint(rerun["loop"].decisions)
+          == decision_fingerprint(loop.decisions),
+          "same-seed rerun reproduced the identical decision "
+          "sequence")
+    check(rerun["frames"] == controlled["frames"],
+          "same-seed rerun reproduced identical observation frames")
+    cached_rows = sum(
+        v for labels, v in
+        rerun["loop"].registry.series("control.forecast_rows")
+        if labels.get("source") == "cache")
+    fresh_rows = sum(
+        v for labels, v in
+        rerun["loop"].registry.series("control.forecast_rows")
+        if labels.get("source") == "dispatch")
+    check(fresh_rows == 0 and cached_rows > 0,
+          f"warm rerun forecast entirely from the row cache "
+          f"({cached_rows} cached, {fresh_rows} fresh)")
+
+    return {"spec": spec, "config": config, "static": s_trial,
+            "controlled": c_trial, "loop": loop,
+            "shard": controlled["shard"],
+            "ctrl_shard": controlled["ctrl_shard"],
+            "cache_dir": cache_dir}
+
+
+def part_b():
+    """Actuation over the real TCP PSK wire, through a blackhole."""
+    import gc
+
+    from hlsjs_p2p_wrapper_tpu.engine.net import (ReconnectPolicy,
+                                                  TcpNetwork)
+    from hlsjs_p2p_wrapper_tpu.engine.netfaults import NetFaultPlan
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+    from hlsjs_p2p_wrapper_tpu.engine.tracker import (Tracker,
+                                                      TrackerEndpoint)
+    from hlsjs_p2p_wrapper_tpu.testing.fixtures import wait_for
+    from hlsjs_p2p_wrapper_tpu.testing.seed_process import (
+        InstantCdn, NullBridge, NullMediaMap)
+    from hlsjs_p2p_wrapper_tpu.core.segment_view import SegmentView
+    from hlsjs_p2p_wrapper_tpu.engine.p2p_agent import P2PAgent
+
+    print("control-gate B: real-TCP PSK actuation")
+    gc.collect()
+    registry = MetricsRegistry()
+    # the blackhole window opens shortly after the first epoch is
+    # published and swallows every socket for a second — the heal
+    # machinery (round 10) must carry the epoch across it
+    plan = NetFaultPlan.parse("blackhole@1.5-3.0", seed=11,
+                              registry=registry)
+    heal = ReconnectPolicy(max_retries=6, backoff_base_s=0.02,
+                           backoff_cap_s=0.2, seed=11,
+                           idle_probe_s=1.0, circuit_threshold=24,
+                           circuit_cooldown_s=0.5)
+    network = TcpNetwork(psk=b"control-gate", registry=registry,
+                         fault_plan=plan, heal=heal)
+    agents = []
+    try:
+        tracker_endpoint = network.register()
+        tracker = Tracker(network.loop, registry=registry)
+        TrackerEndpoint(tracker, tracker_endpoint, concurrent=True)
+
+        def make_agent():
+            return P2PAgent(
+                NullBridge(), "http://cdn.example/master.m3u8",
+                NullMediaMap(),
+                {"network": network, "clock": network.loop,
+                 "cdn_transport": InstantCdn(10_000),
+                 "tracker_peer_id": tracker_endpoint.peer_id,
+                 "content_id": "control-gate",
+                 "announce_interval_ms": 250.0,
+                 "metrics_registry": registry},
+                SegmentView, "hls", "v2")
+
+        agents.append(make_agent())
+        agents.append(make_agent())
+        swarm_id = agents[0].swarm_id
+        ctrl_ep = network.register()
+        actuator = TransportActuator(ctrl_ep, swarm_id,
+                                     tracker_peer_id=tracker_endpoint
+                                     .peer_id, registry=registry)
+
+        # epoch 1: plain convergence through announce piggyback
+        actuator.actuate(1, {"urgent_margin_s": 6.5})
+        check(wait_for(lambda: actuator.acked_epoch >= 1, 10.0),
+              "SET_KNOBS acked by KNOB_UPDATE (epoch 1)")
+        check(wait_for(lambda: all(
+            a.policy.urgent_margin_s == 6.5 and
+            a.tracker_client.knob_epoch == 1 for a in agents), 10.0),
+            "every agent applied epoch 1 via the announce piggyback")
+
+        # stale epoch refused + counted, nothing re-applied
+        actuator.actuate(1, {"urgent_margin_s": 0.25})
+        check(wait_for(lambda: any(
+            v >= 1 for labels, v in
+            registry.series("tracker.knob_sets")
+            if labels.get("result") == "stale"), 10.0),
+            "stale epoch refused and counted "
+            "(tracker.knob_sets{result=stale})")
+        check(all(a.policy.urgent_margin_s == 6.5 for a in agents),
+              "stale epoch did not move any agent's policy")
+
+        # setup traffic on the faulted fabric already auto-armed the
+        # plan — force the window epoch to NOW so the blackhole
+        # actually overlaps the epoch-2 publish, and publish from
+        # INSIDE the window (sends swallowed, idle probes forced)
+        plan.rearm()
+        time.sleep(1.6)  # clock-ok: real-socket window alignment
+        # epoch 2 rides through the blackhole: the controller
+        # republishes until acked, healed agents re-announce
+        deadline = time.monotonic() + 20.0  # clock-ok: real sockets
+        while actuator.acked_epoch < 2 \
+                and time.monotonic() < deadline:  # clock-ok: ditto
+            actuator.actuate(2, {"urgent_margin_s": 2.0})
+            time.sleep(0.25)  # clock-ok: real-socket pacing
+        check(actuator.acked_epoch >= 2,
+              "epoch 2 publish survived the blackhole window "
+              "(republish-until-acked)")
+        check(wait_for(lambda: all(
+            a.policy.urgent_margin_s == 2.0 and
+            a.tracker_client.knob_epoch == 2 for a in agents), 15.0),
+            "healed agents converged to epoch 2 (reconnect "
+            "re-announce picked up the piggyback)")
+        # the blackhole's counted recovery union (the net-chaos
+        # gate's discipline): swallowed sends surface as spliced
+        # frames the MAC integrity check drops, held reads as probe
+        # reconnects — either way the defense must have ACTED, not
+        # merely survived
+        reconnects = sum(v for _l, v in
+                         registry.series("net.reconnects"))
+        mac_drops = sum(v for _l, v in
+                        registry.series("net.mac_drops"))
+        check(reconnects + mac_drops >= 1,
+              f"the blackhole forced counted recovery actions "
+              f"(net.reconnects={reconnects} + "
+              f"net.mac_drops={mac_drops})")
+
+        # a LATE joiner converges on its first announce
+        agents.append(make_agent())
+        check(wait_for(lambda:
+                       agents[-1].policy.urgent_margin_s == 2.0
+                       and agents[-1].tracker_client.knob_epoch == 2,
+                       10.0),
+              "late joiner converged to the current epoch on its "
+              "first announce")
+        applies = sum(v for labels, v in
+                      registry.series("control.knob_applies")
+                      if labels.get("result") == "applied")
+        check(applies == 2 * len(agents[:2]) + 1,
+              f"knob applies counted once per (agent, epoch): "
+              f"{applies}")
+    finally:
+        for agent in agents:
+            agent.dispose()
+        network.close()
+
+
+def part_c(a):
+    """SIGKILL mid-tick + resume: identical decisions, no duplicate
+    actuations."""
+    print("control-gate C: offline replay, SIGKILL + resume")
+    root = os.path.dirname(a["shard"])
+    spec_path = os.path.join(root, "control_spec.json")
+    with open(spec_path, "w", encoding="utf-8") as fh:
+        json.dump({
+            "scenario": dataclasses.asdict(a["spec"]),
+            "knob_grid": KNOB_GRID,
+            "initial_knobs": STATIC_KNOBS,
+            "constraint": CONSTRAINT,
+            "bands_path": BANDS_PATH,
+            "band_set": BAND_SET,
+            "swarm_id": a["config"].swarm_id,
+        }, fh)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def replay(tag, *extra):
+        out = os.path.join(root, f"{tag}.json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools",
+                                          "control.py"),
+             "--spec", spec_path, "--shard", a["shard"],
+             "--actuate-log", os.path.join(root, f"{tag}_acts.jsonl"),
+             "--cache-dir", a["cache_dir"], "--out", out, *extra],
+            env=env, capture_output=True, text=True)
+        return proc, out
+
+    proc, ref_out = replay("ref")
+    check(proc.returncode == 0,
+          f"reference replay exited 0 (stderr: "
+          f"{proc.stderr.strip()[-200:]})")
+    with open(ref_out, encoding="utf-8") as fh:
+        ref = json.load(fh)
+    check(decision_fingerprint(ref["decisions"])
+          == decision_fingerprint(a["loop"].decisions),
+          "offline replay re-derived the live loop's decision "
+          "sequence exactly")
+
+    # the kill run: SIGKILL after the first actuation lands in the
+    # log, BEFORE the tick checkpoints
+    proc, _ = replay("kill", "--sigkill-at-actuation", "1")
+    check(proc.returncode == -signal.SIGKILL,
+          f"kill run died by SIGKILL (rc={proc.returncode})")
+    kill_log = os.path.join(root, "kill_acts.jsonl")
+    with open(kill_log, encoding="utf-8") as fh:
+        pre = [json.loads(line) for line in fh if line.strip()]
+    check([e["epoch"] for e in pre] == [1],
+          f"the killed run actuated epoch 1 exactly once before "
+          f"dying: {[e['epoch'] for e in pre]}")
+
+    proc, res_out = replay("kill", "--resume")
+    check(proc.returncode == 0,
+          f"resumed replay exited 0 (stderr: "
+          f"{proc.stderr.strip()[-200:]})")
+    with open(res_out, encoding="utf-8") as fh:
+        resumed = json.load(fh)
+    check(decision_fingerprint(resumed["decisions"])
+          == decision_fingerprint(ref["decisions"]),
+          "resume re-derived the identical decision sequence")
+    with open(kill_log, encoding="utf-8") as fh:
+        post = [json.loads(line)["epoch"] for line in fh
+                if line.strip()]
+    check(all(b > a for a, b in zip(post, post[1:])),
+          f"actuation log epochs strictly monotone: {post}")
+    check(len(post) == len(set(post)),
+          f"no duplicate actuations across the SIGKILL "
+          f"(epochs {post})")
+    ref_epochs = [d["epoch"] for d in ref["decisions"]
+                  if d["action"] == "actuate"]
+    check(post == ref_epochs,
+          f"resumed log holds exactly the reference's actuated "
+          f"epochs: {post} == {ref_epochs}")
+
+
+def part_consumers(a):
+    """The satellite consumers hold on this run's artifacts."""
+    from fleet_console import render_frame
+    from trace_export import export_dir
+
+    events = export_dir(os.path.dirname(a["ctrl_shard"]))["traceEvents"]
+    ticks = [e for e in events if e.get("ph") == "i"
+             and e.get("name") == "control_tick"]
+    check(len(ticks) == len(a["loop"].decisions),
+          f"Perfetto export renders one control_tick instant per "
+          f"tick ({len(ticks)})")
+    tracks = {e.get("name") for e in events if e.get("ph") == "C"}
+    check("control actuations" in tracks,
+          f"cumulative actuations counter track present "
+          f"(tracks: {sorted(tracks)[:8]}...)")
+    panel = render_frame(trace_dir=os.path.dirname(a["ctrl_shard"]),
+                         control=True)
+    check("control" in panel and "epoch" in panel,
+          f"console --control panel renders (got: {panel[:160]!r})")
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="control-gate-") as root:
+        a = part_a(root)
+        part_b()
+        part_c(a)
+        part_consumers(a)
+
+    failed = [what for ok, what in CHECKS if not ok]
+    print(f"control-gate: {len(CHECKS) - len(failed)}/{len(CHECKS)} "
+          f"checks passed")
+    if failed:
+        for what in failed:
+            print(f"control-gate FAILED: {what}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,7 +53,7 @@ from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
 from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
     merge_trace)
 from hlsjs_p2p_wrapper_tpu.engine.twinframe import (  # noqa: E402
-    ObservationFrame, frame_errors)
+    ObservationFrame, frame_errors, parse_labels)
 
 #: the twin panel's headline metrics, in display order (the gate's
 #: agreement trio plus the delivery rates)
@@ -203,8 +203,70 @@ def twin_panel(twin_path) -> list:
     return lines
 
 
+def control_panel(events) -> list:
+    """Control-plane panel lines from a merged event stream: the last
+    ``control_tick`` mark (tick / action / epoch / forecast-vs-
+    constraint headroom / staleness against the stream head) plus the
+    ``control.*`` counter families (actuations, holds and vetoes by
+    reason, forecast-row provenance, republishes).  Degrades to one
+    explanatory line on artifacts from runs without a controller —
+    never a traceback."""
+    ticks = [e for e in events if e.get("kind") == "mark"
+             and e.get("name") == "control_tick"]
+    counts = {}
+    for event in events:
+        if event.get("kind") != "counter":
+            continue
+        name = str(event.get("name", ""))
+        if not name.startswith("control."):
+            continue
+        key = (name[len("control."):], event.get("labels", ""))
+        counts[key] = counts.get(key, 0) + int(event.get("n", 1))
+    if not ticks and not counts:
+        return ["control: no controller events in trace (run "
+                "without a controller — nothing to show)"]
+    lines = ["control plane:"]
+    if ticks:
+        last = ticks[-1]
+        newest = max(e.get("t", 0.0) for e in events)
+        lag = newest - last.get("t", 0.0)
+        headroom = last.get("headroom")
+        lines.append(
+            f"  last tick {last.get('tick')} "
+            f"({last.get('action')}) at t={last.get('t'):g}, "
+            f"lag {lag:g} behind stream head; "
+            f"knob epoch {last.get('epoch')}, headroom "
+            + (f"{headroom:+.4f}" if headroom is not None
+               else "n/a (warmup)"))
+    def total(family):
+        return sum(v for (fam, _labels), v in counts.items()
+                   if fam == family)
+    def by_label(family, key):
+        out = {}
+        for (fam, labels), v in counts.items():
+            if fam == family:
+                label = parse_labels(labels).get(key, "?")
+                out[label] = out.get(label, 0) + v
+        return out
+    holds = by_label("holds", "reason")
+    vetoes = by_label("vetoes", "reason")
+    rows = by_label("forecast_rows", "source")
+    lines.append(
+        f"  actuations {total('actuations')}, holds "
+        + (", ".join(f"{r}={n}" for r, n in sorted(holds.items()))
+           or "0")
+        + ", vetoes "
+        + (", ".join(f"{r}={n}" for r, n in sorted(vetoes.items()))
+           or "0"))
+    lines.append(
+        f"  forecast rows: cache {rows.get('cache', 0)}, dispatch "
+        f"{rows.get('dispatch', 0)}; ticks {total('ticks')}, "
+        f"republishes {total('republishes')}")
+    return lines
+
+
 def render_frame(fabric_dir=None, trace_dir=None, now=None,
-                 twin_path=None) -> str:
+                 twin_path=None, control=False) -> str:
     """One console frame as text (the testable surface)."""
     now = time.time() if now is None else now
     lines = []
@@ -239,8 +301,9 @@ def render_frame(fabric_dir=None, trace_dir=None, now=None,
         if takeovers or duplicates:
             lines.append(f"  takeovers {takeovers}, duplicate "
                          f"completions {duplicates}")
+    trace_events = merge_trace(trace_dir) if trace_dir else []
     if trace_dir:
-        hosts = host_activity(merge_trace(trace_dir), now)
+        hosts = host_activity(trace_events, now)
         if hosts:
             lines.append(f"trace {trace_dir}: "
                          f"{len(hosts)} host shard(s)")
@@ -274,6 +337,8 @@ def render_frame(fabric_dir=None, trace_dir=None, now=None,
             lines.append(f"trace {trace_dir}: no event shards yet")
     if twin_path:
         lines.extend(twin_panel(twin_path))
+    if control:
+        lines.extend(control_panel(trace_events))
     if not lines:
         lines.append("nothing to watch (pass --fabric, --trace "
                      "and/or --twin)")
@@ -291,6 +356,11 @@ def main(argv=None) -> int:
                          "(tools/twin_gate.py TWIN_FRAMES_local"
                          ".json) — adds the per-metric divergence "
                          "panel")
+    ap.add_argument("--control", action="store_true",
+                    help="add the live-control-plane panel (last "
+                         "control_tick mark, knob epoch, headroom, "
+                         "actuation/hold/veto counters) from the "
+                         "--trace event stream")
     ap.add_argument("--follow", action="store_true",
                     help="refresh continuously (default: one "
                          "post-mortem frame)")
@@ -307,7 +377,8 @@ def main(argv=None) -> int:
     frames = 0
     while True:
         print(render_frame(args.fabric, args.trace,
-                           twin_path=args.twin))
+                           twin_path=args.twin,
+                           control=args.control))
         frames += 1
         if not args.follow or (args.max_frames
                                and frames >= args.max_frames):

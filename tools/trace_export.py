@@ -60,9 +60,13 @@ from hlsjs_p2p_wrapper_tpu.engine.twinframe import (  # noqa: E402
 
 #: thread ids within each host's process (named via thread_name
 #: metadata): spans + fault instants on DISPATCH, lease steps on
-#: LEASE; counter tracks attach to the process, not a thread
+#: LEASE, control-tick marks on CONTROL — their own Perfetto row, so
+#: a chaos window, the forecast dispatch spans, and the knob change
+#: line up visually on one timeline; counter tracks attach to the
+#: process, not a thread
 TID_DISPATCH = 1
 TID_LEASE = 2
+TID_CONTROL = 3
 
 
 def _micros(t, t0) -> float:
@@ -121,16 +125,38 @@ def export_trace(events, host_meta=None) -> dict:
                     "args": {"name": "dispatch"}})
         out.append({"ph": "M", "name": "thread_name", "pid": pid,
                     "tid": TID_LEASE, "args": {"name": "lease"}})
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": TID_CONTROL,
+                    "args": {"name": "control"}})
     # cumulative per-host counter tracks
     counts = {host: {"retries": 0, "cache_hits": 0, "cache_misses": 0,
                      "rows": 0, "twin_cdn_bytes": 0,
-                     "twin_p2p_bytes": 0} for host in hosts}
+                     "twin_p2p_bytes": 0, "actuations": 0}
+              for host in hosts}
     for event in events:
         host = event.get("host", "?")
         pid = pids[host]
         kind = event.get("kind")
         if kind == "span":
             out.append(_span_event(event, pid, t0))
+        elif kind == "mark" and event.get("name") == "control_tick":
+            # one instant per control tick on the CONTROL row, plus
+            # the cumulative actuations track stepping exactly where
+            # a knob change landed
+            out.append({
+                "ph": "i", "s": "t", "pid": pid, "tid": TID_CONTROL,
+                "name": "control_tick", "cat": "control",
+                "ts": _micros(event["t"], t0),
+                "args": {k: event.get(k) for k in
+                         ("tick", "action", "epoch", "headroom",
+                          "t_s")}})
+            if event.get("action") == "actuate":
+                counts[host]["actuations"] += 1
+            out.append({"ph": "C", "pid": pid,
+                        "name": "control actuations",
+                        "ts": _micros(event["t"], t0),
+                        "args": {"actuations":
+                                 counts[host]["actuations"]}})
         elif kind == "lease":
             out.append(_lease_instant(event, pid, t0))
         elif kind == "row":
