@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
 	coverage soak scaling-artifact warmstart-gate chaos-gate \
 	fleet-gate trace-gate tracker-gate net-chaos-gate optimize-gate \
-	twin-gate control-gate
+	twin-gate control-gate population-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -182,6 +182,23 @@ twin-gate:
 control-gate:
 	$(PY) tools/control_gate.py
 
+# Heterogeneous-population plane (round 14, engine/population.py):
+# a degenerate single-cohort population run through BOTH shipped
+# grids must reproduce the homogeneous rows bit-exactly (float.hex
+# on raw metrics — the promoted SwarmScenario fields are arithmetic
+# identities at their defaults), a two-cohort mixture swept across
+# its mix_fractions axis must stay ONE compile group (cohort
+# membership is dynamic scenario data), the same spec + seed must
+# materialize byte-identically in two separate processes
+# (population_digest), a constrained-uplink mixture's
+# offload/rebuffer frontier must sit measurably OUTSIDE its
+# homogeneous-mean equivalent's, and a flash-crowd +
+# regional-partition population must survive the real-protocol
+# plane with the partition windows firing through the shared
+# NetFaultPlan grammar.  POPULATION_GATE_PEERS etc. scale it up.
+population-gate:
+	$(PY) tools/population_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -192,6 +209,6 @@ examples:
 
 check: lint test dryrun warmstart-gate chaos-gate fleet-gate \
 	trace-gate tracker-gate net-chaos-gate optimize-gate twin-gate \
-	control-gate
+	control-gate population-gate
 
 all: check bench

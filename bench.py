@@ -927,6 +927,82 @@ def warm_start_benchmark():
     }
 
 
+def population_benchmark():
+    """The heterogeneous-population rider (engine/population.py):
+
+    - ``materialize_1m``: wall-clock of materializing a two-cohort
+      parametric spec into per-peer arrays at 1,048,576 peers (pure
+      host numpy — the cost a million-user mixture adds BEFORE any
+      dispatch), with the content digest recorded so the number is
+      tied to a reproducible artifact;
+    - ``mixture_vs_homogeneous``: warm whole-grid walls of a VOD
+      grid slice under a two-cohort mixture population vs the plain
+      homogeneous path, at a PINNED chunk shape (both engines warm
+      — pass 1 compiles, pass 2 is the measurement), with the
+      compile-group counts asserted EQUAL: the mixture must ride
+      the same one-group dispatch structure, paying only per-peer
+      array bandwidth, never a compile."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import sweep as sweep_tool
+    from hlsjs_p2p_wrapper_tpu.engine.population import (
+        Cohort, Dist, PopulationSpec, materialize, population_digest)
+
+    spec = PopulationSpec(name="bench_mixture", seed=3, cohorts=(
+        Cohort(name="broadband", fraction=0.6,
+               uplink_bps=Dist(kind="lognormal", median=5e6,
+                               sigma=0.5, lo=1e6, hi=4e7)),
+        Cohort(name="cellular", fraction=0.4,
+               uplink_bps=Dist(kind="uniform", lo=2e5, hi=9e5),
+               connectivity="cdn_only", abr_cap=1)))
+    P_1M = 1_048_576
+    start = time.perf_counter()
+    pop = materialize(spec, P_1M, n_levels=3,
+                      default_cdn_bps=8e6)
+    materialize_wall = time.perf_counter() - start
+    digest = population_digest(pop)
+
+    sizes = grid_bench_sizes()
+    grid = sweep_tool.sample_grid(sweep_tool.vod_grid(), 12)
+    common = dict(live=False, seed=0, **sizes)
+    walls, groups = {}, {}
+    chunk = None
+    for name, population in (("homogeneous", None),
+                             ("mixture", spec)):
+        for warm in (False, True):
+            start = time.perf_counter()
+            _rows, info = sweep_tool.run_grid_batched(
+                grid, chunk=chunk, population=population, **common)
+            wall = time.perf_counter() - start
+            if chunk is None:
+                chunk = info["chunk"]  # pin every later pass
+        walls[name] = wall
+        groups[name] = info["compile_groups"]
+    assert groups["mixture"] == groups["homogeneous"], \
+        (f"mixture grid compiled {groups['mixture']} groups vs "
+         f"homogeneous {groups['homogeneous']} — cohort mixtures "
+         f"must stay dynamic scenario data")
+    return {
+        "what": "two-cohort mixture population vs homogeneous path: "
+                "1M-peer spec materialization wall (host numpy) + "
+                "warm grid walls at a pinned chunk, compile groups "
+                "asserted equal (engine/population.py)",
+        "materialize_1m": {
+            "peers": P_1M, "cohorts": len(spec.cohorts),
+            "wall_s": round(materialize_wall, 3),
+            "digest": digest[:16],
+        },
+        "mixture_vs_homogeneous": {
+            "grid_points": len(grid), "chunk": chunk, **sizes,
+            "homogeneous_warm_wall_s": round(walls["homogeneous"], 3),
+            "mixture_warm_wall_s": round(walls["mixture"], 3),
+            "wall_ratio": round(walls["mixture"]
+                                / walls["homogeneous"], 3),
+            "compile_groups": groups["mixture"],
+        },
+    }
+
+
 def policy_opt_benchmark():
     """``detail.policy_opt``: evaluations-and-wall-to-target of the
     closed-loop policy search (engine/search.py, tools/optimize.py)
@@ -1628,6 +1704,11 @@ def main():
     # benchmarks fragment the heap
     control_tick = control_tick_benchmark()
 
+    # the population rider rides the same grid tier (its 1M-peer
+    # materialization is pure host numpy and frees before the
+    # device measurements; its grid walls are gate-sized)
+    population = population_benchmark()
+
     P, S, T, repeats = scenario_sizes()
     # circulant ring topology → the roll/stencil fast path (the
     # flagship formulation; see ops/swarm_sim.py neighbor_offsets)
@@ -1678,6 +1759,7 @@ def main():
     detail["sweep_grid"] = sweep_grid
     detail["policy_opt"] = policy_opt
     detail["control_tick"] = control_tick
+    detail["population"] = population
     # hoist the flight-recorder rider to the top level: it is its
     # own acceptance bar (< 3% warm-wall overhead, bit-identical
     # rows), not a property of the grid comparison it rode along

@@ -297,6 +297,17 @@ class SwarmConfig(NamedTuple):
     #:   bench.py records) — CPU is a correctness/test surface, not
     #:   the bandwidth-bound production path.
     eligibility: str = "auto"
+    #: population-plane OBSERVABILITY width (engine/population.py):
+    #: with N > 0 cohorts the ``record_every`` metrics timeline
+    #: grows 3 per-cohort columns per cohort (present peers,
+    #: interval stalls, cumulative offload — sliced by the
+    #: scenario's dynamic ``cohort_id`` labels) so triage can name
+    #: WHICH cohort stalls and which carries offload.  Static
+    #: because it sizes the timeline row; 0 (the default) compiles
+    #: the cohort columns away entirely — the pre-population
+    #: program, bit-identical.  Cohort MEMBERSHIP stays dynamic
+    #: data, so one mixture grid is still ONE compile group.
+    n_cohorts: int = 0
 
 
 class SwarmScenario(NamedTuple):
@@ -339,6 +350,30 @@ class SwarmScenario(NamedTuple):
     #: group instead of one per cushion value (``SwarmConfig.
     #: live_sync_s`` survives as the copied-in default).
     live_sync_s: jax.Array
+    # -- heterogeneous-population fields (engine/population.py): all
+    # promoted as dynamic [P] DATA on the PR 3 live_sync_s template —
+    # pure jnp arithmetic in the scheduler/eligibility path, so a
+    # cohort-mixture grid stays ONE compile group, and the defaults
+    # are arithmetic IDENTITIES (×1.0, +0.0, min(level, L-1)) so a
+    # degenerate single-cohort population is bit-identical to the
+    # homogeneous path (make population-gate pins it as float.hex).
+    #: [P] f32 0/1 connectivity-class mask: 0 = the symmetric-NAT /
+    #: enterprise-firewall class that can never establish a peer
+    #: link — gated on BOTH sides (never serves, never fetches P2P;
+    #: the foreground rides the CDN).  Default all-ones.
+    p2p_ok: jax.Array
+    #: [P] i32 device ABR-ladder cap: the highest level this peer's
+    #: device decodes (``want_level = min(abr_pick, cap)``).
+    #: Default ``n_levels - 1`` (uncapped).
+    abr_cap_level: jax.Array
+    #: [P] f32 additive per-peer offset on the scheduler's urgency
+    #: threshold (``urgent_margin_s + off``): risk-averse cohorts
+    #: rescue to the CDN earlier.  Default zeros.
+    urgent_margin_off_s: jax.Array
+    #: [P] i32 cohort label for per-cohort timeline slicing
+    #: (``SwarmConfig.n_cohorts``); pure observability — the step
+    #: never reads it.  Default zeros.
+    cohort_id: jax.Array
 
 
 def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
@@ -351,7 +386,9 @@ def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
                   uplink_efficiency=None,
                   retry_dead_ms=None,
                   holder_penalty_ms=None,
-                  live_sync_s=None) -> SwarmScenario:
+                  live_sync_s=None, p2p_ok=None, abr_cap_level=None,
+                  urgent_margin_off_s=None,
+                  cohort_id=None) -> SwarmScenario:
     """Normalize optional arrays to their defaults (everyone joins at
     t=0, never leaves, serves at the downlink cap, rank 0) and policy
     scalars to the config's values.  Also precomputes the inbound
@@ -416,7 +453,24 @@ def make_scenario(config: SwarmConfig, bitrates, neighbors, cdn_bps,
         retry_dead_ms=scalar(retry_dead_ms, config.retry_dead_ms),
         holder_penalty_ms=scalar(holder_penalty_ms,
                                  config.holder_penalty_ms),
-        live_sync_s=scalar(live_sync_s, config.live_sync_s))
+        live_sync_s=scalar(live_sync_s, config.live_sync_s),
+        # population fields (engine/population.py): defaults are the
+        # homogeneous identities — all P2P-eligible, ladder-top
+        # device cap, zero urgency offset, one anonymous cohort
+        p2p_ok=(jnp.asarray(p2p_ok, jnp.float32)
+                if p2p_ok is not None
+                else jnp.ones((P,), jnp.float32)),
+        abr_cap_level=(jnp.asarray(abr_cap_level, jnp.int32)
+                       if abr_cap_level is not None
+                       else jnp.full((P,), config.n_levels - 1,
+                                     jnp.int32)),
+        urgent_margin_off_s=(
+            jnp.asarray(urgent_margin_off_s, jnp.float32)
+            if urgent_margin_off_s is not None
+            else jnp.zeros((P,), jnp.float32)),
+        cohort_id=(jnp.asarray(cohort_id, jnp.int32)
+                   if cohort_id is not None
+                   else jnp.zeros((P,), jnp.int32)))
 
 
 class SwarmState(NamedTuple):
@@ -729,6 +783,15 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     end_s = S * seg
     t = state.t_s
     present = (t >= scenario.join_s) & (t < scenario.leave_s)  # [P]
+    # connectivity-class gate (engine/population.py): a peer whose
+    # class cannot establish peer links neither SERVES (holder side:
+    # serve_ok masks it out of every eligibility pass) nor FETCHES
+    # P2P (requester side: its eligibility rows zero below), so its
+    # foreground rides the CDN and prefetches never start.  At the
+    # all-ones default both are arithmetic identities (`& True`,
+    # `× 1.0`) — the homogeneous path, bit-for-bit.
+    p2p_req = scenario.p2p_ok                      # [P] f32 0/1
+    serve_ok = present & (scenario.p2p_ok > 0.0)   # [P] bool
     zeros = jnp.zeros((P,), jnp.float32)
     never = jnp.zeros((P,), bool)
     peer_idx32 = jnp.arange(P, dtype=jnp.uint32)
@@ -750,7 +813,10 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     # ---- 1. what does each peer need next? ---------------------------
     estimate = get_estimate(state.ewma, config.fast_half_life_s,
                             config.slow_half_life_s)
-    want_level = _abr_pick(estimate, scenario.bitrates)
+    # device ladder cap (engine/population.py): a cohort's devices
+    # top out below the ladder; the default cap is L-1 (identity)
+    want_level = jnp.minimum(_abr_pick(estimate, scenario.bitrates),
+                             scenario.abr_cap_level)
     next_seg = jnp.minimum(
         ((playhead + state.buffer_s) / seg).astype(jnp.int32), S - 1)
     timeline_left = (playhead + state.buffer_s) < end_s
@@ -785,7 +851,8 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         nbr = scenario.neighbors                             # [P, K]
         peer_idx = jnp.arange(P, dtype=nbr.dtype)
         nbr_valid = (nbr != peer_idx[:, None]).astype(jnp.float32)
-        present_nbr = present.astype(jnp.float32)[nbr]       # [P, K]
+        # holder-side connectivity gate rides the presence mask
+        present_nbr = serve_ok.astype(jnp.float32)[nbr]      # [P, K]
     n_nbr = len(offs) if circulant else nbr.shape[1]
     pen_width = (n_nbr if config.holder_selection == "adaptive" else 0)
     if state.holder_penalty_ms.shape[1] != pen_width:
@@ -812,7 +879,7 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         gi_flats.append(gi_level_c * S + gi_seg_c)
     if circulant:
         elig_slots = circulant_eligibility(
-            avail_p, present, offs, gi_flats,
+            avail_p, serve_ok, offs, gi_flats,
             impl=resolve_eligibility(config))
 
     def eligibility(c):
@@ -822,12 +889,17 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
         Wm = bit_mask_words(gi_flat, n_words)
         if circulant:
             elig, n, own = elig_slots[c]
+            # requester-side connectivity gate: a P2P-ineligible
+            # peer sees zero holders (identity ×1.0 when open)
+            elig = [e * p2p_req for e in elig]
+            n = n * p2p_req
         else:
             word_idx = gi_flat >> 5
             bitmask = jnp.uint32(1) << (gi_flat & 31).astype(jnp.uint32)
             got = avail_p[nbr, word_idx[:, None]]            # [P, K] u32
             have = (got & bitmask[:, None]) != 0
-            elig = nbr_valid * have.astype(jnp.float32) * present_nbr
+            elig = (nbr_valid * have.astype(jnp.float32)
+                    * present_nbr * p2p_req[:, None])
             n = jnp.sum(elig, axis=1)
             # local cache-hit check for absorb/prefetch (bit test)
             own = jnp.any((avail_p & Wm) != 0, axis=1)
@@ -975,7 +1047,10 @@ def swarm_step(config: SwarmConfig, scenario: SwarmScenario,
     # the CDN.  (Foreground only: prefetches are pure P2P
     # opportunism, engine/p2p_agent.py _schedule_prefetch.)
     margin_s = next_seg.astype(jnp.float32) * seg - playhead
-    urgent = margin_s < scenario.urgent_margin_s
+    # per-peer urgency offset (engine/population.py): zeros at the
+    # homogeneous default — `scalar + 0.0` is the identity
+    urgent = margin_s < (scenario.urgent_margin_s
+                         + scenario.urgent_margin_off_s)
     budget_ms = jnp.clip(margin_s * 1000.0 * scenario.p2p_budget_fraction,
                          scenario.p2p_budget_floor_ms,
                          scenario.p2p_budget_cap_ms)
@@ -1443,10 +1518,19 @@ def timeline_columns(config: SwarmConfig) -> Tuple[str, ...]:
     """Column names of one metrics-timeline row (the ``[M]`` axis of
     the ``record_every`` output): sample clock, the cumulative
     north-star pair, interval byte rates, the interval stall count,
-    and per-bitrate-level present-peer counts."""
-    return (("t_s", "offload", "rebuffer", "cdn_rate_bps",
+    per-bitrate-level present-peer counts — and, with
+    ``config.n_cohorts > 0``, three per-cohort slices (present
+    peers, interval stalls, cumulative offload) keyed by the
+    scenario's dynamic ``cohort_id`` labels, so triage can attribute
+    a pathology to the cohort that carries it
+    (tools/triage_timelines.py)."""
+    base = (("t_s", "offload", "rebuffer", "cdn_rate_bps",
              "p2p_rate_bps", "stalled_peers")
             + tuple(f"level_{i}_peers" for i in range(config.n_levels)))
+    for k in range(config.n_cohorts):
+        base += (f"cohort_{k}_peers", f"cohort_{k}_stalled",
+                 f"cohort_{k}_offload")
+    return base
 
 
 def _timeline_row(config: SwarmConfig, scenario: SwarmScenario,
@@ -1483,7 +1567,25 @@ def _timeline_row(config: SwarmConfig, scenario: SwarmScenario,
         .astype(jnp.float32), axis=0)
     head = jnp.stack([t, offload, rebuffer, cdn_rate, p2p_rate,
                       stalled])
-    return jnp.concatenate([head, level_counts])
+    if not config.n_cohorts:
+        return jnp.concatenate([head, level_counts])
+    # per-cohort slices (engine/population.py): membership is
+    # dynamic scenario data, so slicing is pure jnp masking — the
+    # mixture grid stays one compile group; n_cohorts=0 (the
+    # default) compiles this block away entirely
+    cohort_cols = []
+    for k in range(config.n_cohorts):
+        mask = scenario.cohort_id == k
+        cohort_cols.append(jnp.sum(
+            (present & mask).astype(jnp.float32)))
+        cohort_cols.append(jnp.sum(
+            ((state.rebuffer_s > prev_rebuffer) & mask)
+            .astype(jnp.float32)))
+        p2p_k = jnp.sum(jnp.where(mask, state.p2p_bytes, 0.0))
+        tot_k = p2p_k + jnp.sum(jnp.where(mask, state.cdn_bytes, 0.0))
+        cohort_cols.append(p2p_k / jnp.maximum(tot_k, 1.0))
+    return jnp.concatenate([head, level_counts,
+                            jnp.stack(cohort_cols)])
 
 
 def _scan_swarm(config: SwarmConfig, scenario: SwarmScenario,
@@ -1738,14 +1840,15 @@ def batch_lane_bytes(config: SwarmConfig, n_steps: int, *,
             * np.dtype(jnp.result_type(leaf)).itemsize
             for leaf in jax.tree_util.tree_leaves(scenario))
     else:
-        # per-peer scenario reads: cdn/uplink/join/leave/edge_rank f32
-        scenario_bytes = 5 * 4 * P
+        # per-peer scenario arrays: cdn/uplink/join/leave/edge_rank
+        # f32 + the four population fields (engine/population.py)
+        scenario_bytes = 9 * 4 * P
         if config.neighbor_offsets is None and n_neighbors:
             scenario_bytes += 2 * 4 * P * n_neighbors  # nbrs+in_edges
     out_bytes = 4 * n_steps  # per-lane offload-over-time series
     if record_every:
         out_bytes += 4 * (n_steps // record_every) * (
-            6 + config.n_levels)
+            6 + config.n_levels + 3 * config.n_cohorts)
     return 2 * state_bytes + scenario_bytes + out_bytes
 
 
@@ -2408,6 +2511,8 @@ def run_swarm(config: SwarmConfig, bitrates: jax.Array,
               announce_delay_s=None, p2p_setup_ms=None,
               uplink_efficiency=None, retry_dead_ms=None,
               holder_penalty_ms=None, live_sync_s=None,
+              p2p_ok=None, abr_cap_level=None,
+              urgent_margin_off_s=None, cohort_id=None,
               record_every: int = 0,
               ) -> Tuple[SwarmState, jax.Array]:
     """Scan ``n_steps`` ticks; returns (final state, offload-over-time
@@ -2428,7 +2533,9 @@ def run_swarm(config: SwarmConfig, bitrates: jax.Array,
         request_timeout_ms=request_timeout_ms,
         announce_delay_s=announce_delay_s, p2p_setup_ms=p2p_setup_ms,
         uplink_efficiency=uplink_efficiency, retry_dead_ms=retry_dead_ms,
-        holder_penalty_ms=holder_penalty_ms, live_sync_s=live_sync_s)
+        holder_penalty_ms=holder_penalty_ms, live_sync_s=live_sync_s,
+        p2p_ok=p2p_ok, abr_cap_level=abr_cap_level,
+        urgent_margin_off_s=urgent_margin_off_s, cohort_id=cohort_id)
     state = ensure_penalty_width(config, scenario, state)
     return _run_swarm(config, scenario, state, n_steps,
                       record_every=record_every)
@@ -2527,7 +2634,9 @@ def step_hbm_breakdown(config: SwarmConfig,
       word — are counted automatically at their true dtype widths
       instead of drifting from a hand-kept census);
     - ``scenario_reads`` — the per-peer scenario arrays the step
-      consumes (cdn/uplink/join/leave/edge_rank f32);
+      consumes (cdn/uplink/join/leave/edge_rank f32 plus the
+      population fields: p2p_ok/urgent_margin_off_s f32,
+      abr_cap_level i32);
     - ``eligibility`` — the formulation-dependent dominant term
       (``"auto"`` resolved per backend, :func:`resolve_eligibility`,
       so the model prices the program that actually runs).
@@ -2562,7 +2671,7 @@ def step_hbm_breakdown(config: SwarmConfig,
     carry_rw = 2.0 * sum(
         float(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
         for leaf in jax.tree_util.tree_leaves(state))
-    scenario_reads = 5.0 * 4.0 * P
+    scenario_reads = 8.0 * 4.0 * P
     if circulant:
         if resolve_eligibility(config) == "kpass":
             elig = 2.0 * 4.0 * P * W * K * C  # K·C × (AP + bit mask)

@@ -191,7 +191,11 @@ def scenario_shardings(mesh: Mesh) -> SwarmScenario:
         live_spread_s=rep, request_timeout_ms=rep,
         announce_delay_s=rep, p2p_setup_ms=rep,
         uplink_efficiency=rep, retry_dead_ms=rep,
-        holder_penalty_ms=rep, live_sync_s=rep)
+        holder_penalty_ms=rep, live_sync_s=rep,
+        # population fields (engine/population.py): per-peer
+        # vectors, sharded like every other [P] attribute
+        p2p_ok=peer_vec, abr_cap_level=peer_vec,
+        urgent_margin_off_s=peer_vec, cohort_id=peer_vec)
 
 
 def shard_swarm(mesh: Mesh, scenario: SwarmScenario, state: SwarmState):

@@ -96,6 +96,48 @@ class ChurnSpec:
     seed: int = 0
 
 
+def spec_from_population(population, *, n_swarms: int = 32,
+                         target_leases: int = 1_024,
+                         duration_ms: float = 30_000.0,
+                         **overrides) -> ChurnSpec:
+    """Derive the tracker-plane churn workload from the SAME
+    population spec the delivery planes consume
+    (engine/population.py ``PopulationSpec``): the steady-state mean
+    session length is the fraction-weighted mix of the cohorts'
+    session processes (cohorts that watch to the end contribute the
+    spec default), every wave-arrival cohort becomes a
+    :class:`FlashCrowd` piling its share of the lease target into
+    one swarm inside the churn window, and the population's seed
+    seeds the op stream — so tracker churn and sweep/twin runs
+    exercise ONE audience, not three unrelated ones.  ``overrides``
+    pass through to :class:`ChurnSpec` (quota/hostile knobs etc.)."""
+    default_session_ms = float(ChurnSpec.mean_session_ms)
+    total = sum(c.fraction for c in population.cohorts)
+    mean_session_ms = sum(
+        (c.session_mean_s * 1000.0 if c.session_mean_s is not None
+         else default_session_ms) * (c.fraction / total)
+        for c in population.cohorts)
+    crowds = []
+    for c in population.cohorts:
+        if c.arrival.kind != "wave":
+            continue
+        # map the wave into the churn window: its share of the lease
+        # target lands together, proportionally timed
+        at_ms = min(c.arrival.at_s * 1000.0, duration_ms * 0.5)
+        crowds.append(FlashCrowd(
+            t_ms=at_ms, swarm=0,
+            peers=max(1, int(round(target_leases
+                                   * c.fraction / total))),
+            window_ms=max(c.arrival.window_s * 1000.0, 1.0),
+            session_ms=(c.session_mean_s * 1000.0
+                        if c.session_mean_s is not None else 5_000.0)))
+    return ChurnSpec(n_swarms=n_swarms, target_leases=target_leases,
+                     duration_ms=duration_ms,
+                     mean_session_ms=mean_session_ms,
+                     flash_crowds=tuple(crowds),
+                     seed=population.seed, **overrides)
+
+
 def swarm_name(i: int) -> str:
     return f"swarm-{i:05d}"
 

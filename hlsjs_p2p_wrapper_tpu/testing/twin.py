@@ -36,6 +36,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+from ..engine.population import (PopulationSpec, fault_specs_from,
+                                 materialize)
 from ..engine.twinframe import (FrameBuilder, ObservationFrame,
                                 TWIN_WINDOW_MARK, frames_from_events,
                                 frames_from_timelines)
@@ -49,6 +51,10 @@ SIM_CONCURRENCY = 3
 #: observed yet: far past any horizon (zero watch time, zero demand)
 #: while staying well under the kernel's NEVER_S leave sentinel
 ABSENT_JOIN_S = 1e9
+
+#: materialized-population memo (TwinScenario._population): specs
+#: are frozen, so identity + lane count key the deterministic result
+_POP_MEMO: dict = {}
 
 
 def effective_cdn_bps(scenario: "TwinScenario") -> float:
@@ -109,16 +115,72 @@ class TwinScenario:
     #: (``loss@40-70,latency@90-110``); None = clean wire
     fault_specs: Optional[str] = None
     fault_kwargs: dict = field(default_factory=dict)
+    #: heterogeneous population (engine/population.py): when set,
+    #: the SAME materialized spec drives BOTH planes' join schedules
+    #: and per-peer uplinks, and its regional-partition windows land
+    #: as real-plane ``partition@T0-T1`` fault specs (the shared
+    #: NetFaultPlan grammar) unless ``fault_specs`` overrides them.
+    #: Connectivity classes and device ladder caps stay jnp-kernel
+    #: features for now — the real-plane harness has no CDN-only
+    #: transport mode yet (ROADMAP residue) — so a twin population
+    #: should keep every cohort "open"/uncapped.
+    population: Optional[PopulationSpec] = None
+
+    def _population(self):
+        """Materialized population arrays — memoized on the FULL
+        materialization inputs (the spec is a frozen, hashable
+        dataclass), so two scenarios sharing a spec but differing
+        in lane count, ladder, or inherit defaults never alias."""
+        key = (self.population, self.total_peers,
+               len(self.level_bitrates), self.uplink_bps,
+               self.cdn_bps)
+        cached = _POP_MEMO.get(key)
+        if cached is None:
+            cached = _POP_MEMO[key] = materialize(
+                self.population, self.total_peers,
+                n_levels=len(self.level_bitrates),
+                default_uplink_bps=self.uplink_bps,
+                default_cdn_bps=self.cdn_bps)
+        return cached
 
     def join_times_s(self, wave_shift_s: float = 0.0) -> List[float]:
         """Every peer's join clock (seconds): the staggered base
-        audience then the wave cohort.  ``wave_shift_s`` displaces
-        the wave only — the twin gate's injected sim-fidelity bug
-        (a scenario-mapping error, localized in time)."""
+        audience then the wave cohort — or, with a ``population``,
+        the spec's materialized arrival processes.  ``wave_shift_s``
+        displaces the wave (the population's wave-arrival cohorts)
+        only — the twin gate's injected sim-fidelity bug (a
+        scenario-mapping error, localized in time)."""
+        if self.population is not None and not \
+                self.population.inherits_joins:
+            pop = self._population()
+            wave = {k for k, c in enumerate(self.population.cohorts)
+                    if c.arrival.kind == "wave"}
+            return [float(t) + (wave_shift_s if int(k) in wave
+                                else 0.0)
+                    for t, k in zip(pop.join_s, pop.cohort_id)]
         base = [self.join_offset_s + i * self.join_spacing_s
                 for i in range(self.n_peers)]
         wave = [self.wave_at_s + wave_shift_s] * self.wave_peers
         return base + wave
+
+    def uplinks_bps(self) -> List[float]:
+        """Per-peer uplink rates: the population's materialized
+        mixture, or the homogeneous default."""
+        if (self.population is not None
+                and self._population().uplink_bps is not None):
+            return [float(u)
+                    for u in self._population().uplink_bps]
+        return [float(self.uplink_bps)] * self.total_peers
+
+    def effective_fault_specs(self) -> Optional[str]:
+        """Real-plane chaos: explicit ``fault_specs`` first, else
+        the population's regional-partition windows rendered in the
+        shared grammar (engine/population.py ``fault_specs_from``)."""
+        if self.fault_specs is not None:
+            return self.fault_specs
+        if self.population is not None:
+            return fault_specs_from(self.population)
+        return None
 
     @property
     def total_peers(self) -> int:
@@ -183,13 +245,17 @@ class TwinSampler:
 class TwinRunResult:
     """One real-plane run's outputs: the registry-derived frame, the
     event-reconstructed frame (None without a recorder), the shard
-    path, and the harness's final north-star pair."""
+    path, the harness's final north-star pair, and the injected
+    transport-fault counts by kind (``mesh.transport_faults`` — the
+    population gate's proof that a spec's partition windows actually
+    FIRED on the wire)."""
 
     registry_frames: ObservationFrame
     event_frames: Optional[ObservationFrame]
     shard_path: Optional[str]
     offload: float
     rebuffer: float
+    transport_faults: dict = field(default_factory=dict)
 
 
 def run_real_plane(scenario: TwinScenario,
@@ -204,16 +270,17 @@ def run_real_plane(scenario: TwinScenario,
     reconstruction (``event_frames`` stays None, the shard stays on
     disk): the overhead bench times the run with ONLY the recorder
     armed, so extraction cost cannot masquerade as arming cost."""
+    fault_specs = scenario.effective_fault_specs()
     harness = SwarmHarness(
         seg_duration=scenario.seg_duration_s,
         frag_count=scenario.frag_count,
         level_bitrates=tuple(int(b) for b in scenario.level_bitrates),
         cdn_bandwidth_bps=scenario.cdn_bps,
         cdn_latency_ms=scenario.cdn_latency_ms, seed=scenario.seed,
-        fault_plan_specs=scenario.fault_specs,
+        fault_plan_specs=fault_specs,
         fault_plan_kwargs=({"seed": scenario.seed,
                             **scenario.fault_kwargs}
-                           if scenario.fault_specs else None))
+                           if fault_specs else None))
     recorder = None
     shard_path = None
     if trace_dir is not None:
@@ -237,9 +304,10 @@ def run_real_plane(scenario: TwinScenario,
     # below would silently displace it — peer ids keep the list index
     # so p{i} still maps to the sim plane's joins[i]
     joins = scenario.join_times_s()
+    uplinks = scenario.uplinks_bps()
     for i in sorted(range(len(joins)), key=lambda i: (joins[i], i)):
         harness.run(max(joins[i] * 1000.0 - harness.clock.now(), 0.0))
-        harness.add_peer(f"p{i}", uplink_bps=scenario.uplink_bps)
+        harness.add_peer(f"p{i}", uplink_bps=uplinks[i])
     harness.run(scenario.watch_s * 1000.0 - harness.clock.now())
     event_frames = None
     if recorder is not None:
@@ -252,7 +320,11 @@ def run_real_plane(scenario: TwinScenario,
                          event_frames=event_frames,
                          shard_path=shard_path,
                          offload=harness.offload_ratio,
-                         rebuffer=harness.rebuffer_ratio)
+                         rebuffer=harness.rebuffer_ratio,
+                         transport_faults={
+                             labels.get("kind", "?"): value
+                             for labels, value in harness.metrics
+                             .series("mesh.transport_faults")})
 
 
 def parity_sim_config(scenario: TwinScenario,
@@ -303,8 +375,7 @@ def run_sim_plane(scenario: TwinScenario,
         jnp.full((P,), effective_cdn_bps(scenario), jnp.float32),
         init_swarm(config), n_steps,
         jnp.asarray(joins, jnp.float32),
-        uplink_bps=jnp.full((P,), float(scenario.uplink_bps),
-                            jnp.float32),
+        uplink_bps=jnp.asarray(scenario.uplinks_bps(), jnp.float32),
         record_every=record_every)
     import numpy as np
     return frames_from_timelines(

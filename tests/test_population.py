@@ -1,0 +1,477 @@
+"""engine/population.py: the heterogeneous-population scenario plane.
+
+Property tier: every distribution honors its DECLARED bounds across
+seeds, connectivity classes and device caps land on exactly the
+cohort's members, cohort apportionment is exact and interleaved, and
+materialization is deterministic (digest-equal) per seed.  The
+in-process integration tier pins the plane's two load-bearing
+contracts: a DEGENERATE single-cohort population is bit-identical
+(float.hex) to the homogeneous path on sampled points of BOTH
+shipped grids (the process-level full-grid proof is ``make
+population-gate``), and the promoted ``SwarmScenario`` fields
+actually gate the kernel (a CDN-only cohort moves zero P2P bytes, a
+capped cohort never exceeds its ladder cap).  The twin/churn
+adapters are held to the same one-spec contract.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+from hlsjs_p2p_wrapper_tpu.engine.population import (  # noqa: E402
+    CONNECTIVITY_CLASSES, Arrival, Cohort, Dist, NEVER_S, Population,
+    PopulationSpec, cohort_counts, fault_specs_from, interleave_cohorts,
+    load_spec, materialize, materialize_trace, population_digest,
+    to_scenario_kwargs)
+
+EXAMPLE_SPEC = os.path.join(_REPO, "examples",
+                            "population_cellular_broadband.json")
+
+SEEDS = (0, 1, 7, 42, 1234)
+
+
+def two_cohort_spec(seed=0, **cellular_kw):
+    return PopulationSpec(name="t", seed=seed, cohorts=(
+        Cohort(name="broadband", fraction=0.6,
+               uplink_bps=Dist(kind="lognormal", median=5e6,
+                               sigma=0.5, lo=1e6, hi=4e7),
+               arrival=Arrival(kind="staggered", window_s=30.0)),
+        Cohort(name="cellular", fraction=0.4,
+               uplink_bps=Dist(kind="uniform", lo=2e5, hi=9e5),
+               connectivity="cdn_only", abr_cap=1,
+               urgent_margin_off_s=2.0,
+               arrival=Arrival(kind="wave", at_s=33.0, window_s=1.0),
+               session_mean_s=120.0, **cellular_kw)))
+
+
+# -- distribution / spec property tier ----------------------------------
+
+@pytest.mark.parametrize("dist", [
+    Dist(kind="const", value=3.5),
+    Dist(kind="uniform", lo=2e5, hi=9e5),
+    Dist(kind="lognormal", median=5e6, sigma=0.8, lo=1e6, hi=4e7),
+    Dist(kind="choice", values=(1.0, 2.0, 8.0), weights=(1, 1, 2)),
+])
+def test_every_distribution_honors_declared_bounds(dist):
+    lo, hi = dist.bounds()
+    for seed in SEEDS:
+        rng = np.random.default_rng([seed, 0])
+        samples = dist.sample(rng, 512)
+        assert samples.shape == (512,)
+        assert float(samples.min()) >= lo
+        assert float(samples.max()) <= hi
+
+
+@pytest.mark.parametrize("arrival", [
+    Arrival(kind="steady", at_s=5.0),
+    Arrival(kind="staggered", at_s=2.0, window_s=30.0),
+    Arrival(kind="wave", at_s=33.0, window_s=1.0),
+    Arrival(kind="diurnal", at_s=0.0, window_s=120.0,
+            period_s=240.0, amplitude=0.8),
+])
+def test_every_arrival_lands_inside_its_window(arrival):
+    for seed in SEEDS:
+        rng = np.random.default_rng([seed, 1])
+        joins = arrival.sample(rng, 256)
+        assert float(joins.min()) >= arrival.at_s
+        assert float(joins.max()) <= arrival.at_s + arrival.window_s
+
+
+def test_diurnal_intensity_shapes_the_arrivals():
+    # peak at window/4 (sin max), trough at 3·window/4: the first
+    # half must hold well over half the audience at amplitude 0.8
+    arr = Arrival(kind="diurnal", window_s=100.0, period_s=100.0,
+                  amplitude=0.8)
+    joins = arr.sample(np.random.default_rng([0, 2]), 4096)
+    first_half = float(np.mean(joins < 50.0))
+    assert first_half > 0.6
+
+
+def test_population_classes_and_caps_land_on_the_right_cohort():
+    for seed in SEEDS:
+        spec = two_cohort_spec(seed=seed)
+        pop = materialize(spec, 200, n_levels=3,
+                          default_cdn_bps=8e6)
+        cell = pop.cohort_id == spec.cohort_names.index("cellular")
+        assert set(np.unique(pop.p2p_ok[cell])) == {0.0}
+        assert set(np.unique(pop.p2p_ok[~cell])) == {1.0}
+        assert set(np.unique(pop.abr_cap_level[cell])) == {1}
+        assert set(np.unique(pop.abr_cap_level[~cell])) == {2}
+        assert np.all(pop.urgent_margin_off_s[cell] == 2.0)
+        assert np.all(pop.urgent_margin_off_s[~cell] == 0.0)
+        # rate bounds per cohort, every seed
+        assert pop.uplink_bps[cell].min() >= 2e5
+        assert pop.uplink_bps[cell].max() <= 9e5
+        assert pop.uplink_bps[~cell].min() >= 1e6
+        # sessions: leave strictly after join, floored
+        assert np.all(pop.leave_s[cell]
+                      >= pop.join_s[cell] + 1.0)
+        assert np.all(pop.leave_s[~cell] == NEVER_S)
+
+
+def test_cohort_counts_exact_largest_remainder():
+    assert cohort_counts([0.6, 0.4], 101) == [61, 40]
+    assert cohort_counts([1.0, 1.0, 1.0], 10) == [4, 3, 3]
+    assert sum(cohort_counts([0.21, 0.33, 0.46], 997)) == 997
+
+
+def test_interleave_keeps_every_prefix_mixed():
+    ids = interleave_cohorts([60, 40])
+    assert len(ids) == 100
+    assert np.bincount(ids).tolist() == [60, 40]
+    # proportional interleave: every prefix's cohort share stays
+    # within one member of the target fraction
+    for m in range(1, 101):
+        c1 = int(np.sum(ids[:m] == 1))
+        assert abs(c1 - 0.4 * m) <= 1.0, (m, c1)
+
+
+def test_materialization_is_deterministic_per_seed():
+    spec = two_cohort_spec(seed=7)
+    a = materialize(spec, 333, n_levels=3, default_cdn_bps=8e6)
+    b = materialize(spec, 333, n_levels=3, default_cdn_bps=8e6)
+    assert population_digest(a) == population_digest(b)
+    c = materialize(two_cohort_spec(seed=8), 333, n_levels=3,
+                    default_cdn_bps=8e6)
+    assert population_digest(a) != population_digest(c)
+
+
+def test_other_cohorts_are_invariant_to_a_mix_reweight():
+    # the per-cohort RNG stream contract: re-weighting the mixture
+    # only changes HOW MANY lanes each cohort owns, and every
+    # cohort's first n draws stay identical
+    spec = PopulationSpec(
+        name="t", seed=3,
+        cohorts=two_cohort_spec().cohorts,
+        mix_cohort="cellular", mix_fractions=(0.2, 0.4))
+    a = materialize(spec.with_mix(0.2), 100, n_levels=3)
+    b = materialize(spec.with_mix(0.4), 100, n_levels=3)
+    for pop_a, pop_b in ((a, b),):
+        for k in (0, 1):
+            ua = pop_a.uplink_bps[pop_a.cohort_id == k]
+            ub = pop_b.uplink_bps[pop_b.cohort_id == k]
+            n = min(len(ua), len(ub))
+            assert np.array_equal(ua[:n], ub[:n])
+
+
+def test_with_mix_renormalizes_and_validates():
+    spec = PopulationSpec(
+        name="t", seed=0, cohorts=(
+            Cohort(name="a", fraction=0.5),
+            Cohort(name="b", fraction=0.3),
+            Cohort(name="c", fraction=0.2)),
+        mix_cohort="a", mix_fractions=(0.0, 1.0))
+    mixed = spec.with_mix(0.4)
+    fracs = {c.name: c.fraction for c in mixed.cohorts}
+    assert fracs["a"] == pytest.approx(0.4)
+    assert fracs["b"] == pytest.approx(0.36)
+    assert fracs["c"] == pytest.approx(0.24)
+    with pytest.raises(ValueError):
+        spec.with_mix(1.5)
+    with pytest.raises(ValueError):
+        PopulationSpec(name="t", cohorts=spec.cohorts,
+                       mix_cohort="nope")
+
+
+def test_spec_validation_rejects_inconsistent_shapes():
+    with pytest.raises(ValueError):
+        PopulationSpec(name="t", cohorts=())
+    with pytest.raises(ValueError):
+        PopulationSpec(name="t", cohorts=(
+            Cohort(name="a", fraction=0.5),
+            Cohort(name="a", fraction=0.5)))
+    with pytest.raises(ValueError):
+        Cohort(name="x", fraction=0.5, connectivity="carrier-nat")
+    with pytest.raises(ValueError):
+        # half-inherited arrivals would misalign the rebuffer
+        # denominator between cohorts
+        PopulationSpec(name="t", cohorts=(
+            Cohort(name="a", fraction=0.5),
+            Cohort(name="b", fraction=0.5,
+                   arrival=Arrival(kind="wave", at_s=10.0))))
+    with pytest.raises(ValueError):
+        # sessions need materialized joins
+        materialize(PopulationSpec(name="t", cohorts=(
+            Cohort(name="a", fraction=1.0, session_mean_s=60.0),)),
+            10, n_levels=1)
+    with pytest.raises(ValueError):
+        PopulationSpec(name="t", cohorts=(
+            Cohort(name="a", fraction=1.0),),
+            partitions=((10.0, 5.0),))
+
+
+def test_spec_json_round_trip_and_example_file():
+    spec = two_cohort_spec(seed=9)
+    assert PopulationSpec.from_json(spec.to_json()) == spec
+    example = load_spec(EXAMPLE_SPEC)
+    assert example.mix_cohort == "cellular"
+    assert example.partitions
+    assert PopulationSpec.from_json(
+        json.loads(json.dumps(example.to_json()))) == example
+
+
+def test_degenerate_population_emits_identity_arrays_only():
+    spec = PopulationSpec(name="d", cohorts=(
+        Cohort(name="all", fraction=1.0),))
+    pop = materialize(spec, 50, n_levels=3, default_uplink_bps=1e6,
+                      default_cdn_bps=2e6)
+    kwargs = to_scenario_kwargs(pop)
+    # every inherited array is OMITTED — the homogeneous call shape
+    assert set(kwargs) == {"cohort_id", "p2p_ok", "abr_cap_level",
+                           "urgent_margin_off_s"}
+    assert np.all(kwargs["p2p_ok"] == 1.0)
+    assert np.all(kwargs["abr_cap_level"] == 2)
+    assert np.all(kwargs["urgent_margin_off_s"] == 0.0)
+    assert np.all(kwargs["cohort_id"] == 0)
+
+
+def test_trace_materialization_round_trips_an_event_log():
+    records = [
+        {"peer": "a", "join_s": 1.0, "uplink_bps": 2e6,
+         "cohort": "broadband"},
+        {"peer": "b", "join_s": 2.5, "cohort": "cellular",
+         "connectivity": "cdn_only", "abr_cap": 1},
+        {"peer": "a", "leave_s": 40.0},   # later record: departure
+    ]
+    pop = materialize_trace(records, n_levels=3,
+                            default_uplink_bps=1e6)
+    assert pop.cohort_names == ("broadband", "cellular")
+    assert pop.join_s.tolist() == [1.0, 2.5]
+    # the arrays are f32 (the kernel's dtype): NEVER_S rounds
+    assert pop.leave_s.tolist() == [40.0, float(np.float32(NEVER_S))]
+    assert pop.p2p_ok.tolist() == [1.0, 0.0]
+    # a peer missing a key OTHER peers carry gets the default fill
+    assert pop.uplink_bps.tolist() == [2e6, 1e6]
+    # a key the WHOLE trace omits inherits (None), never zero-fills
+    assert pop.cdn_bps is None
+    # missing abr_cap = the ladder TOP, never a silent level-0 pin
+    assert pop.abr_cap_level.tolist() == [2, 1]
+    with pytest.raises(ValueError):
+        materialize_trace([])
+
+
+def test_fault_specs_render_the_shared_grammar():
+    from hlsjs_p2p_wrapper_tpu.engine.netfaults import NetFaultPlan
+    spec = PopulationSpec(name="p", cohorts=(
+        Cohort(name="a", fraction=1.0),),
+        partitions=((30.0, 55.5), (90.0, 110.0)))
+    text = fault_specs_from(spec)
+    assert text == "partition@30-55.5,partition@90-110"
+    plan = NetFaultPlan.parse(text, seed=0)
+    assert plan is not None
+    assert fault_specs_from(PopulationSpec(
+        name="q", cohorts=(Cohort(name="a", fraction=1.0),))) is None
+
+
+def test_registry_counters_note_materializations():
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+    registry = MetricsRegistry()
+    materialize(two_cohort_spec(), 100, n_levels=3,
+                registry=registry)
+    materialize_trace([{"peer": "a", "join_s": 0.0}],
+                      registry=registry)
+    counts = {labels["source"]: value for labels, value in
+              registry.series("population.materializations")}
+    assert counts == {"parametric": 1.0, "trace": 1.0}
+    gauges = {labels["cohort"]: value for labels, value in
+              registry.series("population.cohort_peers")}
+    assert gauges["broadband"] == 60.0
+    assert gauges["cellular"] == 40.0
+
+
+# -- kernel integration tier --------------------------------------------
+
+def _tiny_sizes():
+    return dict(peers=32, segments=8, watch_s=6.0, seed=0, chunk=4)
+
+
+def test_degenerate_population_bit_identical_on_both_shipped_grids():
+    """Sampled points of BOTH shipped grids: the degenerate
+    single-cohort population's raw rows must equal the homogeneous
+    path's float.hex — the full-grid, process-level version lives in
+    ``make population-gate``."""
+    import sweep as sweep_tool
+    spec = PopulationSpec(name="degenerate", cohorts=(
+        Cohort(name="all", fraction=1.0),))
+    for live in (False, True):
+        grid = sweep_tool.sample_grid(
+            sweep_tool.live_grid() if live else sweep_tool.vod_grid(),
+            4)
+        plain, _ = sweep_tool.run_grid_batched(
+            grid, live=live, raw=True, **_tiny_sizes())
+        pop, info = sweep_tool.run_grid_batched(
+            grid, live=live, raw=True, population=spec,
+            **_tiny_sizes())
+        assert [(r["offload"].hex(), r["rebuffer"].hex())
+                for r in plain] == \
+               [(r["offload"].hex(), r["rebuffer"].hex())
+                for r in pop], f"live={live}"
+        assert info["compile_groups"] == 1
+
+
+def test_mixture_grid_is_one_compile_group_with_cohort_columns():
+    import sweep as sweep_tool
+    spec = load_spec(EXAMPLE_SPEC)
+    grid = sweep_tool.population_grid(
+        sweep_tool.sample_grid(sweep_tool.vod_grid(), 2), spec)
+    assert len(grid) == 2 * len(spec.mix_fractions)
+    assert {k["population_mix"] for k in grid} \
+        == set(spec.mix_fractions)
+    rows, info = sweep_tool.run_grid_batched(
+        grid, live=False, raw=True, record_every=4,
+        population=spec, **_tiny_sizes())
+    assert info["compile_groups"] == 1
+    # per-cohort columns ride the timeline
+    config = sweep_tool.build_config(32, 8, False, 8,
+                                     n_cohorts=len(spec.cohorts))
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import timeline_columns
+    columns = timeline_columns(config)
+    assert "cohort_0_offload" in columns
+    assert "cohort_1_stalled" in columns
+    tl = rows[0]["_timeline"]
+    assert tl.shape[-1] == len(columns)
+
+
+def test_cdn_only_cohort_moves_zero_p2p_bytes():
+    import jax.numpy as jnp
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (
+        SwarmConfig, init_swarm, ring_offsets, run_swarm,
+        staggered_joins)
+    P = 64
+    config = SwarmConfig(n_peers=P, n_segments=16, n_levels=3,
+                         neighbor_offsets=ring_offsets(8))
+    bitrates = jnp.array([300e3, 800e3, 2000e3])
+    mask = (np.arange(P) % 2 == 0).astype(np.float32)
+    final, _ = run_swarm(
+        config, bitrates, None, jnp.full((P,), 2.4e6),
+        init_swarm(config), 260, staggered_joins(P, 30.0),
+        uplink_bps=jnp.full((P,), 2.4e6), p2p_ok=mask)
+    p2p = np.asarray(final.p2p_bytes)
+    assert p2p[mask == 0].sum() == 0.0
+    assert p2p[mask == 1].sum() > 0.0
+
+
+def test_abr_cap_binds_per_peer():
+    import jax.numpy as jnp
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (
+        SwarmConfig, init_swarm, ring_offsets, run_swarm)
+    P = 32
+    config = SwarmConfig(n_peers=P, n_segments=16, n_levels=3,
+                         neighbor_offsets=ring_offsets(8))
+    cap = np.where(np.arange(P) % 2 == 0, 0, 2).astype(np.int32)
+    final, _ = run_swarm(
+        config, jnp.array([300e3, 800e3, 2000e3]), None,
+        jnp.full((P,), 8e6), init_swarm(config), 240,
+        uplink_bps=jnp.full((P,), 10e6), abr_cap_level=cap)
+    level = np.asarray(final.level)
+    assert level[cap == 0].max() == 0
+    assert level[cap == 2].max() == 2
+
+
+def test_cohort_timeline_slices_sum_to_the_audience():
+    import jax.numpy as jnp
+    from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (
+        SwarmConfig, init_swarm, ring_offsets, run_swarm,
+        timeline_columns)
+    P = 24
+    config = SwarmConfig(n_peers=P, n_segments=8, n_levels=2,
+                         neighbor_offsets=ring_offsets(4),
+                         n_cohorts=2)
+    cohort_id = (np.arange(P) % 2).astype(np.int32)
+    _final, _series, tl = run_swarm(
+        config, jnp.array([300e3, 800e3]), None,
+        jnp.full((P,), 4e6), init_swarm(config), 40,
+        cohort_id=cohort_id, record_every=8)
+    columns = timeline_columns(config)
+    tl = np.asarray(tl)
+    assert tl.shape[-1] == len(columns)
+    level_cols = [i for i, c in enumerate(columns)
+                  if c.startswith("level_")]
+    c0 = columns.index("cohort_0_peers")
+    c1 = columns.index("cohort_1_peers")
+    for row in tl:
+        assert row[c0] + row[c1] == pytest.approx(
+            sum(row[i] for i in level_cols))
+
+
+# -- one-spec adapters (twin / churn) -----------------------------------
+
+def test_twin_scenario_consumes_the_population():
+    from hlsjs_p2p_wrapper_tpu.testing.twin import TwinScenario
+    spec = PopulationSpec(
+        name="twin", seed=11,
+        cohorts=(
+            Cohort(name="base", fraction=0.6,
+                   arrival=Arrival(kind="staggered", at_s=0.5,
+                                   window_s=20.0),
+                   uplink_bps=Dist(value=2.4e6)),
+            Cohort(name="crowd", fraction=0.4,
+                   arrival=Arrival(kind="wave", at_s=33.0),
+                   uplink_bps=Dist(value=1.2e6))),
+        partitions=((40.0, 52.0),))
+    scenario = TwinScenario(n_peers=8, wave_peers=4, watch_s=64.0,
+                            window_s=8.0, population=spec)
+    joins = scenario.join_times_s()
+    uplinks = scenario.uplinks_bps()
+    assert len(joins) == len(uplinks) == scenario.total_peers
+    pop = scenario._population()
+    crowd = pop.cohort_id == 1
+    assert all(j == 33.0 for j, c in zip(joins, crowd) if c)
+    assert all(u == 1.2e6 for u, c in zip(uplinks, crowd) if c)
+    assert all(u == 2.4e6 for u, c in zip(uplinks, crowd) if not c)
+    # the injected-bug hook displaces ONLY the wave cohort
+    shifted = scenario.join_times_s(wave_shift_s=5.0)
+    assert all(s == j + 5.0 for s, j, c
+               in zip(shifted, joins, crowd) if c)
+    assert all(s == j for s, j, c in zip(shifted, joins, crowd)
+               if not c)
+    assert scenario.effective_fault_specs() == "partition@40-52"
+    # an explicit fault spec overrides the population's windows
+    explicit = TwinScenario(n_peers=8, wave_peers=4,
+                            population=spec, fault_specs="loss@1-2")
+    assert explicit.effective_fault_specs() == "loss@1-2"
+
+
+def test_churn_spec_derives_from_the_population():
+    from hlsjs_p2p_wrapper_tpu.testing.churn import (
+        churn_events, spec_from_population)
+    spec = two_cohort_spec(seed=5)
+    churn = spec_from_population(spec, target_leases=100,
+                                 duration_ms=10_000.0)
+    assert churn.seed == 5
+    # fraction-weighted session mix: broadband watches to the end
+    # (the default mean), cellular churns at 120 s
+    assert churn.mean_session_ms == pytest.approx(
+        0.6 * 120_000.0 + 0.4 * 120.0 * 1000.0)
+    assert len(churn.flash_crowds) == 1
+    crowd = churn.flash_crowds[0]
+    assert crowd.peers == 40
+    assert crowd.t_ms == 5_000.0  # clamped into the churn window
+    assert crowd.session_ms == 120_000.0
+    ops = list(churn_events(churn))
+    assert ops and all(a.t_ms <= b.t_ms for a, b in zip(ops, ops[1:]))
+
+
+def test_population_digest_covers_every_array():
+    spec = two_cohort_spec()
+    pop = materialize(spec, 64, n_levels=3)
+    copied = Population(*[leaf.copy() if isinstance(leaf, np.ndarray)
+                          else leaf for leaf in pop])
+    assert population_digest(copied) == population_digest(pop)
+    flipped = pop._replace(
+        p2p_ok=np.where(np.arange(64) == 3, 1.0 - pop.p2p_ok,
+                        pop.p2p_ok).astype(np.float32))
+    assert population_digest(flipped) != population_digest(pop)
+
+
+def test_connectivity_class_table_is_binary():
+    # the kernel multiplies eligibility by the class value: anything
+    # but 0/1 would scale fair-share demand, not gate it
+    assert set(CONNECTIVITY_CLASSES.values()) <= {0.0, 1.0}

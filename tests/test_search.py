@@ -594,6 +594,13 @@ def test_rng_lint_rule(tmp_path):
         "rng = np.random.default_rng([seed, 3])\n"
         "x = rng.standard_normal(4)\n")
     assert lint_tool.check_rng_discipline(str(good)) == []
-    # the shipped module holds its own rule
-    assert lint_tool.check_rng_discipline(os.path.join(
-        _REPO, "hlsjs_p2p_wrapper_tpu", "engine", "search.py")) == []
+    # the shipped modules hold their own rule — and the population
+    # plane (engine/population.py, the heterogeneous-population
+    # round) is COVERED by RNG_FILES: its cross-process
+    # materialization determinism rests on the same discipline
+    for covered in ("search.py", "population.py"):
+        path = os.path.join(_REPO, "hlsjs_p2p_wrapper_tpu",
+                            "engine", covered)
+        assert any(path.endswith(rf) for rf in lint_tool.RNG_FILES), \
+            f"{covered} must be listed in lint's RNG_FILES"
+        assert lint_tool.check_rng_discipline(path) == []

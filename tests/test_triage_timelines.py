@@ -447,3 +447,116 @@ def test_end_to_end_on_a_real_sweep_dump(tmp_path):
     # tiny trajectories are flagged is threshold behavior, not schema
     triage.triage_records(
         [json.loads(line) for line in open(path, encoding="utf-8")])
+
+
+# -- per-cohort slicing (the heterogeneous-population plane) ------------
+
+COHORT_COLUMNS = COLUMNS + [
+    "cohort_0_peers", "cohort_0_stalled", "cohort_0_offload",
+    "cohort_1_peers", "cohort_1_stalled", "cohort_1_offload"]
+
+
+def cohort_sample(t, c0, c1):
+    """One two-cohort sample: ``c0``/``c1`` are (present, stalled,
+    offload) triples; the swarm-wide columns derive from them."""
+    present = c0[0] + c1[0]
+    stalled = c0[1] + c1[1]
+    return [t, 0.5, 0.0, 1e6, 1e6, stalled, present, 0.0,
+            c0[0], c0[1], c0[2], c1[0], c1[1], c1[2]]
+
+
+def cohort_burst_record():
+    """Cohort 1 (cellular) stalls hard at t=6..8 while cohort 0
+    holds — the cohort-ATTRIBUTED burst the slicer must name."""
+    samples = []
+    for t in range(12):
+        c1_stalled = 5.0 if t in (6, 7, 8) else 0.0
+        samples.append(cohort_sample(t, (10.0, 0.0, 0.6),
+                                     (8.0, c1_stalled, 0.1)))
+    return {"uplink_mbps": 2.2, "cohorts": ["broadband", "cellular"],
+            "columns": COHORT_COLUMNS, "samples": samples}
+
+
+def swarm_wide_burst_record():
+    """BOTH cohorts stall together: a swarm failure, not a cohort
+    one — the plain burst detector's territory, not the slicer's."""
+    samples = []
+    for t in range(12):
+        c0 = (10.0, 6.0 if t in (6, 7) else 0.0, 0.5)
+        c1 = (8.0, 5.0 if t in (6, 7) else 0.0, 0.5)
+        samples.append(cohort_sample(t, c0, c1))
+    return {"cohorts": ["broadband", "cellular"],
+            "columns": COHORT_COLUMNS, "samples": samples}
+
+
+def test_cohort_stall_burst_fires_and_names_the_cohort():
+    record = cohort_burst_record()
+    finding = triage.detect_cohort_stall_burst(
+        record["columns"], record["samples"], record["cohorts"])
+    assert finding is not None
+    assert finding["reason"] == "cohort_stall_burst"
+    assert finding["cohort"] == "cellular"
+    assert finding["cohort_index"] == 1
+    assert finding["bursts"] == 3
+    assert finding["first_t_s"] == 6.0
+    assert finding["max_stalled_frac"] == 0.625
+
+
+def test_homogeneous_control_has_no_cohort_findings():
+    """The satellite's control: the SAME pathology without cohort
+    columns (a homogeneous sweep's timeline) must not fire either
+    cohort detector — there is nothing to attribute."""
+    record = bursting_record()
+    assert triage.detect_cohort_stall_burst(
+        record["columns"], record["samples"], None) is None
+    assert triage.detect_cohort_offload_skew(
+        record["columns"], record["samples"], None) is None
+    triaged = triage.triage_records([record])
+    reasons = [f["reason"] for e in triaged for f in e["findings"]]
+    assert "cohort_stall_burst" not in reasons
+    assert "cohort_offload_skew" not in reasons
+
+
+def test_swarm_wide_burst_is_not_cohort_attributed():
+    record = swarm_wide_burst_record()
+    assert triage.detect_cohort_stall_burst(
+        record["columns"], record["samples"],
+        record["cohorts"]) is None
+
+
+def test_cohort_offload_skew_names_carrier_and_laggard():
+    record = cohort_burst_record()  # 0.6 vs 0.1 at the last sample
+    finding = triage.detect_cohort_offload_skew(
+        record["columns"], record["samples"], record["cohorts"])
+    assert finding is not None
+    assert finding["carrier"] == "broadband"
+    assert finding["laggard"] == "cellular"
+    assert finding["gap"] == 0.5
+    # under the gap bar: no finding
+    level = [cohort_sample(t, (10.0, 0.0, 0.5), (8.0, 0.0, 0.45))
+             for t in range(6)]
+    assert triage.detect_cohort_offload_skew(
+        COHORT_COLUMNS, level, record["cohorts"]) is None
+
+
+def test_cohort_findings_ride_triage_records_with_names():
+    triaged = triage.triage_records([cohort_burst_record()])
+    assert len(triaged) == 1
+    reasons = {f["reason"]: f for f in triaged[0]["findings"]}
+    assert "cohort_stall_burst" in reasons
+    assert "cohort_offload_skew" in reasons
+    assert reasons["cohort_stall_burst"]["cohort"] == "cellular"
+    # structure keys (incl. the cohorts name map) stay off the knob
+    # label
+    assert "cohorts" not in triaged[0]["knobs"]
+    # and the human descriptions name the cohorts
+    described = [triage._describe(f) for f in triaged[0]["findings"]]
+    assert any("[cellular]" in d for d in described)
+    assert any("broadband carries" in d for d in described)
+
+
+def test_unnamed_cohorts_fall_back_to_indices():
+    record = cohort_burst_record()
+    finding = triage.detect_cohort_stall_burst(
+        record["columns"], record["samples"], None)
+    assert finding["cohort"] == "cohort_1"

@@ -412,9 +412,14 @@ def check_traffic_discipline(path):
 #: "same seed ⇒ identical proposal sequence ⇒ identical frontier"
 #: (make optimize-gate asserts it at process level), and a single
 #: global-state RNG draw breaks that invisibly — the checkpoint
-#: can't serialize global state, so a resumed search would diverge
+#: can't serialize global state, so a resumed search would diverge.
+#: The population plane (engine/population.py) carries the same
+#: contract at process level: ``make population-gate`` asserts the
+#: same spec + seed materializes byte-identically in two separate
+#: interpreters, which one naked global-RNG draw silently breaks.
 RNG_FILES = (
     os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "search.py"),
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "population.py"),
 )
 
 #: numpy constructors that, WITH an explicit seed argument, are the

@@ -96,6 +96,16 @@ per-level peer counts; ops/swarm_sim.py ``timeline_columns``), and
 grid point: knobs + columns + samples) so a debug session can see
 WHEN offload ramps or the ladder oscillates, not just where it
 ended.
+
+``--population SPEC.json`` (the heterogeneous-population plane,
+engine/population.py) overlays every grid point with a seeded
+cohort-mixture spec: per-peer rate distributions, connectivity
+classes, device ladder caps, arrival/session processes — all
+materialized into dynamic ``SwarmScenario`` data, so the mixture
+grid still compiles ONCE.  A spec with a ``mix_cohort`` /
+``mix_fractions`` axis crosses the grid with a ``population_mix``
+knob; timelines gain per-cohort columns the triage tool slices
+(``make population-gate`` pins the plane's contracts).
 """
 
 import argparse
@@ -118,6 +128,8 @@ from hlsjs_p2p_wrapper_tpu.engine.fabric import (  # noqa: E402
     FleetChaos, WorkLedger, barrier, fleet_report, run_units)
 from hlsjs_p2p_wrapper_tpu.engine.faults import (  # noqa: E402
     FaultPlan, FaultPolicy)
+from hlsjs_p2p_wrapper_tpu.engine.population import (  # noqa: E402
+    load_spec, materialize, to_scenario_kwargs)
 from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
     FlightRecorder, counter_families, run_id_for)
 from hlsjs_p2p_wrapper_tpu.ops.swarm_sim import (  # noqa: E402
@@ -215,8 +227,36 @@ def live_grid():
                               announces, waves)]
 
 
+def population_grid(grid, spec):
+    """Cross a grid with the population spec's MIXTURE AXIS: one copy
+    of every point per ``mix_fractions`` entry, carrying the fraction
+    as the ``population_mix`` knob (dynamic scenario DATA — the whole
+    mixture grid stays ONE compile group; engine/population.py
+    ``with_mix``).  A spec without a mixture axis applies uniformly
+    and adds no knob."""
+    if spec.mix_cohort is None or not spec.mix_fractions:
+        return [dict(knobs) for knobs in grid]
+    return [dict(knobs, population_mix=mix)
+            for knobs in grid for mix in spec.mix_fractions]
+
+
+def _cached_population(spec, mix, peers, n_levels, uplink_bps,
+                       cdn_bps):
+    """Materialized-population memo: one materialization per
+    (spec, mix, peers, defaults) — the same host-PRNG-off-the-path
+    rule the join/rank memo above follows."""
+    def build(_key):
+        mixed = spec if mix is None else spec.with_mix(mix)
+        return materialize(mixed, peers, n_levels=n_levels,
+                           default_uplink_bps=uplink_bps,
+                           default_cdn_bps=cdn_bps)
+    return _cached("population", build,
+                   (json.dumps(spec.to_json(), sort_keys=True), mix,
+                    peers, n_levels, uplink_bps, cdn_bps))
+
+
 def build_config(peers, segments, live, degree, live_sync_s=None,
-                 eligibility="auto"):
+                 eligibility="auto", n_cohorts=0):
     """The static scenario description: topology degree is the only
     compile-time knob (the live-sync cushion is dynamic scenario data
     since this round).  ``live_sync_s`` re-pins the cushion as a
@@ -231,17 +271,41 @@ def build_config(peers, segments, live, degree, live_sync_s=None,
     return SwarmConfig(n_peers=peers, n_segments=segments,
                       n_levels=N_LEVELS, live=live,
                       neighbor_offsets=ring_offsets(degree),
-                      eligibility=eligibility, **kwargs)
+                      eligibility=eligibility, n_cohorts=n_cohorts,
+                      **kwargs)
 
 
-def build_scenario(config, knobs, *, watch_s, stagger_s, seed):
+def build_scenario(config, knobs, *, watch_s, stagger_s, seed,
+                   population=None):
     """One grid point's dynamic scenario (plus its join times, which
     the rebuffer denominator needs).  Everything here is scenario
-    DATA — no recompile across points."""
+    DATA — no recompile across points.  ``population`` (an
+    engine/population.py ``PopulationSpec``) overlays the point with
+    materialized per-peer cohort arrays — rates, joins/leaves and
+    the population fields — with the point's supply knobs as the
+    inherit defaults and ``knobs["population_mix"]`` re-weighting
+    the spec's mixture axis; a degenerate all-inherit spec
+    reproduces the homogeneous arrays exactly (the population gate's
+    bit-identity surface)."""
     peers = config.n_peers
-    cdn = jnp.full((peers,), knobs["cdn_mbps"] * 1e6)
-    uplink = jnp.full((peers,), knobs["uplink_mbps"] * 1e6)
-    if not config.live:
+    pop_kwargs = {}
+    if population is not None:
+        pop = _cached_population(
+            population, knobs.get("population_mix"), peers,
+            config.n_levels, knobs["uplink_mbps"] * 1e6,
+            knobs["cdn_mbps"] * 1e6)
+        pop_kwargs = to_scenario_kwargs(pop)
+    if "cdn_bps" in pop_kwargs:
+        cdn = jnp.asarray(pop_kwargs.pop("cdn_bps"))
+    else:
+        cdn = jnp.full((peers,), knobs["cdn_mbps"] * 1e6)
+    if "uplink_bps" in pop_kwargs:
+        uplink = jnp.asarray(pop_kwargs.pop("uplink_bps"))
+    else:
+        uplink = jnp.full((peers,), knobs["uplink_mbps"] * 1e6)
+    if "join_s" in pop_kwargs:
+        join = jnp.asarray(pop_kwargs.pop("join_s"))
+    elif not config.live:
         join = _cached("join", staggered_joins, peers, stagger_s, seed)
     elif knobs.get("join_wave", "steady") == "crowd":
         # flash crowd: a 25% seed population from t=0, then 75% of
@@ -265,7 +329,7 @@ def build_scenario(config, knobs, *, watch_s, stagger_s, seed):
         p2p_budget_cap_ms=knobs["budget_cap_ms"],
         live_spread_s=knobs["spread_s"],
         announce_delay_s=knobs.get("announce_delay_s", 0.0),
-        live_sync_s=knobs.get("live_sync_s"))
+        live_sync_s=knobs.get("live_sync_s"), **pop_kwargs)
     return scenario, join
 
 
@@ -306,7 +370,7 @@ def group_grid(grid, static_live_sync=False):
 
 def build_groups(grid, *, peers, segments, watch_s, live, seed,
                  stagger_s=60.0, static_live_sync=False,
-                 eligibility="auto"):
+                 eligibility="auto", population=None):
     """The compile-group decomposition every execution path shares
     (batched engine, fabric workers, fabric merge): ``group_list``
     is ``run_groups_chunked``'s ``(config, items, build)`` triples,
@@ -319,12 +383,18 @@ def build_groups(grid, *, peers, segments, watch_s, live, seed,
     group_keys = []
     for key, idxs in groups_map.items():
         sync = key[-1] if (static_live_sync and live) else None
-        config = build_config(peers, segments, live, key[0],
-                              live_sync_s=sync,
-                              eligibility=eligibility)
+        config = build_config(
+            peers, segments, live, key[0], live_sync_s=sync,
+            eligibility=eligibility,
+            # the cohort count sizes the per-cohort timeline columns;
+            # it is shared by every point of a population sweep, so
+            # the grid still collapses to one group per degree
+            n_cohorts=(len(population.cohorts)
+                       if population is not None else 0))
         build = (lambda k, cfg=config:
                  build_scenario(cfg, k, watch_s=watch_s,
-                                stagger_s=stagger_s, seed=seed))
+                                stagger_s=stagger_s, seed=seed,
+                                population=population))
         group_list.append((config, [grid[i] for i in idxs], build))
         group_keys.append((key, idxs))
     n_steps = int(watch_s * 1000.0 / group_list[0][0].dt_ms)
@@ -332,13 +402,18 @@ def build_groups(grid, *, peers, segments, watch_s, live, seed,
 
 
 def journal_meta(grid, *, peers, segments, watch_s, live, seed,
-                 record_every):
+                 record_every, population=None):
     """The sweep-identity material the crash-safe journal is
     content-addressed by — everything that changes what a row IS, so
-    a ``--resume`` can never replay a different sweep's progress."""
-    return {"tool": "sweep", "peers": peers, "segments": segments,
+    a ``--resume`` can never replay a different sweep's progress.
+    The population spec is identity material too: the same grid
+    under a different cohort mixture computes different rows."""
+    meta = {"tool": "sweep", "peers": peers, "segments": segments,
             "watch_s": watch_s, "live": bool(live), "seed": seed,
             "record_every": record_every, "grid": grid}
+    if population is not None:
+        meta["population"] = population.to_json()
+    return meta
 
 
 def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
@@ -346,7 +421,8 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
                      record_every=0, tracer=None, pipeline=True,
                      static_live_sync=False, interleave=True,
                      warm_start=None, raw=False, faults=None,
-                     journal=None, trace=None, eligibility="auto"):
+                     journal=None, trace=None, eligibility="auto",
+                     population=None):
     """The batched engine: one ``run_swarm_batch`` dispatch per
     padded chunk per compile group, host readback pipelined one chunk
     behind the device, chunks round-robined across groups when more
@@ -388,7 +464,8 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
     group_list, group_keys, n_steps = build_groups(
         grid, peers=peers, segments=segments, watch_s=watch_s,
         live=live, seed=seed, stagger_s=stagger_s,
-        static_live_sync=static_live_sync, eligibility=eligibility)
+        static_live_sync=static_live_sync, eligibility=eligibility,
+        population=population)
     results, stats = run_groups_chunked(
         group_list, n_steps, watch_s=watch_s, chunk=chunk,
         record_every=record_every, tracer=tracer, pipeline=pipeline,
@@ -444,7 +521,7 @@ def run_grid_batched(grid, *, peers, segments, watch_s, live, seed,
 
 
 def run_grid_sequential(grid, *, peers, segments, watch_s, live, seed,
-                        stagger_s=60.0, **_):
+                        stagger_s=60.0, population=None, **_):
     """The pre-batching reference path: one ``run_swarm`` dispatch
     plus one blocking host readback PER grid point.  Kept as the
     parity/benchmark baseline the batched engine is measured against
@@ -455,10 +532,14 @@ def run_grid_sequential(grid, *, peers, segments, watch_s, live, seed,
     rows = []
     compiles = set()
     for knobs in grid:
-        config = build_config(peers, segments, live, knobs["degree"])
+        config = build_config(
+            peers, segments, live, knobs["degree"],
+            n_cohorts=(len(population.cohorts)
+                       if population is not None else 0))
         n_steps = int(watch_s * 1000.0 / config.dt_ms)
         scenario, join = build_scenario(config, knobs, watch_s=watch_s,
-                                        stagger_s=stagger_s, seed=seed)
+                                        stagger_s=stagger_s, seed=seed,
+                                        population=population)
         final, _ = run_swarm_scenario(config, scenario,
                                       init_swarm(config), n_steps)
         compiles.add(_static_key(knobs))
@@ -722,6 +803,17 @@ def main():
     ap.add_argument("--watch-s", type=float, default=240.0)
     ap.add_argument("--live", action="store_true",
                     help="sweep the live-edge stagger grid instead of VOD")
+    ap.add_argument("--population", metavar="SPEC",
+                    help="heterogeneous-population scenario plane "
+                         "(engine/population.py): path to a JSON "
+                         "PopulationSpec (see examples/) — cohort "
+                         "attribute distributions, connectivity "
+                         "classes, device ladder caps, arrival/"
+                         "session processes.  A spec with a "
+                         "mix_cohort/mix_fractions axis CROSSES the "
+                         "grid with one population_mix knob value "
+                         "per fraction (dynamic scenario data: the "
+                         "mixture grid stays one compile group)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--chunk", type=int, default=None,
                     help="scenarios per batched dispatch (default: "
@@ -839,8 +931,16 @@ def main():
     elif (args.hosts is not None or args.host_id
           or args.fabric_chaos or args.fabric_barrier):
         ap.error("--hosts/--host-id/--fabric-* need --fabric DIR")
+    if args.population and args.fabric:
+        ap.error("--population is single-host for now (the fabric "
+                 "manifest does not carry the spec; run the mixture "
+                 "grid without --fabric)")
 
     grid = live_grid() if args.live else vod_grid()
+    population = None
+    if args.population:
+        population = load_spec(args.population)
+        grid = population_grid(grid, population)
     engine = run_grid_sequential if args.sequential else run_grid_batched
     warm_start = None
     if not (args.no_warm_start or args.sequential):
@@ -874,7 +974,7 @@ def main():
         trace_meta = journal_meta(
             grid, peers=args.peers, segments=args.segments,
             watch_s=args.watch_s, live=args.live, seed=args.seed,
-            record_every=args.record_every)
+            record_every=args.record_every, population=population)
         trace = FlightRecorder(
             args.trace_dir, args.host_id or "host00",
             run_id=run_id_for(trace_meta),
@@ -911,7 +1011,8 @@ def main():
                             segments=args.segments,
                             watch_s=args.watch_s, live=args.live,
                             seed=args.seed,
-                            record_every=args.record_every)
+                            record_every=args.record_every,
+                            population=population)
         jpath = journal_path(warm_start.cache_dir, meta)
         shards = journal_shards(warm_start.cache_dir, meta)
         if args.resume and not (os.path.exists(jpath) or shards):
@@ -944,7 +1045,7 @@ def main():
             watch_s=args.watch_s, live=args.live, seed=args.seed,
             chunk=args.chunk, record_every=args.record_every,
             warm_start=warm_start, faults=faults, journal=journal,
-            trace=trace)
+            trace=trace, population=population)
     elapsed = time.perf_counter() - t0
     # with the warm-start engine active, the honest compile count is
     # the number of FRESH program compiles it performed (cache misses
@@ -966,7 +1067,10 @@ def main():
         # degree-dependent column)
         columns = timeline_columns(
             build_config(args.peers, args.segments, args.live,
-                         grid[0]["degree"]))
+                         grid[0]["degree"],
+                         n_cohorts=(len(population.cohorts)
+                                    if population is not None
+                                    else 0)))
         lines = []
         for row, tl in zip(rows, timelines):
             if tl is None:
@@ -977,6 +1081,10 @@ def main():
                 "offload": row["offload"],
                 "rebuffer": row["rebuffer"],
                 "record_every": args.record_every,
+                # cohort index → name map for the per-cohort
+                # columns (triage_timelines.py cohort slicing)
+                **({"cohorts": list(population.cohort_names)}
+                   if population is not None else {}),
                 "columns": list(columns),
                 # FULL precision: the artifact's last sample IS
                 # the final-state metric pair (the row's
@@ -1058,6 +1166,8 @@ def main():
                 "warm_start": (warm_start.summary()
                                if warm_start is not None else None),
                 "resume": bool(args.resume),
+                **({"population": population.to_json()}
+                   if population is not None else {}),
                 "dispatch_faults": fault_counts,
                 "failed_points": len(failed),
                 "failures": info.get("failures", []),

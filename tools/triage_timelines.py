@@ -38,6 +38,17 @@ pathologies the on-device metrics timelines were built to expose
   with NO arrivals behind it is the swarm itself failing (uplink
   collapse, CDN rescue arriving late), which is the pathology worth
   a work-list line.
+- **Per-cohort slicing** (the heterogeneous-population plane): a
+  ``--population`` sweep's timelines carry per-cohort columns
+  (``cohort_<k>_{peers,stalled,offload}``) and a ``cohorts`` name
+  map, and two detectors answer the population questions aggregates
+  cannot: **cohort stall burst** — one cohort's stalled share of
+  its OWN members crosses ``--burst-frac`` while the REST of the
+  audience holds (the delivery failure lives in the cohort; names
+  it) — and **cohort offload skew** — the final offload gap between
+  the best- and worst-offloading cohorts is ≥ ``--skew-gap``,
+  naming which cohort CARRIES the P2P bytes and which rides the
+  CDN.  Homogeneous timelines skip both.
 
 Prints one triaged line per flagged grid point (knobs + reasons +
 the numbers behind them) and a summary; ``--strict`` exits nonzero
@@ -69,7 +80,7 @@ from hlsjs_p2p_wrapper_tpu.core.gridjoin import (  # noqa: E402
 
 #: record keys that are structure, not scenario knobs
 _RESERVED = ("columns", "samples", "record_every", "offload",
-             "rebuffer")
+             "rebuffer", "cohorts")
 
 
 def _dominant_levels(columns, samples):
@@ -223,6 +234,117 @@ def detect_stagger_overshoot(columns, samples, spread_s, *,
     return None
 
 
+# -- per-cohort slicing (the heterogeneous-population plane) ------------
+
+def cohort_slices(columns):
+    """The per-cohort column triples a population sweep's timelines
+    carry (``cohort_<k>_{peers,stalled,offload}``, emitted by
+    ops/swarm_sim.py ``timeline_columns`` when ``n_cohorts > 0``):
+    ``[(k, peers_col, stalled_col, offload_col), …]`` in cohort
+    order.  Empty on a homogeneous timeline — every cohort detector
+    degrades to None there, which IS the homogeneous control the
+    unit tests pin."""
+    out = []
+    k = 0
+    while (f"cohort_{k}_peers" in columns
+           and f"cohort_{k}_stalled" in columns
+           and f"cohort_{k}_offload" in columns):
+        out.append((k, columns.index(f"cohort_{k}_peers"),
+                    columns.index(f"cohort_{k}_stalled"),
+                    columns.index(f"cohort_{k}_offload")))
+        k += 1
+    return out
+
+
+def _cohort_name(cohorts, k):
+    if cohorts and k < len(cohorts):
+        return cohorts[k]
+    return f"cohort_{k}"
+
+
+def detect_cohort_stall_burst(columns, samples, cohorts=None, *,
+                              burst_frac=0.25, others_frac=None):
+    """Cohort-ATTRIBUTED stall burst finding dict, or None: a sample
+    window where one cohort's stalled share of its OWN present
+    members is at or above ``burst_frac`` while the REST of the
+    audience stays under ``others_frac`` (default half the bar) —
+    i.e. the delivery failure lives in the cohort, not the swarm.
+    A swarm-wide burst is the plain rebuffer-burst detector's job;
+    this one answers the population question: WHICH cohort stalls.
+    Reports the worst-hit cohort (by burst count, then worst share)
+    with its windows, worst stalled share and first sample clock."""
+    slices = cohort_slices(columns)
+    if len(slices) < 2:
+        return None  # homogeneous control: nothing to attribute
+    if others_frac is None:
+        others_frac = burst_frac / 2.0
+    t_col = columns.index("t_s")
+    per_cohort = {}
+    for sample in samples:
+        stats = []
+        for k, p_col, s_col, _ in slices:
+            present = sample[p_col]
+            stalled = sample[s_col]
+            stats.append((k, present, stalled))
+        total_present = sum(p for _, p, _ in stats)
+        total_stalled = sum(s for _, _, s in stats)
+        for k, present, stalled in stats:
+            if present <= 0:
+                continue
+            rest_present = total_present - present
+            rest_stalled = total_stalled - stalled
+            rest_frac = (rest_stalled / rest_present
+                         if rest_present > 0 else 0.0)
+            frac = stalled / present
+            if frac >= burst_frac and rest_frac < others_frac:
+                entry = per_cohort.setdefault(
+                    k, {"bursts": 0, "worst": 0.0, "first_t": None})
+                entry["bursts"] += 1
+                entry["worst"] = max(entry["worst"], frac)
+                if entry["first_t"] is None:
+                    entry["first_t"] = sample[t_col]
+    if not per_cohort:
+        return None
+    k, entry = max(per_cohort.items(),
+                   key=lambda kv: (kv[1]["bursts"], kv[1]["worst"]))
+    return {"reason": "cohort_stall_burst",
+            "cohort": _cohort_name(cohorts, k), "cohort_index": k,
+            "bursts": entry["bursts"],
+            "max_stalled_frac": round(entry["worst"], 4),
+            "first_t_s": round(entry["first_t"], 3),
+            "cohorts_flagged": len(per_cohort)}
+
+
+def detect_cohort_offload_skew(columns, samples, cohorts=None, *,
+                               skew_gap=0.2):
+    """Cohort offload-skew finding dict, or None: at the final
+    sample, the gap between the best- and worst-offloading cohorts
+    (among cohorts with present members) is at or above
+    ``skew_gap`` — naming WHICH cohort carries the P2P offload and
+    which rides the CDN.  An expected property of connectivity-split
+    mixtures, which is exactly why it belongs on the triage line:
+    the knob table alone cannot show who pays for the aggregate."""
+    slices = cohort_slices(columns)
+    if len(slices) < 2 or not samples:
+        return None
+    last = samples[-1]
+    finals = [(k, last[o_col]) for k, p_col, _, o_col in slices
+              if last[p_col] > 0]
+    if len(finals) < 2:
+        return None
+    carrier = max(finals, key=lambda kv: kv[1])
+    laggard = min(finals, key=lambda kv: kv[1])
+    gap = carrier[1] - laggard[1]
+    if gap < skew_gap:
+        return None
+    return {"reason": "cohort_offload_skew",
+            "carrier": _cohort_name(cohorts, carrier[0]),
+            "laggard": _cohort_name(cohorts, laggard[0]),
+            "carrier_offload": round(carrier[1], 4),
+            "laggard_offload": round(laggard[1], 4),
+            "gap": round(gap, 4)}
+
+
 def knob_label(record):
     """Compact ``k=v`` knob summary for one record's triage line."""
     return " ".join(f"{k}={v}" for k, v in record.items()
@@ -335,13 +457,18 @@ def grid_triage(records, triaged):
 def triage_records(records, *, min_flips=4, osc_frac=0.25,
                    stall_offload=0.2, stall_gain=0.02,
                    burst_frac=0.25, wave_frac=0.1,
-                   overshoot_share=0.5, overshoot_frac=0.5):
+                   overshoot_share=0.5, overshoot_frac=0.5,
+                   skew_gap=0.2):
     """Findings list: ``{"point", "knobs", "findings": [...]}`` per
-    flagged record, in file order."""
+    flagged record, in file order.  Population sweeps' records carry
+    per-cohort columns (and a ``cohorts`` name map), so the cohort
+    detectors attribute pathologies to the cohort that carries them;
+    homogeneous records skip them entirely."""
     triaged = []
     for idx, record in enumerate(records):
         columns = record["columns"]
         samples = record["samples"]
+        cohorts = record.get("cohorts")
         findings = [f for f in (
             detect_oscillation(columns, samples, min_flips=min_flips,
                                osc_frac=osc_frac),
@@ -356,6 +483,10 @@ def triage_records(records, *, min_flips=4, osc_frac=0.25,
                                      overshoot_share=overshoot_share,
                                      overshoot_frac=overshoot_frac,
                                      wave_frac=wave_frac),
+            detect_cohort_stall_burst(columns, samples, cohorts,
+                                      burst_frac=burst_frac),
+            detect_cohort_offload_skew(columns, samples, cohorts,
+                                       skew_gap=skew_gap),
         ) if f is not None]
         if findings:
             triaged.append({"point": idx, "knobs": knob_label(record),
@@ -364,6 +495,17 @@ def triage_records(records, *, min_flips=4, osc_frac=0.25,
 
 
 def _describe(finding):
+    if finding["reason"] == "cohort_stall_burst":
+        return (f"cohort_stall_burst [{finding['cohort']}] "
+                f"({finding['bursts']} windows, worst "
+                f"{finding['max_stalled_frac']:.0%} of the cohort "
+                f"stalled while the rest of the audience held, "
+                f"first at t={finding['first_t_s']}s)")
+    if finding["reason"] == "cohort_offload_skew":
+        return (f"cohort_offload_skew ({finding['carrier']} carries "
+                f"offload {finding['carrier_offload']} vs "
+                f"{finding['laggard']} {finding['laggard_offload']}, "
+                f"gap {finding['gap']})")
     if finding["reason"] == "ladder_oscillation":
         return (f"ladder_oscillation ({finding['flips']} flips / "
                 f"{finding['transitions']} transitions)")
@@ -435,6 +577,13 @@ def main(argv=None):
                     help="fraction of post-window samples over the "
                          "share bar before a point is flagged as "
                          "stagger overshoot (default 0.5)")
+    ap.add_argument("--skew-gap", type=float, default=0.2,
+                    help="final offload gap between the best- and "
+                         "worst-offloading cohorts before a "
+                         "population point is flagged as cohort "
+                         "offload skew (default 0.2; needs the "
+                         "per-cohort columns a --population sweep "
+                         "emits)")
     args = ap.parse_args(argv)
 
     with open(args.timelines, encoding="utf-8") as f:
@@ -444,7 +593,7 @@ def main(argv=None):
         stall_offload=args.stall_offload, stall_gain=args.stall_gain,
         burst_frac=args.burst_frac, wave_frac=args.wave_frac,
         overshoot_share=args.overshoot_share,
-        overshoot_frac=args.overshoot_frac)
+        overshoot_frac=args.overshoot_frac, skew_gap=args.skew_gap)
 
     grid = (grid_triage(records, triaged) if args.grid else None)
     if args.json:
@@ -475,7 +624,9 @@ def main(argv=None):
           f"flagged ({reasons.count('ladder_oscillation')} "
           f"oscillating, {reasons.count('offload_stall')} stalled, "
           f"{reasons.count('rebuffer_burst')} bursting, "
-          f"{reasons.count('stagger_overshoot')} overshooting)",
+          f"{reasons.count('stagger_overshoot')} overshooting, "
+          f"{reasons.count('cohort_stall_burst')} cohort-stalling, "
+          f"{reasons.count('cohort_offload_skew')} cohort-skewed)",
           file=sys.stderr)
     return 1 if (args.strict and triaged) else 0
 
