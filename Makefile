@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
 	coverage soak scaling-artifact warmstart-gate chaos-gate \
 	fleet-gate trace-gate tracker-gate net-chaos-gate optimize-gate \
-	twin-gate control-gate population-gate
+	twin-gate control-gate population-gate slo-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -199,6 +199,25 @@ control-gate:
 population-gate:
 	$(PY) tools/population_gate.py
 
+# Fleet observation plane (round 15, engine/twinframe.py mux +
+# engine/digest.py + engine/slo.py): a 4-way per-peer re-shard of a
+# recorded provenance shard must merge back to the single-shard
+# frames BIT-FOR-BIT (quantile columns included) — batch replay,
+# incremental torn-tail tail-follow, and a same-seed rerun all
+# identical; the controller's decisions must be identical whether
+# the same traffic arrives as one shard or four (tools/control.py
+# --shard repeated); a truncated shard must be declared dead after
+# its watermark stalls and every later window must record the
+# exclusion (counted, never silently merged); and the committed
+# SLO_r12.json objectives must fire exactly one cohort-attributed
+# burn alert on an injected regional loss window (worst shard AND
+# worst cohort named) with zero clean-run false positives —
+# consumers (console --slo, Perfetto SLO row/tracks) held.
+# Recalibrate via `python tools/slo_gate.py --write-artifact`;
+# SLO_GATE_PEERS etc. scale it up.
+slo-gate:
+	$(PY) tools/slo_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -209,6 +228,6 @@ examples:
 
 check: lint test dryrun warmstart-gate chaos-gate fleet-gate \
 	trace-gate tracker-gate net-chaos-gate optimize-gate twin-gate \
-	control-gate population-gate
+	control-gate population-gate slo-gate
 
 all: check bench

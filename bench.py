@@ -98,6 +98,16 @@ Final states are asserted bit-identical and a VOD grid slice re-runs
 raw under both with float.hex row equality: the stencil is a pure
 traffic transform, measured as such.
 
+The fleet observation round adds ``detail.fleet_ingest``: the same
+recorded provenance traffic ingested as one shard vs re-sharded
+per-peer into 4 and 16 host-shaped shards through the
+``ShardMuxFollower`` (engine/twinframe.py), merged frames asserted
+identical to the single-shard frames on every timed pass; the
+per-window quantile-digest merge cost (engine/digest.py) rides
+along, and the armed-vs-off overhead is recorded with the quantile
+columns live in the frame path (3% standalone bar; in-bench hard
+backstop 0.5 — the rider docstring explains the heap-wake noise).
+
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
@@ -830,6 +840,123 @@ def twin_overhead_benchmark(reps=6):
         "twin_overhead": round(on_s / off_s - 1.0, 4),
         "frame_extract_wall_s": round(statistics.median(extract_times),
                                       4),
+    }
+
+
+def fleet_ingest_benchmark(twin_overhead, reps=5):
+    """``detail.fleet_ingest`` (the fleet observation round): what
+    multi-shard ingest costs over single-shard ingest, and what the
+    digest layer costs per window.
+
+    One armed twin-scenario run produces the provenance shard; the
+    SAME traffic is then re-sharded per-peer into 4 and 16
+    host-shaped shards (``testing/twin.split_shard``) and ingested
+    three ways — single-shard ``frames_from_events``, 4-shard mux,
+    16-shard mux — with the merged frames asserted IDENTICAL to the
+    single-shard frames every pass (the slo-gate exactness bar,
+    re-checked where the walls are measured).  Walls are medians of
+    ``reps`` interleaved passes (the twin_overhead discipline).  The
+    per-window quantile-digest merge cost rides along: 16 per-shard
+    sketches folded into one (engine/digest.py — integer bin adds),
+    timed per window.  The armed-vs-off number is the PR 12
+    ``detail.twin_overhead`` measurement, RECORDED here because the
+    FrameBuilder now computes the quantile columns on every window
+    close: the 3% bar is the STANDALONE acceptance number (the
+    rider measures ~2% in isolation); inside a whole-bench run the
+    churn riders' heap wake swings the ratio by double digits
+    (committed BENCH_r11 carries 0.20 for identical code), so the
+    hard assert is only the 0.5 order-of-magnitude backstop and
+    the artifact names both numbers honestly."""
+    import tempfile
+
+    from hlsjs_p2p_wrapper_tpu.engine.digest import QuantileDigest
+    from hlsjs_p2p_wrapper_tpu.engine.tracer import read_shard
+    from hlsjs_p2p_wrapper_tpu.engine.twinframe import (
+        frames_from_events, frames_from_shards, parse_labels)
+    from hlsjs_p2p_wrapper_tpu.testing.twin import (TwinScenario,
+                                                    run_real_plane,
+                                                    split_shard)
+
+    # the < 3% bar is the tracked acceptance number (the PR 12
+    # twin_overhead treatment: recorded, judged standalone — inside
+    # a whole-bench run the churn riders' heap wake swings this
+    # ratio by double digits, e.g. the committed BENCH_r11 carries
+    # 0.20 for the identical code that measures ~2% isolated); the
+    # assert below is the order-of-magnitude regression backstop
+    assert twin_overhead["twin_overhead"] < 0.5, \
+        f"armed event plane overhead {twin_overhead['twin_overhead']}" \
+        f" is far past the 3% bar — the quantile columns or the " \
+        f"recorder grew a real cost, not noise"
+
+    scenario = TwinScenario()
+    single_walls, mux_walls = [], {4: [], 16: []}
+    with tempfile.TemporaryDirectory() as root:
+        result = run_real_plane(scenario, trace_dir=root,
+                                extract_events=False)
+        _meta, events = read_shard(result.shard_path)
+        split_paths = {
+            n: split_shard(result.shard_path,
+                           os.path.join(root, f"split{n}"), n)
+            for n in (4, 16)}
+        reference = frames_from_events(events)
+        for _ in range(reps):
+            start = time.perf_counter()
+            _meta2, events2 = read_shard(result.shard_path)
+            single = frames_from_events(events2)
+            single_walls.append(time.perf_counter() - start)
+            assert single == reference
+            for n, paths in split_paths.items():
+                start = time.perf_counter()
+                merged = frames_from_shards(paths)
+                mux_walls[n].append(time.perf_counter() - start)
+                assert merged == reference, \
+                    f"{n}-shard merge diverged from single-shard"
+
+        # per-window digest merge: 16 per-shard sketches sized from
+        # the run's own audience folded into one (parse_labels is
+        # the one canonical label inverse — engine/twinframe.py)
+        n_peers = len({parse_labels(e.get("labels", "")).get("peer")
+                       for e in events
+                       if e.get("kind") == "counter"} - {None})
+        shard_digests = []
+        for i in range(16):
+            digest = QuantileDigest()
+            for j in range(i, n_peers, 16):
+                digest.add(float(j) * 100.0)
+            shard_digests.append(digest)
+        iters = 2000
+        start = time.perf_counter()
+        for _ in range(iters):
+            merged_digest = QuantileDigest()
+            for digest in shard_digests:
+                merged_digest.merge(digest)
+        merge_per_window_s = (time.perf_counter() - start) / iters
+
+    single_s = statistics.median(single_walls)
+    mux4_s = statistics.median(mux_walls[4])
+    mux16_s = statistics.median(mux_walls[16])
+    return {
+        "what": "multi-shard flight-recorder ingest (ShardMuxFollower)"
+                " vs single-shard frames_from_events on the same "
+                "traffic re-sharded per peer — frames asserted "
+                "identical every pass; digest merge cost per window; "
+                "armed-vs-off bar inherited from detail.twin_overhead",
+        "peers": scenario.total_peers,
+        "windows": scenario.n_windows,
+        "events_per_run": len(events),
+        "single_shard_ingest_wall_s": round(single_s, 5),
+        "mux4_ingest_wall_s": round(mux4_s, 5),
+        "mux16_ingest_wall_s": round(mux16_s, 5),
+        "mux4_vs_single": round(mux4_s / single_s, 3),
+        "mux16_vs_single": round(mux16_s / single_s, 3),
+        "digest_merge_per_window_s": round(merge_per_window_s, 7),
+        "armed_overhead": twin_overhead["twin_overhead"],
+        # the 3% bar is the STANDALONE acceptance number; the only
+        # in-bench hard assert is the order-of-magnitude backstop
+        # (whole-bench heap wake swings the ratio double digits —
+        # docstring)
+        "armed_overhead_bar_standalone": 0.03,
+        "armed_overhead_backstop": 0.5,
     }
 
 
@@ -1678,6 +1805,11 @@ def main():
     # nothing it allocates lingers under the device measurements
     twin_overhead = twin_overhead_benchmark()
 
+    # fleet ingest rides the same host-side tier and inherits the
+    # twin rider's armed-vs-off bar (the digest columns must fit
+    # inside the same 3% budget)
+    fleet_ingest = fleet_ingest_benchmark(twin_overhead)
+
     # warm-start benchmark FIRST of the device measurements: its cold
     # pass must be the first compile of the batched VOD program in
     # this process — run after the grid benchmark below, the AOT
@@ -1768,6 +1900,7 @@ def main():
     detail["tracker_churn"] = tracker_churn
     detail["announce_storm"] = announce_storm
     detail["twin_overhead"] = twin_overhead
+    detail["fleet_ingest"] = fleet_ingest
     # the one-pass stencil A/B runs LAST of the in-process
     # measurements: its 1M-peer buffers would fragment the heap
     # under everything above

@@ -113,6 +113,8 @@ class CdnOnlyAgent:
             self._stats.cdn += delta
             self._stats.note_fetch_bytes("cdn", delta)
             self._stats.note_fetch_done("cdn")
+            self._stats.note_fetch_ms("cdn",
+                                      self.clock.now() - t_start)
             state["last_reported"] = len(data)
             callbacks["on_success"](data)
 

@@ -11,11 +11,15 @@ calibrated twin (round 12) that gives every forecast a MEASURED error
 bar.  This module is the loop itself.  Each control tick:
 
 1. **observe** — :class:`ObservationIngest` tail-follows the live
-   flight-recorder shard (torn-tail tolerant, the journal reader's
-   discipline) and reduces the ``twin.*`` provenance + membership
-   events through :class:`~.twinframe.EventFrameFeeder` — EXACTLY
-   :func:`~.twinframe.frames_from_events`' window partitioning,
-   incrementally: one closed observation window is one control tick.
+   flight-recorder shard — or a fleet's shard LIST, merged on the
+   virtual window clock by :class:`~.twinframe.ShardMuxFollower`
+   with explicit per-shard watermarks (torn-tail tolerant per shard,
+   the journal reader's discipline) — and reduces the ``twin.*``
+   provenance + membership events through the shared frame reducer:
+   EXACTLY :func:`~.twinframe.frames_from_events`' window
+   partitioning, incrementally; one closed (merged) observation
+   window is one control tick, and the decisions are bit-identical
+   whether the traffic arrives as one shard or split across four.
 2. **predict** — observed membership becomes a forecast scenario
    (``testing/twin.scenario_from_observation``: observed joins AND
    departures on the calibrated parity mapping's lanes, absent lanes
@@ -65,7 +69,16 @@ from .artifact_cache import _digest, atomic_write_json
 from .protocol import KnobUpdate, SetKnobs, decode, encode
 from .search import Constraint, rank_key
 from .telemetry import MetricsRegistry
-from .twinframe import FRAME_COLUMNS, EventFrameFeeder
+# ShardFollower moved to engine/twinframe.py in the fleet
+# observation round (the mux reuses its torn-tail discipline
+# per shard); re-exported here so existing imports keep working
+from .twinframe import (FRAME_COLUMNS, ShardFollower,
+                        ShardMuxFollower)
+
+__all__ = ["ShardFollower", "ObservationIngest", "ControlConfig",
+           "ControlLoop", "TransportActuator", "LogActuator",
+           "band_halfwidth", "decide_tick", "control_checkpoint_path",
+           "TICK_PHASES"]
 
 #: the tick phases whose walls the loop records (bench.py
 #: ``detail.control_tick`` reads them): observe → predict → decide →
@@ -74,75 +87,49 @@ TICK_PHASES = ("ingest", "reconstruct", "forecast", "decide",
                "actuate", "checkpoint")
 
 
-class ShardFollower:
-    """Tolerant tail-follow of one flight-recorder shard: each
-    :meth:`poll` yields the records that became COMPLETE since the
-    last poll — only whole lines are consumed (a torn tail stays
-    buffered in the file until its newline lands), and a line that
-    fails to parse is skipped, the ``read_jsonl_tolerant``
-    discipline applied to a growing file."""
-
-    def __init__(self, path: str):
-        self.path = path
-        self._offset = 0
-
-    def poll(self) -> List[dict]:
-        try:
-            with open(self.path, "rb") as fh:
-                fh.seek(self._offset)
-                data = fh.read()
-        except OSError:
-            return []
-        end = data.rfind(b"\n")
-        if end < 0:
-            return []
-        chunk = data[:end + 1]
-        self._offset += len(chunk)
-        records = []
-        for line in chunk.split(b"\n"):
-            if not line.strip():
-                continue
-            try:
-                records.append(json.loads(line))
-            except ValueError:
-                continue  # torn/corrupt line: skip, never raise
-        return records
-
-
 class ObservationIngest:
     """The observe leg: shard tail-follow + the incremental frame
-    reducer.  ``poll()`` returns the frame rows whose windows closed
-    since the last poll (``twin_window`` marks partition the stream
-    exactly where the live sampler stood), and :meth:`membership`
-    exposes the observed join/leave clocks the forecast scenario is
-    reconstructed from."""
+    reducer, for ONE shard or a FLEET of them.  ``shard_paths`` may
+    be a single path (the round-13 signature, byte-compatible) or a
+    list — the fleet case, merged on the virtual window clock by
+    :class:`~.twinframe.ShardMuxFollower` with explicit per-shard
+    watermarks, so the controller's decisions are bit-identical
+    whether the same traffic arrives as one shard or split across
+    four (``make slo-gate`` asserts exactly that).  ``poll()``
+    returns the frame rows whose merged windows closed since the
+    last poll, :meth:`membership_at` exposes the per-window observed
+    join/leave snapshots the forecast scenario is reconstructed
+    from, and :attr:`exclusions` records which shards each window
+    closed WITHOUT (a dead shard is excluded-and-counted, never
+    silently merged)."""
 
-    def __init__(self, shard_path: str, source: str = "real"):
-        self.follower = ShardFollower(shard_path)
-        self.feeder = EventFrameFeeder(source)
-        self.rows: List[Tuple[float, ...]] = []
-        #: per-window ``(join_ms, leave_ms)`` snapshots, captured the
-        #: moment each window's mark was fed — NOT the live builder
-        #: state, so a batch replay of a finished shard reconstructs
-        #: the same per-tick view an incremental tail-follow saw (the
-        #: resume-determinism contract)
-        self.memberships: List[Tuple[Dict[str, float],
-                                     Dict[str, float]]] = []
+    def __init__(self, shard_paths, source: str = "real", *,
+                 dead_after_polls: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        paths = ([shard_paths] if isinstance(shard_paths, str)
+                 else list(shard_paths))
+        self.mux = ShardMuxFollower(
+            paths, source=source, dead_after_polls=dead_after_polls,
+            registry=registry)
+
+    @property
+    def rows(self) -> List[Tuple[float, ...]]:
+        return self.mux.rows
+
+    @property
+    def memberships(self):
+        return self.mux.memberships
+
+    @property
+    def exclusions(self) -> List[Tuple[str, ...]]:
+        return self.mux.exclusions
 
     def poll(self) -> List[Tuple[float, ...]]:
-        new = []
-        for event in self.follower.poll():
-            row = self.feeder.feed(event)
-            if row is not None:
-                new.append(row)
-                self.memberships.append(
-                    self.feeder.builder.membership())
-        self.rows.extend(new)
-        return new
+        return self.mux.poll()
 
     def membership_at(self, window: int) \
             -> Tuple[Dict[str, float], Dict[str, float]]:
-        return self.memberships[window]
+        return self.mux.membership_at(window)
 
 
 @dataclass
@@ -413,17 +400,23 @@ class ControlLoop:
     ``recorder`` arms the flight-recorder marks; ``wall`` is the
     injectable phase-timing clock (tools/lint.py discipline)."""
 
-    def __init__(self, config: ControlConfig, shard_path: str,
+    def __init__(self, config: ControlConfig, shard_path,
                  actuator, *, warm_start=None,
                  registry: Optional[MetricsRegistry] = None,
                  recorder=None, checkpoint_path: Optional[str] = None,
+                 dead_after_polls: Optional[int] = None,
                  wall: Callable[[], float] = time.perf_counter):
         self.config = config
-        self.ingest = ObservationIngest(shard_path)
         self.actuator = actuator
         self.warm_start = warm_start
         self.registry = registry if registry is not None \
             else MetricsRegistry()
+        #: ``shard_path`` may be one path or a list of them (the
+        #: fleet ingest; ObservationIngest muxes on the window clock
+        #: and the decisions are layout-independent by construction)
+        self.ingest = ObservationIngest(
+            shard_path, dead_after_polls=dead_after_polls,
+            registry=self.registry)
         self.recorder = recorder
         self.checkpoint_path = checkpoint_path
         self.digest = _digest(config.identity())

@@ -1,0 +1,208 @@
+"""Deterministic mergeable quantile digests for fleet telemetry.
+
+The fleet observation plane needs TAIL metrics — "what is fleet p99
+rebuffer, per cohort" — computed across shards whose merge ORDER is
+an accident of filesystem listing and poll timing.  Classic sketches
+(t-digest, GK) trade that determinism away: their bin boundaries
+depend on insertion order (t-digest centroids drift with the stream),
+so two hosts folding the same observations in different orders report
+different p99s, and a gate asserting "4-shard merge == single shard"
+can never be exact.  This module's sketch is the boring opposite, on
+purpose:
+
+- **fixed log-spaced bins** (:func:`log_edges`): the bin layout is a
+  pure function of ``(lo, hi, bins)`` — no data-dependent boundaries,
+  no RNG, nothing to seed (tools/lint.py enforces the no-RNG rule on
+  this file);
+- **integer bin counts**: ``add`` is a counter bump, ``merge`` is
+  element-wise integer addition — associative AND commutative by
+  construction, so any fold order over any shard partition yields the
+  IDENTICAL digest (tests/test_digest.py holds this as a property
+  across seeds and permutations);
+- **quantiles from counts alone** (:func:`quantiles_from_counts`):
+  the reported quantile is a deterministic function of the counts —
+  underflow reads 0 (below the resolution floor), an interior bin
+  reads its geometric midpoint, overflow reads the top edge — so a
+  quantile can never depend on anything but the multiset of binned
+  observations.
+
+The price is bounded relative resolution (each bin spans a fixed
+ratio, ~1.6× at the default layout) instead of t-digest's adaptive
+tails — the right trade here, because the twin bands that consume
+these quantiles are measured envelopes far wider than one bin.
+
+The jnp plane computes the SAME digest from timeline arrays
+(ops/swarm_sim.py ``stall_digest``: per-peer interval stall binned
+with :func:`log_edges` via ``searchsorted``), which is what lets the
+twin band tail metrics, not just means.  The registry instrument
+wrapper lives in engine/telemetry.py (:class:`~.telemetry.Digest`),
+next to counter/gauge/histogram.
+
+Pure stdlib, no numpy/jax — digests travel with artifacts and reduce
+anywhere (the twinframe/triage discipline).
+"""
+
+from __future__ import annotations
+
+import math
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+#: the default bin layout for millisecond-scale latency/stall
+#: families (rebuffer accrual, fetch walls, announce RTTs): 1 ms
+#: resolution floor to a 120 s ceiling, 24 bins — ~1.62× relative
+#: resolution per bin, far inside the committed twin bands
+DEFAULT_LO_MS = 1.0
+DEFAULT_HI_MS = 120_000.0
+DEFAULT_BINS = 24
+
+#: the quantiles the observation plane reports everywhere (frame
+#: columns, SLO objectives, console panels) — one list, so no two
+#: consumers can disagree about what "tail" means
+REPORTED_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def log_edges(lo: float = DEFAULT_LO_MS, hi: float = DEFAULT_HI_MS,
+              bins: int = DEFAULT_BINS) -> Tuple[float, ...]:
+    """The ``bins + 1`` log-spaced bin edges from ``lo`` to ``hi``
+    (inclusive ends, geometric spacing).  A pure function of its
+    arguments — the determinism anchor: every digest sharing a
+    layout shares these exact floats, host and jnp plane alike."""
+    if not (0.0 < lo < hi):
+        raise ValueError(f"need 0 < lo < hi, got ({lo}, {hi})")
+    if bins < 1:
+        raise ValueError(f"need >= 1 bin, got {bins}")
+    ratio = math.log(hi / lo) / bins
+    edges = [lo * math.exp(i * ratio) for i in range(bins)]
+    edges.append(float(hi))  # exact, not exp-rounded
+    return tuple(edges)
+
+
+#: the shared default layout (module docstring)
+DEFAULT_EDGES = log_edges()
+
+
+def bin_index(edges: Sequence[float], value: float) -> int:
+    """Which of the ``len(edges) + 1`` bins ``value`` lands in:
+    bin 0 is the underflow (``value <= edges[0]``, zeros included),
+    bin ``i`` holds ``edges[i-1] < value <= edges[i]``, and the last
+    bin is the overflow (``value > edges[-1]``).  ``bisect_left``
+    semantics — the jnp plane's ``searchsorted(..., side="left")``
+    computes the identical index."""
+    return bisect_left(edges, value)
+
+
+def quantiles_from_counts(edges: Sequence[float],
+                          counts: Sequence[int],
+                          qs: Iterable[float] = REPORTED_QUANTILES
+                          ) -> List[float]:
+    """Deterministic quantile estimates from a bin-count vector
+    (``len(edges) + 1`` long, :func:`bin_index` layout).
+
+    The estimate for rank ``ceil(q * n)``'s bin: 0.0 for the
+    underflow bin (mass below the resolution floor reads as zero —
+    honest for stall/latency families where "under 1 ms" IS zero),
+    the geometric midpoint for an interior bin, the top edge for the
+    overflow bin (a deliberately clamped, never-extrapolated tail).
+    An empty digest reports 0.0 for every quantile."""
+    total = sum(counts)
+    out = []
+    for q in qs:
+        if total <= 0:
+            out.append(0.0)
+            continue
+        rank = max(1, math.ceil(q * total))
+        cum = 0
+        idx = len(counts) - 1
+        for i, n in enumerate(counts):
+            cum += n
+            if cum >= rank:
+                idx = i
+                break
+        if idx == 0:
+            out.append(0.0)
+        elif idx >= len(edges):
+            out.append(float(edges[-1]))
+        else:
+            out.append(math.sqrt(edges[idx - 1] * edges[idx]))
+    return out
+
+
+class QuantileDigest:
+    """One mergeable sketch: fixed edges + integer bin counts.
+
+    ``add``/``add_binned`` feed it, ``merge`` folds another digest
+    of the SAME layout in (layout mismatch is a hard error — two
+    different layouts have no common refinement, and silently
+    rebinning would break the exactness contract), and
+    :meth:`quantile` / :meth:`quantiles` read it.  Not thread-safe;
+    the registry instrument (engine/telemetry.py) adds the lock."""
+
+    __slots__ = ("edges", "counts")
+
+    def __init__(self, edges: Sequence[float] = DEFAULT_EDGES,
+                 counts: Sequence[int] = None):
+        self.edges = tuple(float(e) for e in edges)
+        if counts is None:
+            self.counts = [0] * (len(self.edges) + 1)
+        else:
+            self.counts = [int(n) for n in counts]
+            if len(self.counts) != len(self.edges) + 1:
+                raise ValueError(
+                    f"counts length {len(self.counts)} does not fit "
+                    f"{len(self.edges)} edges (+ under/overflow)")
+
+    def add(self, value: float, n: int = 1) -> None:
+        self.counts[bin_index(self.edges, value)] += n
+
+    def add_binned(self, counts: Sequence[int]) -> None:
+        """Fold a raw bin-count vector (the jnp plane's timeline
+        columns) — the cross-plane feeder."""
+        if len(counts) != len(self.counts):
+            raise ValueError(
+                f"binned vector length {len(counts)} != "
+                f"{len(self.counts)}")
+        for i, n in enumerate(counts):
+            self.counts[i] += int(n)
+
+    def merge(self, other: "QuantileDigest") -> "QuantileDigest":
+        if other.edges != self.edges:
+            raise ValueError("digest layouts differ — refusing a "
+                             "silently-rebinned merge")
+        for i, n in enumerate(other.counts):
+            self.counts[i] += n
+        return self
+
+    @property
+    def count(self) -> int:
+        return sum(self.counts)
+
+    def quantile(self, q: float) -> float:
+        return quantiles_from_counts(self.edges, self.counts, (q,))[0]
+
+    def quantiles(self, qs: Iterable[float] = REPORTED_QUANTILES
+                  ) -> List[float]:
+        return quantiles_from_counts(self.edges, self.counts, qs)
+
+    def read(self) -> Dict[str, float]:
+        """The reporting view (the registry instrument's ``read()``):
+        count plus the standard quantile trio."""
+        p50, p95, p99 = self.quantiles(REPORTED_QUANTILES)
+        return {"count": self.count, "p50": round(p50, 6),
+                "p95": round(p95, 6), "p99": round(p99, 6)}
+
+    def as_dict(self) -> dict:
+        return {"edges": list(self.edges), "counts": list(self.counts)}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "QuantileDigest":
+        return cls(edges=data["edges"], counts=data["counts"])
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, QuantileDigest)
+                and self.edges == other.edges
+                and self.counts == other.counts)
+
+    def __repr__(self) -> str:
+        return (f"QuantileDigest(n={self.count}, "
+                f"bins={len(self.counts)})")

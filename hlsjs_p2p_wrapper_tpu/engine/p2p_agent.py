@@ -221,7 +221,8 @@ class P2PAgent:
                 announce_interval_ms=cfg.get("announce_interval_ms",
                                              DEFAULT_ANNOUNCE_INTERVAL_MS),
                 on_peers=lambda peers: self.mesh.on_tracker_peers(peers),
-                on_knobs=self._apply_knobs)
+                on_knobs=self._apply_knobs,
+                registry=self.metrics_registry)
             # frames claiming to be FROM the tracker are trusted
             # (TrackerClient matches on src id); on a fabric where
             # inbound identity is self-declared, forbid peers from
@@ -422,6 +423,7 @@ class P2PAgent:
             # twin provenance: same delta, additive view (stats.py)
             self._stats.note_fetch_bytes("p2p", len(payload))
             self._stats.note_fetch_done("p2p")
+            self._stats.note_fetch_ms("p2p", duration)
             request.finish()
             self._store(key, payload, duration)
             callbacks["on_success"](payload)
@@ -479,6 +481,7 @@ class P2PAgent:
             self._stats.note_fetch_bytes("cdn", delta)
             self._stats.note_fetch_done("cdn")
             duration = self.clock.now() - t_start
+            self._stats.note_fetch_ms("cdn", duration)
             request.finish()
             self._store(key, data, duration)
             callbacks["on_success"](data)

@@ -18,7 +18,7 @@ from __future__ import annotations
 import itertools
 from typing import Optional
 
-from .telemetry import Counter, Gauge, MetricsRegistry
+from .telemetry import Counter, Digest, Gauge, MetricsRegistry
 
 #: fallback labels for registry-bound stats built without a peer id:
 #: two anonymous agents sharing a registry must NOT resolve to the
@@ -61,6 +61,9 @@ class AgentStats:
             self._fetches = {
                 src: Counter("twin.fetches", {**labels, "src": src})
                 for src in ("cdn", "p2p")}
+            self._fetch_ms = {
+                src: Digest("slo.fetch_ms", {"src": src})
+                for src in ("cdn", "p2p")}
         else:
             self._cdn = registry.counter("agent.cdn_bytes", **labels)
             self._p2p = registry.counter("agent.p2p_bytes", **labels)
@@ -74,6 +77,14 @@ class AgentStats:
             self._fetches = {
                 src: registry.counter("twin.fetches", src=src,
                                       **labels)
+                for src in ("cdn", "p2p")}
+            # the fetch-latency digest is deliberately NOT per-peer:
+            # a fleet p99 is one order-independent merge of per-src
+            # sketches (engine/digest.py), and per-peer instruments
+            # would multiply registry cardinality for a statistic
+            # whose whole point is aggregation
+            self._fetch_ms = {
+                src: registry.digest("slo.fetch_ms", src=src)
                 for src in ("cdn", "p2p")}
 
     @property
@@ -132,6 +143,13 @@ class AgentStats:
         lets tools/soak.py catch an agent reporting bytes without
         matching fetch events."""
         self._fetches[src].inc()
+
+    def note_fetch_ms(self, src: str, ms: float) -> None:
+        """One completed fetch's wall (engine clock ms) into the
+        ``slo.fetch_ms{src}`` quantile digest — the fleet tail-
+        latency instrument (engine/digest.py; the SLO layer and the
+        console read its p50/p95/p99)."""
+        self._fetch_ms[src].observe(ms)
 
     def as_dict(self) -> dict:
         return {"cdn": self.cdn, "p2p": self.p2p, "upload": self.upload,

@@ -24,6 +24,12 @@ Three instrument kinds, deliberately tiny:
 - :class:`Gauge` — last-write-wins point-in-time value.
 - :class:`Histogram` — fixed upper-bound buckets plus count/sum,
   Prometheus-style cumulative ``le`` semantics on read.
+- :class:`Digest` — a locked wrapper around the fleet observation
+  plane's mergeable quantile sketch (engine/digest.py): fixed
+  log-spaced bins, integer counts, order-independent merge.  The
+  tail-latency instrument (``slo.fetch_ms``, ``slo.announce_rtt_ms``)
+  — a histogram answers "how many under X", a digest answers
+  "what IS p99", and its counts fold across hosts exactly.
 
 Instruments are keyed by ``(name, labels)``: the registry memoizes,
 so ``registry.counter("net.handshake_rejects", reason="psk")`` is a
@@ -46,6 +52,8 @@ import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, Iterable, List, Optional, Tuple
+
+from .digest import DEFAULT_EDGES, QuantileDigest
 
 #: default histogram upper bounds (ms-ish scale); pass ``buckets=`` to
 #: :meth:`MetricsRegistry.histogram` for anything domain-specific
@@ -200,6 +208,55 @@ class Histogram:
             return self._count
 
 
+class Digest:
+    """Streaming quantile sketch instrument (engine/digest.py
+    :class:`~.digest.QuantileDigest` under the Counter lock
+    discipline): ``observe(v)`` bins one observation, ``read()``
+    reports count + p50/p95/p99, :meth:`merge_into` folds this
+    instrument into a plain digest (the fleet aggregation path —
+    order-independent by the sketch's construction).  The bin layout
+    is fixed at construction; a memoized re-request with a DIFFERENT
+    explicit layout is refused like Histogram's bucket rule."""
+
+    kind = "digest"
+
+    def __init__(self, name: str, labels: Optional[Dict] = None,
+                 edges: Iterable[float] = DEFAULT_EDGES):
+        self.name = name
+        self.labels = _label_key(labels or {})
+        self._lock = threading.Lock()
+        self._digest = QuantileDigest(edges)
+
+    @property
+    def edges(self) -> Tuple[float, ...]:
+        return self._digest.edges
+
+    def observe(self, value) -> None:
+        with self._lock:
+            self._digest.add(float(value))
+
+    def merge_into(self, target: QuantileDigest) -> QuantileDigest:
+        """Fold this instrument's counts into ``target`` (same
+        layout required) — a snapshot-consistent read under the
+        lock."""
+        with self._lock:
+            return target.merge(self._digest)
+
+    def snapshot(self) -> QuantileDigest:
+        with self._lock:
+            return QuantileDigest(self._digest.edges,
+                                  list(self._digest.counts))
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._digest.count
+
+    def read(self) -> Dict:
+        with self._lock:
+            return self._digest.read()
+
+
 class MetricsRegistry:
     """One process-wide (or harness-wide) instrument store.
 
@@ -237,12 +294,16 @@ class MetricsRegistry:
     def _get(self, cls, name: str, labels: Dict, **kwargs):
         key = (name, _label_key(labels))
         buckets = kwargs.pop("buckets", None)
+        edges = kwargs.pop("edges", None)
         with self._lock:
             inst = self._instruments.get(key)
             if inst is None:
                 if cls is Histogram:
                     kwargs["buckets"] = (DEFAULT_BUCKETS
                                          if buckets is None else buckets)
+                if cls is Digest:
+                    kwargs["edges"] = (DEFAULT_EDGES
+                                       if edges is None else edges)
                 inst = cls(name, labels, **kwargs)
                 if cls is Counter:
                     inst._listeners = self._bump_listeners
@@ -250,6 +311,14 @@ class MetricsRegistry:
             elif not isinstance(inst, cls):
                 raise ValueError(
                     f"{name!r} already registered as {inst.kind}")
+            elif edges is not None and inst.edges != tuple(
+                    float(e) for e in edges):
+                # the Histogram explicit-bucket rule, for digests: a
+                # memoized hit must not silently drop a DIFFERENT
+                # explicit bin layout
+                raise ValueError(
+                    f"{name!r} already registered with edges "
+                    f"{inst.edges}")
             elif buckets is not None and inst.buckets != tuple(
                     sorted(float(b) for b in buckets)):
                 # a memoized hit must not silently drop an EXPLICIT
@@ -273,6 +342,11 @@ class MetricsRegistry:
                   buckets: Optional[Iterable[float]] = None,
                   **labels) -> Histogram:
         return self._get(Histogram, name, labels, buckets=buckets)
+
+    def digest(self, name: str, *,
+               edges: Optional[Iterable[float]] = None,
+               **labels) -> Digest:
+        return self._get(Digest, name, labels, edges=edges)
 
     def _items(self):
         with self._lock:
@@ -315,8 +389,10 @@ class MetricsRegistry:
     def delta(self, prev: Dict[str, object]) -> Dict[str, object]:
         """Current snapshot minus ``prev`` (a prior ``snapshot()``):
         counters subtract, histogram bucket counts/count/sum
-        subtract, gauges pass through unchanged.  Keys absent from
-        ``prev`` diff against zero."""
+        subtract, gauges — and digests, whose quantiles are
+        point-in-time summaries a subtraction would scramble — pass
+        through unchanged.  Keys absent from ``prev`` diff against
+        zero."""
         out = {}
         for (name, labels), inst in self._items():
             key = _format_key(name, labels)

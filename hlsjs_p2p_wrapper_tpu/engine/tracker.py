@@ -1127,7 +1127,8 @@ class TrackerClient:
                  tracker_peer_id: str = TRACKER_PEER_ID,
                  announce_interval_ms: float = DEFAULT_ANNOUNCE_INTERVAL_MS,
                  on_peers: Optional[Callable[[Tuple[str, ...]], None]] = None,
-                 on_knobs: Optional[Callable[[int, dict], None]] = None):
+                 on_knobs: Optional[Callable[[int, dict], None]] = None,
+                 registry=None):
         self.endpoint = endpoint
         self.swarm_id = swarm_id
         self.peer_id = peer_id
@@ -1137,6 +1138,14 @@ class TrackerClient:
         self.on_peers = on_peers
         self.on_knobs = on_knobs
         self.known_peers: Tuple[str, ...] = ()
+        #: announce→PEERS round-trip digest (engine/digest.py): the
+        #: control-plane tail-latency instrument the fleet
+        #: observation layer reads as ``slo.announce_rtt_ms`` —
+        #: only the FIRST Peers after each announce is an RTT
+        #: sample (later pushes are piggybacks, not replies)
+        self._rtt_digest = (registry.digest("slo.announce_rtt_ms")
+                            if registry is not None else None)
+        self._announced_at_ms: Optional[float] = None
         #: last APPLIED knob epoch — the idempotency floor: the
         #: tracker piggybacks the current epoch on every answered
         #: announce, so the same update arrives many times and must
@@ -1168,6 +1177,11 @@ class TrackerClient:
             return False
         if isinstance(frame_msg, Peers):
             if frame_msg.swarm_id == self.swarm_id:
+                if self._rtt_digest is not None \
+                        and self._announced_at_ms is not None:
+                    self._rtt_digest.observe(
+                        self.clock.now() - self._announced_at_ms)
+                    self._announced_at_ms = None
                 self.known_peers = frame_msg.peer_ids
                 if self.on_peers is not None:
                     self.on_peers(frame_msg.peer_ids)
@@ -1185,6 +1199,7 @@ class TrackerClient:
     def _announce(self) -> None:
         if self._stopped:
             return
+        self._announced_at_ms = self.clock.now()
         self.endpoint.send(self.tracker_peer_id,
                            encode(Announce(self.swarm_id, self.peer_id)))
         self._timer = self.clock.call_later(self.announce_interval_ms,

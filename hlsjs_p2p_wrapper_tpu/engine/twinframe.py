@@ -50,6 +50,19 @@ BOTH planes in it:
   ``TWIN_r10.json``), and :func:`frame_errors` is the console's
   per-metric max-error panel.
 
+The fleet observation round widened the module in two directions:
+the frame carries TAIL columns (:data:`QUANTILE_COLUMNS` — the
+per-window per-peer interval stall distribution's p50/p95/p99,
+computed through the ONE mergeable digest definition in
+engine/digest.py by both planes), and ingest scales from one shard
+to a fleet: :class:`ShardFollower` (moved here from the controller)
+tail-follows one shard, and :class:`ShardMuxFollower` merges N of
+them on the virtual window clock with explicit per-shard watermarks
+— merged rows bit-identical to single-shard ingest under any peer
+partition, dead shards excluded-and-counted (``mux.*`` families),
+per-shard sub-frames for the SLO layer's attribution
+(engine/slo.py).  :func:`frames_from_shards` is the batch form.
+
 Pure stdlib + host arithmetic — no jax import, so frames compare
 anywhere the artifacts travel (the triage-tool discipline).  Frames
 carry VirtualClock-derived timestamps only; this file is under
@@ -59,15 +72,31 @@ here is a lint failure by construction.
 
 from __future__ import annotations
 
+import json
+import os
+from collections import deque
 from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+from .digest import (DEFAULT_EDGES, QuantileDigest,
+                     quantiles_from_counts)
+
+#: the per-window tail columns (the fleet observation round): the
+#: per-peer interval stall distribution's quantile trio, computed
+#: through ONE digest definition in both planes (engine/digest.py;
+#: the jnp kernel bins the same values with the same edges via
+#: ``stall_digest`` timeline columns) — so the twin can band p99
+#: rebuffer, not just the mean
+QUANTILE_COLUMNS = ("rebuffer_ms_p50", "rebuffer_ms_p95",
+                    "rebuffer_ms_p99")
 
 #: the canonical frame vocabulary, shared with the jnp kernel's
 #: ``timeline_columns``: sample clock, cumulative north-star pair,
 #: interval byte rates, interval stall count — plus the membership
 #: columns the twin comparison adds (presence and join/leave counts)
+#: and the per-window stall-quantile trio
 FRAME_COLUMNS = ("t_s", "offload", "rebuffer", "cdn_rate_bps",
                  "p2p_rate_bps", "stalled_peers", "present_peers",
-                 "joins", "leaves")
+                 "joins", "leaves") + QUANTILE_COLUMNS
 
 
 class ObservationFrame(NamedTuple):
@@ -135,6 +164,18 @@ class FrameBuilder:
         self._prev_cdn = 0.0
         self._prev_p2p = 0.0
         self._prev_t_ms = 0.0
+        #: per-peer stall totals at the previous window close — the
+        #: interval view the quantile digest bins (QUANTILE_COLUMNS)
+        self._prev_stall: Dict[str, float] = {}
+        #: per-(peer, src) byte totals at the previous window close —
+        #: the interval view behind ``last_peer_p2p_bytes``
+        self._prev_bytes: Dict[Tuple[str, str], float] = {}
+        #: the last closed window's per-peer interval stall / interval
+        #: P2P bytes (present peers only) — the SLO layer's
+        #: cohort-attribution inputs (engine/slo.py), snapshotted so
+        #: a consumer never reads half-advanced builder state
+        self.last_peer_stall_ms: Dict[str, float] = {}
+        self.last_peer_p2p_bytes: Dict[str, float] = {}
         self._first = True
         self._rows: List[Tuple[float, ...]] = []
 
@@ -197,6 +238,9 @@ class FrameBuilder:
         present = 0
         joins = 0
         leaves = 0
+        stall_digest = QuantileDigest(DEFAULT_EDGES)
+        peer_stall: Dict[str, float] = {}
+        peer_p2p: Dict[str, float] = {}
         for peer in sorted(self._join_ms):
             j = self._join_ms[peer]
             leave = self._leave_ms.get(peer)
@@ -204,6 +248,17 @@ class FrameBuilder:
             watched += max(end - j, 0.0)
             if j <= t_ms and (leave is None or leave > t_ms):
                 present += 1
+                # the interval stall digest counts PRESENT peers
+                # (zeros included: p50 of a healthy window IS 0) —
+                # the same present-mask convention the jnp plane's
+                # stall_digest columns apply at the sample clock
+                interval = (self._stall_ms.get(peer, 0.0)
+                            - self._prev_stall.get(peer, 0.0))
+                peer_stall[peer] = interval
+                stall_digest.add(interval)
+                key = (peer, "p2p")
+                peer_p2p[peer] = (self._bytes.get(key, 0.0)
+                                  - self._prev_bytes.get(key, 0.0))
             if _in_window(j, self._prev_t_ms, t_ms, self._first):
                 joins += 1
             if _in_window(leave, self._prev_t_ms, t_ms, self._first):
@@ -214,10 +269,15 @@ class FrameBuilder:
                (cdn - self._prev_cdn) * 8.0 / dt_s,
                (p2p - self._prev_p2p) * 8.0 / dt_s,
                float(len(self._stalled)), float(present),
-               float(joins), float(leaves))
+               float(joins), float(leaves)) \
+            + tuple(stall_digest.quantiles())
         self._prev_cdn = cdn
         self._prev_p2p = p2p
         self._prev_t_ms = t_ms
+        self._prev_stall = dict(self._stall_ms)
+        self._prev_bytes = dict(self._bytes)
+        self.last_peer_stall_ms = peer_stall
+        self.last_peer_p2p_bytes = peer_p2p
         self._first = False
         self._stalled = set()
         self._rows.append(row)
@@ -259,6 +319,33 @@ TWIN_EVENT_FAMILIES = ("twin.fetch_bytes", "twin.fetches",
 TWIN_WINDOW_MARK = "twin_window"
 
 
+def feed_builder_event(builder: FrameBuilder, event: dict) -> bool:
+    """Apply one NON-MARK flight-recorder event's ``twin.*``
+    provenance to a :class:`FrameBuilder` — the ONE event vocabulary
+    shared by the single-shard reducer (:class:`EventFrameFeeder`)
+    and the multi-shard mux (:class:`ShardMuxFollower`), so the two
+    ingest paths can never drift on what a bump means.  Returns True
+    when the event carried provenance."""
+    if event.get("kind") != "counter":
+        return False
+    name = event.get("name", "")
+    if not name.startswith("twin."):
+        return False
+    labels = parse_labels(event.get("labels", ""))
+    peer = labels.get("peer", "")
+    n = event.get("n", 0)
+    if name == "twin.fetch_bytes":
+        builder.add_bytes(peer, labels.get("src", ""), n)
+    elif name == "twin.stall_ms":
+        builder.add_stall(peer, n)
+    elif name == "twin.peer":
+        if labels.get("event") == "join":
+            builder.set_join(peer, event.get("t", 0.0))
+        elif labels.get("event") == "leave":
+            builder.set_leave(peer, event.get("t", 0.0))
+    return True
+
+
 class EventFrameFeeder:
     """The event-replay extractor as an INCREMENTAL reducer: feed
     flight-recorder events one at a time (in SHARD ORDER) and a
@@ -278,30 +365,14 @@ class EventFrameFeeder:
     def feed(self, event: dict) -> Optional[Tuple[float, ...]]:
         """One event; returns the closed frame row when ``event`` is
         a window mark, else None."""
-        kind = event.get("kind")
-        if kind == "mark" and event.get("name") == TWIN_WINDOW_MARK:
+        if event.get("kind") == "mark" \
+                and event.get("name") == TWIN_WINDOW_MARK:
             if self.windows == 0:
                 self.builder.window_s = \
                     event.get("window_ms", 0.0) / 1000.0
             self.windows += 1
             return self.builder.close_window(event.get("t", 0.0))
-        if kind != "counter":
-            return None
-        name = event.get("name", "")
-        if not name.startswith("twin."):
-            return None
-        labels = parse_labels(event.get("labels", ""))
-        peer = labels.get("peer", "")
-        n = event.get("n", 0)
-        if name == "twin.fetch_bytes":
-            self.builder.add_bytes(peer, labels.get("src", ""), n)
-        elif name == "twin.stall_ms":
-            self.builder.add_stall(peer, n)
-        elif name == "twin.peer":
-            if labels.get("event") == "join":
-                self.builder.set_join(peer, event.get("t", 0.0))
-            elif labels.get("event") == "leave":
-                self.builder.set_leave(peer, event.get("t", 0.0))
+        feed_builder_event(self.builder, event)
         return None
 
     def frame(self) -> ObservationFrame:
@@ -326,6 +397,392 @@ def frames_from_events(events: Iterable[dict], *,
     return feeder.frame()
 
 
+# -- multi-shard ingest (the fleet observation round) -------------------
+
+class ShardFollower:
+    """Tolerant tail-follow of one flight-recorder shard: each
+    :meth:`poll` yields the records that became COMPLETE since the
+    last poll — only whole lines are consumed (a torn tail stays
+    buffered in the file until its newline lands), and a line that
+    fails to parse is skipped, the ``read_jsonl_tolerant``
+    discipline applied to a growing file.  (Moved here from
+    engine/controller.py so the mux below can reuse it without the
+    observation plane importing the control plane.)"""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+
+    def poll(self) -> List[dict]:
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(self._offset)
+                data = fh.read()
+        except OSError:
+            return []
+        end = data.rfind(b"\n")
+        if end < 0:
+            return []
+        chunk = data[:end + 1]
+        self._offset += len(chunk)
+        records = []
+        for line in chunk.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # torn/corrupt line: skip, never raise
+        return records
+
+
+class _MuxLane:
+    """One shard's buffered view inside the mux: the tail-follower,
+    the open (un-marked) event tail, and the completed window
+    segments — ``(mark, events)`` pairs — awaiting the merge."""
+
+    __slots__ = ("shard_id", "follower", "open_events", "segments",
+                 "started", "dead", "stall_polls")
+
+    def __init__(self, shard_id: str, path: str):
+        self.shard_id = shard_id
+        self.follower = ShardFollower(path)
+        self.open_events: List[dict] = []
+        self.segments: deque = deque()
+        self.started = False
+        self.dead = False
+        self.stall_polls = 0
+
+    def ingest(self) -> bool:
+        """Poll the follower, partition new records into window
+        segments at the ``twin_window`` marks; True when anything
+        new arrived (the mux's liveness evidence)."""
+        records = self.follower.poll()
+        for event in records:
+            if event.get("kind") == "mark" \
+                    and event.get("name") == TWIN_WINDOW_MARK:
+                self.segments.append((event, self.open_events))
+                self.open_events = []
+            else:
+                self.open_events.append(event)
+        if records:
+            self.started = True
+        return bool(records)
+
+
+class ShardMuxFollower:
+    """Tail-follow N flight-recorder shards and merge them into ONE
+    canonical frame stream on the virtual window clock.
+
+    Each shard keeps :class:`ShardFollower`'s torn-tail / corrupt-
+    line discipline; per-shard ``twin_window`` marks are the
+    WATERMARKS: a merged window closes only when every LIVE shard's
+    watermark has passed it (its segment for that window is
+    buffered), and the segments then feed one shared
+    :class:`FrameBuilder` in shard-id order.  Because per-(peer,src)
+    accumulation order within a shard is file order and the builder
+    reduces in sorted-peer order, the merged rows are BIT-IDENTICAL
+    to a single-shard ingest of the same traffic however it was
+    partitioned across shards — the determinism contract
+    ``tools/slo_gate.py`` asserts, and what makes the controller's
+    decisions independent of the shard layout.
+
+    Liveness is explicit, never inferred silently:
+
+    - a shard whose file has not produced a record yet has NOT
+      started and does not block the merge (a shard may appear
+      mid-run; segments for already-closed windows are dropped and
+      counted ``mux.late_windows``) — but while the fleet closes
+      windows without it, it accrues the same stall polls as a
+      stalled shard, so a host that crashed before its first write
+      is declared dead and COUNTED, never silently treated as
+      absent forever;
+    - a shard that stops advancing (or never starts) while others
+      buffer windows is a WATERMARK STALL: after
+      ``dead_after_polls`` CONSECUTIVE no-progress lagging polls
+      (progress, or simply not lagging, resets the count) it is
+      declared dead (counted ``mux.shard_dead``) and subsequent
+      windows close WITHOUT it — each such window records the
+      exclusion (:attr:`exclusions`, counted
+      ``mux.excluded_windows{shard=...}``), so a dead shard is
+      excluded-and-counted, never silently merged;
+    - a dead shard that produces a fresh (non-stale) window again is
+      revived (counted ``mux.shard_revived``) and rejoins from the
+      next unclosed window.
+
+    ``dead_after_polls=None`` (the default) waits forever — the
+    batch-replay setting, where a finished shard set has no liveness
+    question.  ``per_shard=True`` additionally reduces each shard's
+    own events through a private FrameBuilder (:attr:`shard_rows`),
+    the SLO layer's worst-shard attribution input."""
+
+    def __init__(self, paths: Iterable[str], *,
+                 source: str = "real",
+                 dead_after_polls: Optional[int] = None,
+                 registry=None, per_shard: bool = False):
+        paths = list(paths)
+        # duplicate detection on the RESOLVED path: the same file
+        # under two spellings (./dir/x vs dir/x, abs vs rel) would
+        # otherwise be followed twice and silently double every
+        # merged count
+        resolved = [os.path.realpath(path) for path in paths]
+        if len(set(resolved)) != len(resolved):
+            raise ValueError("duplicate shard paths in the mux path "
+                             "list — the same shard followed twice "
+                             "would double every merged count")
+        paths = [os.path.normpath(path) for path in paths]
+
+        def ids_from(depth: int) -> List[str]:
+            out = []
+            for path in paths:
+                parts = path.replace("\\", "/").split("/")
+                tail = "/".join(parts[-depth:])
+                out.append(tail[:-len(".jsonl")]
+                           if tail.endswith(".jsonl") else tail)
+            return out
+
+        # shard ids come from the basename (the per-host
+        # `<host>.jsonl` layout); per-host DIRECTORIES holding
+        # same-named files (`host01/trace.jsonl`) are a legitimate
+        # fleet layout too, so colliding basenames widen to include
+        # parent components until the ids are distinct — only
+        # genuinely identical paths are refused
+        depth = 1
+        shard_ids = ids_from(depth)
+        while len(set(shard_ids)) != len(shard_ids):
+            depth += 1
+            widened = ids_from(depth)
+            if widened == shard_ids:
+                raise ValueError("duplicate shard paths in the mux "
+                                 "path list — the merge order would "
+                                 "be ambiguous")
+            shard_ids = widened
+        lanes = [_MuxLane(shard_id, path)
+                 for shard_id, path in zip(shard_ids, paths)]
+        lanes.sort(key=lambda lane: lane.shard_id)
+        if not lanes:
+            raise ValueError("ShardMuxFollower needs >= 1 shard path")
+        self._lanes = lanes
+        self._dead_after = dead_after_polls
+        # mux health counts into the shared registry when given one,
+        # else a private instance — call sites stay unconditional
+        # (the AgentStats convention; telemetry is imported lazily so
+        # this pure-host module's import surface stays stdlib)
+        if registry is None:
+            from .telemetry import MetricsRegistry
+            registry = MetricsRegistry()
+        self._registry = registry
+        self.builder = FrameBuilder(source, 0.0)
+        self.windows = 0
+        self.rows: List[Tuple[float, ...]] = []
+        #: per closed window: the shard ids excluded from it (dead at
+        #: close time) — empty tuple for a fully-merged window
+        self.exclusions: List[Tuple[str, ...]] = []
+        #: per closed window: (join_ms, leave_ms) membership
+        #: snapshots, captured at the close (the control plane's
+        #: resume-determinism contract)
+        self.memberships: List[Tuple[Dict[str, float],
+                                     Dict[str, float]]] = []
+        #: per closed window: the merged builder's per-peer interval
+        #: stall / interval P2P bytes (present peers) — the SLO
+        #: layer's cohort-attribution inputs (engine/slo.py)
+        self.peer_stall: List[Dict[str, float]] = []
+        self.peer_p2p: List[Dict[str, float]] = []
+        self._last_t: Optional[float] = None
+        self._shard_builders: Optional[Dict[str, FrameBuilder]] = None
+        self.shard_rows: Dict[str, List[Optional[Tuple[float, ...]]]] \
+            = {}
+        if per_shard:
+            self._shard_builders = {
+                lane.shard_id: FrameBuilder(
+                    f"{source}:{lane.shard_id}", 0.0)
+                for lane in lanes}
+            self.shard_rows = {lane.shard_id: [] for lane in lanes}
+
+    @property
+    def shard_ids(self) -> List[str]:
+        return [lane.shard_id for lane in self._lanes]
+
+    def _drop_stale(self) -> None:
+        """Discard buffered segments whose window already closed —
+        a late-appearing or revived shard must not smear old BYTE
+        and STALL deltas into a newer window's intervals (counted
+        ``mux.late_windows``, never silent).  MEMBERSHIP events are
+        the exception: a ``twin.peer`` join/leave carries its own
+        absolute clock, so applying it late is exact — without this,
+        a shard that appears mid-run would leave its peers
+        permanently invisible to presence, watched-time, and the
+        per-peer attribution surfaces of every later window."""
+        if self._last_t is None:
+            return
+        for lane in self._lanes:
+            while lane.segments and \
+                    lane.segments[0][0].get("t", 0.0) <= self._last_t:
+                _mark, events = lane.segments.popleft()
+                shard_builder = (self._shard_builders or {}).get(
+                    lane.shard_id)
+                for event in events:
+                    if event.get("kind") != "counter" \
+                            or event.get("name") != "twin.peer":
+                        continue
+                    feed_builder_event(self.builder, event)
+                    if shard_builder is not None:
+                        feed_builder_event(shard_builder, event)
+                self._registry.counter("mux.late_windows",
+                                       shard=lane.shard_id).inc()
+
+    def _live(self) -> List[_MuxLane]:
+        return [lane for lane in self._lanes
+                if lane.started and not lane.dead]
+
+    def _close(self, live: List[_MuxLane]) -> Tuple[float, ...]:
+        """Close one merged window at the EARLIEST buffered mark
+        clock among the live lanes (lanes already sorted by shard
+        id — the deterministic feed order).  A lane whose next mark
+        sits BEYOND that clock is ahead of this window — a
+        late-started host missing the earlier marks, or a shard
+        whose mark line was lost to corruption — and skips it
+        (recorded in the window's exclusions) instead of having a
+        LATER window's segment consumed positionally, which would
+        desynchronize every subsequent merge.  On an aligned fleet
+        every live lane's mark carries the same boundary clock and
+        everyone contributes."""
+        t = min(lane.segments[0][0].get("t", 0.0) for lane in live)
+        window_ms = None
+        contributed = set()
+        for lane in live:
+            if lane.segments[0][0].get("t", 0.0) > t:
+                continue  # ahead of this window: contributes later
+            mark, events = lane.segments.popleft()
+            if window_ms is None:
+                window_ms = mark.get("window_ms", 0.0)
+            shard_builder = (self._shard_builders or {}).get(
+                lane.shard_id)
+            for event in events:
+                feed_builder_event(self.builder, event)
+                if shard_builder is not None:
+                    feed_builder_event(shard_builder, event)
+            contributed.add(lane.shard_id)
+        if self.windows == 0:
+            self.builder.window_s = (window_ms or 0.0) / 1000.0
+            for builder in (self._shard_builders or {}).values():
+                builder.window_s = (window_ms or 0.0) / 1000.0
+        row = self.builder.close_window(t)
+        if self._shard_builders is not None:
+            for shard_id, builder in self._shard_builders.items():
+                self.shard_rows[shard_id].append(
+                    builder.close_window(t)
+                    if shard_id in contributed else None)
+        excluded = tuple(sorted(
+            lane.shard_id for lane in self._lanes
+            if lane.dead or (lane in live
+                             and lane.shard_id not in contributed)))
+        self.exclusions.append(excluded)
+        for shard_id in excluded:
+            self._registry.counter("mux.excluded_windows",
+                                   shard=shard_id).inc()
+        self._registry.counter("mux.windows").inc()
+        self.windows += 1
+        self._last_t = t
+        self.rows.append(row)
+        self.memberships.append(self.builder.membership())
+        self.peer_stall.append(dict(self.builder.last_peer_stall_ms))
+        self.peer_p2p.append(dict(self.builder.last_peer_p2p_bytes))
+        return row
+
+    def _drain(self) -> List[Tuple[float, ...]]:
+        rows = []
+        while True:
+            self._drop_stale()
+            for lane in self._lanes:
+                if lane.dead and lane.segments:
+                    # fresh post-stall window: the shard is back
+                    lane.dead = False
+                    lane.stall_polls = 0
+                    self._registry.counter(
+                        "mux.shard_revived",
+                        shard=lane.shard_id).inc()
+            live = self._live()
+            if live and all(lane.segments for lane in live):
+                rows.append(self._close(live))
+                continue
+            return rows
+
+    def poll(self) -> List[Tuple[float, ...]]:
+        """Ingest whatever every shard grew and return the frame
+        rows whose merged windows closed.  Dead-shard detection runs
+        once per poll: only a shard that is LAGGING the merge
+        (blocking a closable window, or never started while other
+        shards close windows) and made no progress accrues stall
+        polls — CONSECUTIVE polls only (any progress, or simply not
+        lagging, resets the count), so an idle fleet times nobody
+        out and an old stall can never shorten a later one's fuse."""
+        progressed = {lane.shard_id for lane in self._lanes
+                      if lane.ingest()}
+        rows = self._drain()
+        if self._dead_after is not None:
+            live = self._live()
+            # a lane is LAGGING when the merge has evidence it fell
+            # behind: a started lane lags while it BLOCKS a closable
+            # window — after the drain, another live lane still
+            # holds a buffered segment this lane has no counterpart
+            # for (a fully-drained fleet blocks on nobody, however
+            # many rows just closed); a never-started lane lags as
+            # soon as the merge has closed ANY window without it (a
+            # crashed-before-first-write host must be excluded and
+            # counted, not silently treated as absent forever)
+            lagging = []
+            if any(lane.segments for lane in live):
+                lagging = [lane for lane in live
+                           if not lane.segments]
+            if self.windows > 0:
+                lagging += [lane for lane in self._lanes
+                            if not lane.started and not lane.dead]
+            lagging_ids = {lane.shard_id for lane in lagging}
+            for lane in self._lanes:
+                if lane.shard_id in progressed \
+                        or lane.shard_id not in lagging_ids:
+                    lane.stall_polls = 0
+            died = False
+            for lane in lagging:
+                if lane.shard_id in progressed:
+                    continue
+                lane.stall_polls += 1
+                if lane.stall_polls >= self._dead_after:
+                    lane.dead = True
+                    died = True
+                    self._registry.counter(
+                        "mux.shard_dead",
+                        shard=lane.shard_id).inc()
+            if died:
+                rows.extend(self._drain())
+        return rows
+
+    def membership_at(self, window: int) \
+            -> Tuple[Dict[str, float], Dict[str, float]]:
+        return self.memberships[window]
+
+    def frame(self) -> ObservationFrame:
+        return self.builder.frame()
+
+    def shard_frame(self, shard_id: str) -> ObservationFrame:
+        if self._shard_builders is None:
+            raise ValueError("mux built without per_shard=True")
+        return self._shard_builders[shard_id].frame()
+
+
+def frames_from_shards(paths: Iterable[str], *,
+                       source: str = "real") -> ObservationFrame:
+    """Batch replay of a finished shard set through the mux — by
+    construction the same partitioning as an incremental tail-follow
+    of the same shards (it IS the mux, applied to files that no
+    longer grow), and bit-identical to :func:`frames_from_events` of
+    the same traffic in one shard."""
+    mux = ShardMuxFollower(paths, source=source)
+    mux.poll()
+    return mux.frame()
+
+
 def frames_from_timelines(columns, samples, *,
                           join_s: Optional[Iterable[float]] = None,
                           leave_s: Optional[Iterable[float]] = None,
@@ -344,7 +801,15 @@ def frames_from_timelines(columns, samples, *,
     arrays (seconds) under the shared window convention — the jnp
     plane has no per-peer event stream, but its scenario arrays ARE
     its membership ground truth.  ``leave_s`` entries at or above
-    ``never_s`` mean "never departs" (ops/swarm_sim.py NEVER_S)."""
+    ``never_s`` mean "never departs" (ops/swarm_sim.py NEVER_S).
+
+    The quantile columns fold from the kernel's ``stall_ms_bin{i}``
+    timeline columns (``SwarmConfig.stall_digest``: per-peer interval
+    stall binned in-kernel with the SAME log-spaced edges this
+    module's real-plane digest uses) through the one quantile
+    estimator (engine/digest.py ``quantiles_from_counts``); a
+    timeline recorded without the digest columns reports zeros —
+    columns never silently vanish from the frame."""
     columns = list(columns)
     samples = [list(row) for row in samples]
     t_col = columns.index("t_s")
@@ -353,6 +818,9 @@ def frames_from_timelines(columns, samples, *,
     copy_cols = [columns.index(c) for c in
                  ("offload", "rebuffer", "cdn_rate_bps",
                   "p2p_rate_bps", "stalled_peers")]
+    bin_cols = [columns.index(f"stall_ms_bin{i}")
+                for i in range(len(DEFAULT_EDGES) + 1)] \
+        if "stall_ms_bin0" in columns else None
     joins = [float(j) for j in join_s] if join_s is not None else []
     leaves = ([float(v) for v in leave_s]
               if leave_s is not None else [])
@@ -373,9 +841,15 @@ def frames_from_timelines(columns, samples, *,
         n_leaves = sum(1 for v in leaves
                        if _in_window(v, prev_t, t, first))
         present = sum(sample[i] for i in level_cols)
+        if bin_cols is not None:
+            quantiles = quantiles_from_counts(
+                DEFAULT_EDGES,
+                [int(round(sample[i])) for i in bin_cols])
+        else:
+            quantiles = [0.0] * len(QUANTILE_COLUMNS)
         rows.append((t,) + tuple(sample[i] for i in copy_cols)
                     + (float(present), float(n_joins),
-                       float(n_leaves)))
+                       float(n_leaves)) + tuple(quantiles))
         prev_t = t
     return ObservationFrame(source=source, window_s=float(window_s),
                             columns=FRAME_COLUMNS,
@@ -491,7 +965,11 @@ def compare_frames(sim: ObservationFrame, real: ObservationFrame,
 _CALIBRATION_FLOORS = {
     "present_peers": 0.5, "joins": 0.5, "leaves": 0.5,
     "stalled_peers": 1.5, "cdn_rate_bps": 200_000.0,
-    "p2p_rate_bps": 200_000.0, "offload": 0.01, "rebuffer": 0.005}
+    "p2p_rate_bps": 200_000.0, "offload": 0.01, "rebuffer": 0.005,
+    # the stall-quantile columns: a couple of digest bins of slack
+    # (the sketch's ~1.6× relative resolution at the second scale)
+    "rebuffer_ms_p50": 250.0, "rebuffer_ms_p95": 500.0,
+    "rebuffer_ms_p99": 500.0}
 
 
 def calibrate_bands(sim: ObservationFrame, real: ObservationFrame, *,

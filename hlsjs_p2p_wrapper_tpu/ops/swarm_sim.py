@@ -308,6 +308,17 @@ class SwarmConfig(NamedTuple):
     #: program, bit-identical.  Cohort MEMBERSHIP stays dynamic
     #: data, so one mixture grid is still ONE compile group.
     n_cohorts: int = 0
+    #: fleet-observability TAIL width (engine/digest.py): when True,
+    #: each ``record_every`` timeline row additionally carries the
+    #: per-peer INTERVAL stall distribution binned into the shared
+    #: log-spaced digest layout (``stall_ms_bin{i}`` columns —
+    #: ``searchsorted`` over the same edges the real plane's
+    #: FrameBuilder bins with, so the two planes compute the
+    #: IDENTICAL mergeable digest and the twin can band p99
+    #: rebuffer).  Static because it sizes the timeline row; False
+    #: (the default) compiles the binning away entirely — every
+    #: pre-0.17 timeline shape is bit-identical.
+    stall_digest: bool = False
 
 
 class SwarmScenario(NamedTuple):
@@ -1530,7 +1541,19 @@ def timeline_columns(config: SwarmConfig) -> Tuple[str, ...]:
     for k in range(config.n_cohorts):
         base += (f"cohort_{k}_peers", f"cohort_{k}_stalled",
                  f"cohort_{k}_offload")
+    if config.stall_digest:
+        base += tuple(f"stall_ms_bin{i}"
+                      for i in range(len(_stall_digest_edges()) + 1))
     return base
+
+
+def _stall_digest_edges():
+    """The shared digest bin layout (engine/digest.py DEFAULT_EDGES)
+    — imported lazily so the jnp kernel does not pull the engine
+    package onto its import path (every engine→ops import is lazy
+    for the same reason, in the other direction)."""
+    from ..engine.digest import DEFAULT_EDGES
+    return DEFAULT_EDGES
 
 
 def _timeline_row(config: SwarmConfig, scenario: SwarmScenario,
@@ -1567,25 +1590,41 @@ def _timeline_row(config: SwarmConfig, scenario: SwarmScenario,
         .astype(jnp.float32), axis=0)
     head = jnp.stack([t, offload, rebuffer, cdn_rate, p2p_rate,
                       stalled])
-    if not config.n_cohorts:
-        return jnp.concatenate([head, level_counts])
-    # per-cohort slices (engine/population.py): membership is
-    # dynamic scenario data, so slicing is pure jnp masking — the
-    # mixture grid stays one compile group; n_cohorts=0 (the
-    # default) compiles this block away entirely
-    cohort_cols = []
-    for k in range(config.n_cohorts):
-        mask = scenario.cohort_id == k
-        cohort_cols.append(jnp.sum(
-            (present & mask).astype(jnp.float32)))
-        cohort_cols.append(jnp.sum(
-            ((state.rebuffer_s > prev_rebuffer) & mask)
-            .astype(jnp.float32)))
-        p2p_k = jnp.sum(jnp.where(mask, state.p2p_bytes, 0.0))
-        tot_k = p2p_k + jnp.sum(jnp.where(mask, state.cdn_bytes, 0.0))
-        cohort_cols.append(p2p_k / jnp.maximum(tot_k, 1.0))
-    return jnp.concatenate([head, level_counts,
-                            jnp.stack(cohort_cols)])
+    parts = [head, level_counts]
+    if config.n_cohorts:
+        # per-cohort slices (engine/population.py): membership is
+        # dynamic scenario data, so slicing is pure jnp masking — the
+        # mixture grid stays one compile group; n_cohorts=0 (the
+        # default) compiles this block away entirely
+        cohort_cols = []
+        for k in range(config.n_cohorts):
+            mask = scenario.cohort_id == k
+            cohort_cols.append(jnp.sum(
+                (present & mask).astype(jnp.float32)))
+            cohort_cols.append(jnp.sum(
+                ((state.rebuffer_s > prev_rebuffer) & mask)
+                .astype(jnp.float32)))
+            p2p_k = jnp.sum(jnp.where(mask, state.p2p_bytes, 0.0))
+            tot_k = p2p_k + jnp.sum(jnp.where(mask,
+                                              state.cdn_bytes, 0.0))
+            cohort_cols.append(p2p_k / jnp.maximum(tot_k, 1.0))
+        parts.append(jnp.stack(cohort_cols))
+    if config.stall_digest:
+        # the fleet observation plane's tail columns: per-peer
+        # INTERVAL stall (ms) binned into the shared log-spaced
+        # digest layout (engine/digest.py) over PRESENT peers —
+        # searchsorted(side="left") is bit-for-bit the host
+        # bin_index convention, so fold-merging these counts with
+        # any real-plane digest is exact by construction
+        edges = jnp.asarray(_stall_digest_edges(), jnp.float32)
+        interval_ms = (state.rebuffer_s - prev_rebuffer) * 1000.0
+        idx = jnp.searchsorted(edges, interval_ms, side="left")
+        n_bins = edges.shape[0] + 1
+        one_hot = (idx[:, None]
+                   == jnp.arange(n_bins, dtype=idx.dtype)[None, :])
+        parts.append(jnp.sum(
+            (one_hot & present[:, None]).astype(jnp.float32), axis=0))
+    return jnp.concatenate(parts)
 
 
 def _scan_swarm(config: SwarmConfig, scenario: SwarmScenario,
@@ -1847,8 +1886,11 @@ def batch_lane_bytes(config: SwarmConfig, n_steps: int, *,
             scenario_bytes += 2 * 4 * P * n_neighbors  # nbrs+in_edges
     out_bytes = 4 * n_steps  # per-lane offload-over-time series
     if record_every:
-        out_bytes += 4 * (n_steps // record_every) * (
-            6 + config.n_levels + 3 * config.n_cohorts)
+        # the timeline row width is the columns function's ground
+        # truth — sized from it so a new column family (cohorts,
+        # stall-digest bins) can never silently under-count
+        out_bytes += 4 * (n_steps // record_every) * len(
+            timeline_columns(config))
     return 2 * state_bytes + scenario_bytes + out_bytes
 
 

@@ -342,7 +342,14 @@ def parity_sim_config(scenario: TwinScenario,
         n_segments=scenario.frag_count,
         n_levels=len(scenario.level_bitrates),
         seg_duration_s=scenario.seg_duration_s,
-        max_concurrency=SIM_CONCURRENCY, holder_selection="spread")
+        max_concurrency=SIM_CONCURRENCY, holder_selection="spread",
+        # the fleet-observability tail columns (engine/digest.py):
+        # per-peer interval stall binned in-kernel with the shared
+        # digest edges, so the sim frame carries the same
+        # rebuffer_ms quantile trio the real plane's FrameBuilder
+        # computes (compiled away wherever record_every=0, e.g. the
+        # controller's forecast sweeps)
+        stall_digest=True)
 
 
 def run_sim_plane(scenario: TwinScenario,
@@ -419,6 +426,59 @@ def scenario_from_observation(spec: TwinScenario, join_ms,
     join_out += [ABSENT_JOIN_S] * pad
     leave_out += [NEVER_S] * pad
     return join_out, leave_out
+
+
+def split_shard(shard_path: str, out_dir: str, n_shards: int,
+                prefix: str = "mux", assign=None) -> List[str]:
+    """Re-shard ONE recorded flight-recorder shard into ``n_shards``
+    per-host-shaped shards: every peer's ``twin.*`` events land on
+    the shard ``crc32(peer) % n_shards`` picks (a peer lives on
+    exactly one host — the fleet invariant the mux merge relies on;
+    pass ``assign(peer) -> index`` for an explicit placement, e.g.
+    one shard per cohort), the ``twin_window`` marks are replicated
+    into EVERY shard (each host's sampler closes its own windows on
+    the shared virtual clock), and peer-less records follow the meta
+    onto shard 0.
+
+    This is the gate's ground-truth construction: because the split
+    preserves each peer's event order and window assignment exactly,
+    a correct mux merge of the split MUST reproduce the single-shard
+    frames bit-for-bit (``tools/slo_gate.py``)."""
+    import json
+    import os
+    import zlib
+
+    from ..engine.tracer import read_shard
+    from ..engine.twinframe import TWIN_WINDOW_MARK, parse_labels
+
+    os.makedirs(out_dir, exist_ok=True)
+    meta, events = read_shard(shard_path)
+    paths = [os.path.join(out_dir, f"{prefix}{i:02d}.jsonl")
+             for i in range(n_shards)]
+    handles = [open(path, "w", encoding="utf-8") for path in paths]
+    try:
+        for i, fh in enumerate(handles):
+            header = dict(meta or {"kind": "meta"})
+            header["host"] = f"{prefix}{i:02d}"
+            fh.write(json.dumps(header) + "\n")
+        for event in events:
+            if event.get("kind") == "mark" \
+                    and event.get("name") == TWIN_WINDOW_MARK:
+                for fh in handles:
+                    fh.write(json.dumps(event) + "\n")
+                continue
+            peer = parse_labels(event.get("labels", "")).get("peer")
+            if not peer:
+                shard = 0
+            elif assign is not None:
+                shard = int(assign(peer)) % n_shards
+            else:
+                shard = zlib.crc32(peer.encode()) % n_shards
+            handles[shard].write(json.dumps(event) + "\n")
+    finally:
+        for fh in handles:
+            fh.close()
+    return paths
 
 
 def forecast_group(spec: TwinScenario, join_s, knob_list,

@@ -1,0 +1,239 @@
+"""Unit tier for the fleet quantile digest (engine/digest.py) and
+its registry instrument (engine/telemetry.py Digest).
+
+The process-level proof lives in tools/slo_gate.py (`make slo-gate`:
+re-sharded frames bit-identical); this tier pins the sketch's
+contracts directly — binning convention, merge-order invariance
+across seeds and partitions (the property the whole fleet merge
+leans on), deterministic quantile reads, and the instrument's
+memoization/layout rules.
+"""
+
+import math
+import os
+import random
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+from hlsjs_p2p_wrapper_tpu.engine.digest import (  # noqa: E402
+    DEFAULT_EDGES, QuantileDigest, bin_index, log_edges,
+    quantiles_from_counts)
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import (  # noqa: E402
+    MetricsRegistry)
+
+
+# -- edges / binning ---------------------------------------------------
+
+
+def test_log_edges_are_geometric_and_exact_at_ends():
+    edges = log_edges(1.0, 1000.0, 3)
+    assert edges[0] == 1.0
+    assert edges[-1] == 1000.0
+    ratios = [edges[i + 1] / edges[i] for i in range(len(edges) - 1)]
+    for ratio in ratios:
+        assert ratio == pytest.approx(10.0, rel=1e-9)
+
+
+def test_log_edges_validate():
+    with pytest.raises(ValueError):
+        log_edges(0.0, 10.0)
+    with pytest.raises(ValueError):
+        log_edges(10.0, 1.0)
+    with pytest.raises(ValueError):
+        log_edges(1.0, 10.0, 0)
+
+
+def test_bin_index_convention():
+    edges = (1.0, 10.0, 100.0)
+    # underflow holds zeros and the lower edge itself
+    assert bin_index(edges, 0.0) == 0
+    assert bin_index(edges, -5.0) == 0
+    assert bin_index(edges, 1.0) == 0
+    # interior: edges[i-1] < v <= edges[i]
+    assert bin_index(edges, 1.0000001) == 1
+    assert bin_index(edges, 10.0) == 1
+    assert bin_index(edges, 10.1) == 2
+    assert bin_index(edges, 100.0) == 2
+    # overflow strictly above the top edge
+    assert bin_index(edges, 100.1) == 3
+
+
+def test_quantile_representatives_are_deterministic():
+    edges = (1.0, 10.0, 100.0)
+    # all mass in the underflow -> every quantile reads 0
+    assert quantiles_from_counts(edges, [5, 0, 0, 0]) == [0, 0, 0]
+    # all mass overflow -> clamped to the top edge, never beyond
+    assert quantiles_from_counts(edges, [0, 0, 0, 5]) \
+        == [100.0, 100.0, 100.0]
+    # interior bin reads its geometric midpoint
+    mid = quantiles_from_counts(edges, [0, 7, 0, 0], (0.5,))[0]
+    assert mid == pytest.approx(math.sqrt(10.0))
+    # empty digest reads zeros (no NaN, no raise)
+    assert quantiles_from_counts(edges, [0, 0, 0, 0]) == [0, 0, 0]
+
+
+def test_quantile_rank_walk():
+    edges = (1.0, 10.0, 100.0)
+    counts = [2, 6, 2, 0]  # 10 samples
+    p50 = quantiles_from_counts(edges, counts, (0.5,))[0]
+    p99 = quantiles_from_counts(edges, counts, (0.99,))[0]
+    assert p50 == pytest.approx(math.sqrt(10.0))     # rank 5 -> bin 1
+    assert p99 == pytest.approx(math.sqrt(1000.0))   # rank 10 -> bin 2
+
+
+# -- merge-order invariance (THE property) -----------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 7, 42])
+def test_fold_order_permutation_yields_identical_quantiles(seed):
+    """ISSUE acceptance: any partition of the observations into any
+    number of digests, merged in any order, yields the IDENTICAL
+    digest — counts, quantiles, everything."""
+    rng = random.Random(seed)
+    values = [rng.expovariate(1.0 / 500.0) for _ in range(400)]
+
+    reference = QuantileDigest()
+    for value in values:
+        reference.add(value)
+
+    for n_parts in (2, 4, 7):
+        parts = [QuantileDigest() for _ in range(n_parts)]
+        for value in values:
+            parts[rng.randrange(n_parts)].add(value)
+        order = list(range(n_parts))
+        rng.shuffle(order)
+        merged = QuantileDigest()
+        for k in order:
+            merged.merge(parts[k])
+        assert merged == reference
+        assert merged.quantiles() == reference.quantiles()
+
+
+def test_merge_is_associative():
+    a, b, c = QuantileDigest(), QuantileDigest(), QuantileDigest()
+    for digest, values in ((a, [1, 5]), (b, [50, 5000]),
+                           (c, [0.0, 2e6])):
+        for value in values:
+            digest.add(value)
+
+    left = QuantileDigest()
+    left.merge(a).merge(b).merge(c)
+    bc = QuantileDigest()
+    bc.merge(b).merge(c)
+    right = QuantileDigest()
+    right.merge(a).merge(bc)
+    assert left == right
+
+
+def test_merge_refuses_layout_mismatch():
+    with pytest.raises(ValueError, match="layout"):
+        QuantileDigest().merge(QuantileDigest(log_edges(1, 10, 2)))
+
+
+def test_add_binned_matches_add():
+    values = [0.0, 3.0, 750.0, 1e9]
+    a = QuantileDigest()
+    for value in values:
+        a.add(value)
+    counts = [0] * (len(DEFAULT_EDGES) + 1)
+    for value in values:
+        counts[bin_index(DEFAULT_EDGES, value)] += 1
+    b = QuantileDigest()
+    b.add_binned(counts)
+    assert a == b
+    with pytest.raises(ValueError):
+        b.add_binned([1, 2, 3])
+
+
+def test_dict_roundtrip():
+    digest = QuantileDigest()
+    for value in (2.0, 90.0, 40_000.0):
+        digest.add(value)
+    assert QuantileDigest.from_dict(digest.as_dict()) == digest
+
+
+# -- registry instrument ----------------------------------------------
+
+
+def test_registry_digest_is_memoized_and_reads_quantiles():
+    registry = MetricsRegistry()
+    digest = registry.digest("slo.test_ms", src="cdn")
+    assert registry.digest("slo.test_ms", src="cdn") is digest
+    for _ in range(10):
+        digest.observe(100.0)
+    read = digest.read()
+    assert read["count"] == 10
+    assert read["p50"] == read["p99"] > 0
+    snap = registry.snapshot()
+    assert snap["slo.test_ms{src=cdn}"]["count"] == 10
+
+
+def test_registry_digest_refuses_conflicting_layout():
+    registry = MetricsRegistry()
+    registry.digest("slo.test_ms")
+    with pytest.raises(ValueError, match="edges"):
+        registry.digest("slo.test_ms", edges=log_edges(1, 10, 2))
+    # re-request WITHOUT an explicit layout is the memo hit
+    assert registry.digest("slo.test_ms") is not None
+
+
+def test_registry_digest_kind_collision():
+    registry = MetricsRegistry()
+    registry.counter("slo.collide")
+    with pytest.raises(ValueError, match="registered as"):
+        registry.digest("slo.collide")
+
+
+def test_digest_delta_passes_through():
+    registry = MetricsRegistry()
+    inst = registry.digest("slo.test_ms")
+    inst.observe(5.0)
+    prev = registry.snapshot()
+    inst.observe(5.0)
+    delta = registry.delta(prev)
+    # digests pass through like gauges: a quantile delta would be
+    # meaningless
+    assert delta["slo.test_ms"]["count"] == 2
+
+
+def test_merge_into_folds_instrument_counts():
+    registry = MetricsRegistry()
+    fleet = QuantileDigest()
+    for src, walls in (("cdn", [10.0, 20.0]), ("p2p", [5000.0])):
+        inst = registry.digest("slo.test_ms", src=src)
+        for wall in walls:
+            inst.observe(wall)
+        inst.merge_into(fleet)
+    assert fleet.count == 3
+
+
+# -- the seed-free-digest lint rule ------------------------------------
+
+
+def test_seed_free_digest_lint_rule(tmp_path):
+    import lint as lint_tool
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import random\n"
+        "from numpy import random as npr\n"
+        "import numpy as np\n"
+        "a = np.random.default_rng(7)\n"   # seeded is STILL banned
+        "b = random.random()  # rng-ok: no escape exists here\n"
+        "c = jax.random.PRNGKey(0)\n")
+    findings = lint_tool.check_digest_seed_free(str(bad))
+    # every randomness reference flagged, the inline escape ignored
+    assert len(findings) >= 5
+    assert all("determinism" in f for f in findings)
+    good = tmp_path / "good.py"
+    good.write_text("import math\nx = math.sqrt(2.0)\n")
+    assert lint_tool.check_digest_seed_free(str(good)) == []
+    # the shipped digest module is covered and holds its own rule
+    path = os.path.join(_REPO, "hlsjs_p2p_wrapper_tpu", "engine",
+                        "digest.py")
+    assert any(path.endswith(df) for df in lint_tool.DIGEST_FILES), \
+        "digest.py must be listed in lint's DIGEST_FILES"
+    assert lint_tool.check_digest_seed_free(path) == []

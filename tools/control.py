@@ -103,8 +103,12 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--spec", required=True,
                     help="controller spec JSON (module docstring)")
-    ap.add_argument("--shard", required=True,
-                    help="flight-recorder shard to ingest")
+    ap.add_argument("--shard", required=True, action="append",
+                    help="flight-recorder shard to ingest; repeat "
+                         "for a fleet's shard LIST (merged on the "
+                         "window clock by the ShardMuxFollower — "
+                         "decisions are bit-identical to the "
+                         "single-shard ingest of the same traffic)")
     ap.add_argument("--actuate-log", required=True,
                     help="append-only fsync'd actuation JSONL (the "
                          "idempotent-by-epoch external effect)")
@@ -123,6 +127,16 @@ def main() -> int:
                     help="chaos hook: SIGKILL self after the N-th "
                          "actuation lands in the log, before the "
                          "tick checkpoints")
+    ap.add_argument("--dead-after-polls", type=int, default=0,
+                    metavar="N",
+                    help="fleet ingest liveness: declare a shard "
+                         "dead after N consecutive lagging "
+                         "no-progress polls and close the remaining "
+                         "windows WITHOUT it (excluded-and-counted; "
+                         "the replay re-polls until the verdicts "
+                         "settle).  0 (default) waits forever — a "
+                         "truncated shard then truncates the "
+                         "decision sequence too")
     args = ap.parse_args()
 
     config = load_config(args.spec)
@@ -132,20 +146,36 @@ def main() -> int:
     if args.sigkill_at_actuation > 0:
         actuator = _KillingActuator(actuator,
                                     args.sigkill_at_actuation)
+    shards = (args.shard[0] if len(args.shard) == 1
+              else list(args.shard))
     loop = ControlLoop(
-        config, args.shard, actuator, warm_start=warm,
+        config, shards, actuator, warm_start=warm,
         registry=warm.registry,
         checkpoint_path=control_checkpoint_path(warm.cache_dir,
-                                                config))
+                                                config),
+        dead_after_polls=(args.dead_after_polls or None))
     resumed = False
     if args.resume:
         resumed = loop.resume()
     loop.run_available()
+    if args.dead_after_polls:
+        # offline replay against files that no longer grow: every
+        # extra poll is pure stall evidence, so keep polling until
+        # the dead-shard verdicts settle and no further merged
+        # windows close — otherwise a truncated shard's stall fuse
+        # (dead_after_polls consecutive lagging polls) never burns
+        # and half the capture's ticks silently never happen
+        idle = 0
+        while idle <= args.dead_after_polls:
+            if loop.run_available():
+                idle = 0
+            else:
+                idle += 1
 
     doc = {
         "meta": {
             "spec": os.path.abspath(args.spec),
-            "shard": os.path.abspath(args.shard),
+            "shard": [os.path.abspath(s) for s in args.shard],
             "resumed": resumed,
             "scenario": dataclasses.asdict(config.spec),
             "constraint": [config.constraint.metric,
@@ -158,6 +188,12 @@ def main() -> int:
         "current_knobs": loop.current_knobs,
         "decisions": loop.decisions,
         "tick_stats": loop.tick_stats,
+        # fleet ingest visibility: which shards each merged window
+        # closed WITHOUT (dead/lagging — excluded-and-counted)
+        "excluded_windows": [{"tick": i, "shards": list(shards)}
+                             for i, shards in
+                             enumerate(loop.ingest.exclusions)
+                             if shards],
     }
     if args.out:
         atomic_write_json(args.out, doc)

@@ -21,7 +21,12 @@ refresh:
   ``tools/twin_gate.py`` artifact) — per scenario, each frame
   metric's max relative error between the sim and real planes with
   the worst window's index and clock (engine/twinframe.py
-  ``frame_errors``): where the digital twin diverges, at a glance.
+  ``frame_errors``): where the digital twin diverges, at a glance;
+- **SLO panel** (``--slo``, from the trace stream's
+  ``slo_window``/``slo_alert`` marks, engine/slo.py) — per
+  objective: current fast/slow burn rates, error budget remaining,
+  alert count, and the last alert's worst shard/cohort attribution;
+  graceful on artifacts without SLO events.
 
 Both sources are append-only and torn-tail tolerant
 (``read_jsonl_tolerant``), so tailing a LIVE fleet mid-write is safe
@@ -265,8 +270,63 @@ def control_panel(events) -> list:
     return lines
 
 
+def slo_panel(events) -> list:
+    """SLO panel lines from a merged event stream: per objective,
+    the last ``slo_window`` mark's burn rates and budget remaining,
+    the alert count, and the last alert's worst shard/cohort
+    attribution (engine/slo.py emits the marks).  Degrades to one
+    explanatory line on artifacts from runs without an SLO
+    evaluator — the ``--control`` pattern."""
+    windows = {}
+    alerts = {}
+    for event in events:
+        if event.get("kind") != "mark":
+            continue
+        name = event.get("name")
+        if name == "slo_window":
+            windows[event.get("slo", "?")] = event
+        elif name == "slo_alert":
+            alerts.setdefault(event.get("slo", "?"),
+                              []).append(event)
+    if not windows and not alerts:
+        return ["slo: no SLO events in trace (run without an SLO "
+                "evaluator — nothing to judge)"]
+    lines = ["slo objectives:"]
+    for slo in sorted(set(windows) | set(alerts)):
+        last = windows.get(slo)
+        fired = alerts.get(slo, [])
+        if last is not None:
+            burn_fast = last.get("burn_fast")
+            remaining = last.get("budget_remaining")
+            lines.append(
+                f"  {slo} ({last.get('metric')}/"
+                f"{last.get('quantile')}): burn fast "
+                + (f"{burn_fast:g}×" if burn_fast is not None
+                   else "n/a")
+                + f" / slow "
+                + (f"{last.get('burn_slow'):g}×"
+                   if last.get("burn_slow") is not None else "n/a")
+                + f", budget remaining "
+                + (f"{remaining:.0%}" if remaining is not None
+                   else "n/a (warmup)")
+                + f", {len(fired)} alert(s)"
+                + ("  ** FIRING **" if last.get("firing") else ""))
+        else:
+            lines.append(f"  {slo}: {len(fired)} alert(s)")
+        if fired:
+            worst = fired[-1]
+            shard = worst.get("worst_shard") or {}
+            cohort = worst.get("worst_cohort") or {}
+            lines.append(
+                f"    last alert @ w{worst.get('window')} "
+                f"(t={worst.get('t_s'):g}s): worst shard "
+                f"{shard.get('shard', '-')}, worst cohort "
+                f"{cohort.get('cohort', '-')}")
+    return lines
+
+
 def render_frame(fabric_dir=None, trace_dir=None, now=None,
-                 twin_path=None, control=False) -> str:
+                 twin_path=None, control=False, slo=False) -> str:
     """One console frame as text (the testable surface)."""
     now = time.time() if now is None else now
     lines = []
@@ -339,6 +399,8 @@ def render_frame(fabric_dir=None, trace_dir=None, now=None,
         lines.extend(twin_panel(twin_path))
     if control:
         lines.extend(control_panel(trace_events))
+    if slo:
+        lines.extend(slo_panel(trace_events))
     if not lines:
         lines.append("nothing to watch (pass --fabric, --trace "
                      "and/or --twin)")
@@ -361,6 +423,12 @@ def main(argv=None) -> int:
                          "control_tick mark, knob epoch, headroom, "
                          "actuation/hold/veto counters) from the "
                          "--trace event stream")
+    ap.add_argument("--slo", action="store_true",
+                    help="add the SLO panel (per objective: burn "
+                         "rates, budget remaining, alert count, "
+                         "worst shard/cohort of the last alert) "
+                         "from the --trace event stream's "
+                         "slo_window/slo_alert marks")
     ap.add_argument("--follow", action="store_true",
                     help="refresh continuously (default: one "
                          "post-mortem frame)")
@@ -378,7 +446,7 @@ def main(argv=None) -> int:
     while True:
         print(render_frame(args.fabric, args.trace,
                            twin_path=args.twin,
-                           control=args.control))
+                           control=args.control, slo=args.slo))
         frames += 1
         if not args.follow or (args.max_frames
                                and frames >= args.max_frames):

@@ -295,6 +295,12 @@ CLOCK_FILES = (
     # construction — a naked wall-clock read here would let the two
     # planes' windows drift apart undetectably
     os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "twinframe.py"),
+    # the fleet observation plane (round 15): digests and SLO
+    # verdicts are pure functions of VirtualClock-stamped frames —
+    # a wall-clock read in either would make burn rates and
+    # dead-shard timeouts flake under load
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "digest.py"),
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "slo.py"),
 )
 
 #: the transports (round 10): these ALSO flag naked
@@ -478,13 +484,68 @@ def check_rng_discipline(path):
     return findings
 
 
+#: the fleet quantile sketch (engine/digest.py): its whole value is
+#: that merge order CANNOT change a quantile — the digest is a pure
+#: function of the binned multiset.  ANY randomness (seeded or not)
+#: would break that determinism contract invisibly, so unlike
+#: RNG_FILES this rule has no seeded-constructor allowance: no
+#: ``random`` / ``np.random`` / ``jax.random`` draw of any kind.
+DIGEST_FILES = (
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "digest.py"),
+)
+
+
+def check_digest_seed_free(path):
+    """Seed-FREE discipline for the digest sketch: reject every
+    reference to a randomness module — ``import random``,
+    ``np.random.*`` (even explicitly seeded), ``jax.random`` —
+    anywhere in DIGEST_FILES.  There is no inline escape: a sketch
+    that needs randomness belongs in a different module."""
+    findings = []
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []  # check_file already reports the syntax error
+    for node in ast.walk(tree):
+        offender = None
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if root == "random" or "random" in alias.name.split(
+                        "."):
+                    offender = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            parts = (node.module or "").split(".")
+            if "random" in parts:
+                offender = node.module
+            else:
+                for alias in node.names:
+                    if alias.name == "random":
+                        offender = f"{node.module}.random"
+        elif isinstance(node, ast.Attribute) \
+                and node.attr == "random":
+            offender = "<attr>.random"
+        elif isinstance(node, ast.Name) and node.id == "random":
+            offender = "random"
+        if offender is not None:
+            findings.append(
+                f"{path}:{node.lineno}: randomness ({offender}) in "
+                f"the quantile digest — the sketch's merge-order "
+                f"determinism contract forbids ANY RNG here, seeded "
+                f"or not (no inline escape)")
+    return findings
+
+
 #: roots the metrics reference is collected from: the package (what
 #: the engine emits) plus tools/ (soak's invariant gauges).  Tests
 #: mint throwaway families and must not pollute the reference.
 METRIC_ROOTS = ("hlsjs_p2p_wrapper_tpu", "tools")
 
-#: the registry's instrument constructors (engine/telemetry.py)
-_INSTRUMENT_KINDS = ("counter", "gauge", "histogram")
+#: the registry's instrument constructors (engine/telemetry.py) —
+#: ``digest`` is the round-15 quantile-sketch instrument
+_INSTRUMENT_KINDS = ("counter", "gauge", "histogram", "digest")
 
 
 def collect_metric_families(repo_root):
@@ -523,7 +584,7 @@ def collect_metric_families(repo_root):
                     for kw in node.keywords:
                         if kw.arg is None:
                             labels.append("**")
-                        elif kw.arg != "buckets":
+                        elif kw.arg not in ("buckets", "edges"):
                             labels.append(kw.arg)
                     key = (node.args[0].value, node.func.attr)
                     entry = families.setdefault(
@@ -653,6 +714,8 @@ def main(argv=None):
             all_findings.extend(check_traffic_discipline(path))
         if path.endswith(RNG_FILES):
             all_findings.extend(check_rng_discipline(path))
+        if path.endswith(DIGEST_FILES):
+            all_findings.extend(check_digest_seed_free(path))
     all_findings.extend(check_static_knobs(
         os.path.join(repo_root, "tools", "sweep.py")))
     all_findings.extend(check_metrics_reference(repo_root))

@@ -1,0 +1,548 @@
+"""Fleet observation gate: multi-shard ingest is exact, the
+controller is shard-layout-blind, dead shards are counted, and the
+SLO layer fires the right cohort-attributed burn alert.
+
+This is the proof for the fleet observation plane (engine/twinframe
+``ShardMuxFollower``, engine/digest.py, engine/slo.py): the layer
+that turns N hosts' flight-recorder shards into one judged frame
+stream.  Four parts:
+
+**A — the merge is exact and deterministic.**  One real-protocol
+swarm run (two delivery cohorts, a join wave) records its ``twin.*``
+provenance into ONE shard; splitting that shard per-peer into four
+host-shaped shards (``testing/twin.split_shard`` — each peer's
+events on exactly one shard, the window marks on all, order
+preserved) and merging them back through the mux must reproduce the
+single-shard frames BIT-FOR-BIT — including the new
+``rebuffer_ms_p50/p95/p99`` digest columns, whose fixed-bin
+order-independent sketch (engine/digest.py) is what makes exactness
+under re-sharding possible at all.  The merge must also be
+path-independent: an INCREMENTAL tail-follow of the same four
+shards growing in arbitrary byte-size chunks (torn tails mid-poll
+included) must equal the batch replay, and a same-seed rerun of the
+whole plane must reproduce the merged frames exactly.
+
+**B — a dead shard is excluded and counted, never silently
+merged.**  Truncating one of the four shards mid-run stalls its
+watermark; after ``dead_after_polls`` no-progress polls the mux
+must declare it dead (``mux.shard_dead``), close every remaining
+window WITHOUT it, record the exclusion per window
+(``mux.excluded_windows{shard=...}``), and still close the full
+window count.
+
+**C — the controller cannot tell shard layouts apart.**  The
+ROADMAP control-plane residue (2): ``tools/control.py`` replays the
+SAME recorded traffic twice — once from the single shard, once from
+the four-way split (``--shard`` repeated) — against one warm-start
+cache, and the decision sequences must be IDENTICAL (with >= 1
+actuation, so the identity is not vacuous) and the actuation logs
+must hold the same epochs.
+
+**D — the SLO layer judges and attributes.**  Two runs of the
+two-cohort swarm, one clean and one with an injected REGIONAL loss
+window (every loopback link touching the cellular cohort drops all
+frames for half the watch), evaluated against the committed
+``SLO_r12.json`` objectives (a per-window delivery-offload SLO and
+a p99 stall-quantile SLO, both with error budgets and fast+slow
+burn windows): the clean run must fire ZERO alerts, and the loss
+run must fire exactly the delivery alert, naming the burn rates,
+the cellular REGION's shard (the per-shard sub-frames) and the
+``cellular`` cohort (the per-peer P2P-bytes surface) — and the
+consumers must hold (``fleet_console.py --slo`` renders the panel,
+``trace_export.py`` renders the alert instants and quantile
+tracks).  ``--write-artifact`` re-measures and rewrites
+``SLO_r12.json`` (the --write-bands pattern).
+
+Run: ``python tools/slo_gate.py`` (exit 1 on any violation);
+``make slo-gate`` wires it into ``make check``.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
+    atomic_write_text)
+from hlsjs_p2p_wrapper_tpu.engine.slo import (  # noqa: E402
+    SLOSpec, evaluate_mux)
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import (  # noqa: E402
+    MetricsRegistry)
+from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
+    FlightRecorder, read_shard)
+from hlsjs_p2p_wrapper_tpu.engine.twinframe import (  # noqa: E402
+    ShardMuxFollower, TWIN_WINDOW_MARK, frames_from_events,
+    frames_from_shards)
+from hlsjs_p2p_wrapper_tpu.testing.swarm import SwarmHarness  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.testing.twin import (  # noqa: E402
+    TwinScenario, TwinSampler, _is_twin_family, split_shard)
+
+ARTIFACT_PATH = os.path.join(_REPO, "SLO_r12.json")
+BANDS_PATH = os.path.join(_REPO, "TWIN_r10.json")
+
+#: the two delivery cohorts: "broadband" fails over to the CDN fast
+#: (short P2P budgets), "cellular" rides long P2P budgets — the
+#: regional loss window hits the cellular region's links.  Derived
+#: from the env-scalable scenario (SLO_GATE_PEERS etc.) so scaling
+#: the gate scales BOTH regions: the back half of the audience is
+#: the cellular region (6/6 at the committed default shape)
+BROADBAND_CFG = {"p2p_budget_cap_ms": 400.0,
+                 "p2p_budget_fraction": 0.5}
+CELLULAR_CFG = {"p2p_budget_cap_ms": 6000.0,
+                "p2p_budget_fraction": 0.9}
+
+
+def cellular_ids(spec) -> frozenset:
+    total = spec.total_peers
+    return frozenset(f"p{i}" for i in range(total // 2, total))
+
+#: the regional loss window (seconds on the scenario clock): every
+#: loopback link touching a cellular peer drops ALL frames
+LOSS_START_S, LOSS_END_S = 64.0, 128.0
+
+#: the committed objectives (SLO_r12.json): a per-window delivery
+#: SLO (the alertable interval form of offload) and a stall-tail
+#: SLO on the new digest quantile columns
+SLO_SPECS = (
+    SLOSpec(name="delivery-offload", metric="interval_offload",
+            threshold=0.25, op=">=", error_budget=0.1,
+            budget_windows=20, fast_windows=2, slow_windows=5,
+            burn_threshold=2.0),
+    SLOSpec(name="rebuffer-p99", metric="rebuffer_ms_p99",
+            threshold=3000.0, op="<=", error_budget=0.1,
+            budget_windows=20, fast_windows=2, slow_windows=5,
+            burn_threshold=2.0),
+)
+
+#: SLO judgment starts after the join/fill phase (the controller's
+#: warmup_windows discipline: startup spends patience, not budget)
+SLO_WARMUP_WINDOWS = 8
+
+#: part C's controller identity (the control-gate scenario family:
+#: scarce supply, where the knob lattice genuinely moves the
+#: forecast, so the replay actually actuates)
+CONTROL_SPEC = {
+    "knob_grid": {"p2p_budget_cap_ms": [500.0, 6000.0],
+                  "p2p_budget_fraction": [0.5, 0.9]},
+    "initial_knobs": {"p2p_budget_cap_ms": 6000.0,
+                      "p2p_budget_fraction": 0.9},
+    "constraint": "rebuffer<=0.05",
+    "band_set": "chaos",
+}
+
+CHECKS = []
+
+
+def check(ok, what):
+    CHECKS.append((bool(ok), what))
+    print(f"  [{'ok ' if ok else 'FAIL'}] {what}")
+
+
+def gate_spec() -> TwinScenario:
+    return TwinScenario(
+        seed=int(os.environ.get("SLO_GATE_SEED", 0)),
+        n_peers=int(os.environ.get("SLO_GATE_PEERS", 8)),
+        wave_peers=int(os.environ.get("SLO_GATE_WAVE", 4)))
+
+
+#: populated from the scenario in main() — the cohort map every
+#: part shares (module-level so run_plane/cohort_of see one set)
+CELLULAR: frozenset = frozenset()
+
+
+def cohort_of(peer: str) -> str:
+    return "cellular" if peer in CELLULAR else "broadband"
+
+
+def run_plane(spec: TwinScenario, trace_dir: str,
+              regional_loss: bool) -> str:
+    """One two-cohort swarm run, provenance recorded to one shard;
+    ``regional_loss`` arms the loss window on every link touching
+    the cellular cohort.  Returns the shard path."""
+    harness = SwarmHarness(
+        seg_duration=spec.seg_duration_s, frag_count=spec.frag_count,
+        level_bitrates=tuple(int(b) for b in spec.level_bitrates),
+        cdn_bandwidth_bps=spec.cdn_bps,
+        cdn_latency_ms=spec.cdn_latency_ms, seed=spec.seed)
+    recorder = FlightRecorder(trace_dir, "twin00",
+                              clock=harness.clock.now,
+                              registry=harness.metrics,
+                              counter_filter=_is_twin_family)
+    sampler = TwinSampler(harness, spec.window_s * 1000.0,
+                          recorder=recorder)
+    all_ids = [f"p{i}" for i in range(spec.total_peers)]
+    if regional_loss:
+        def set_region_loss(rate):
+            for cell in sorted(CELLULAR):
+                for other in all_ids:
+                    if other != cell:
+                        harness.network.set_link(cell, other,
+                                                 loss_rate=rate)
+        harness.clock.call_later(LOSS_START_S * 1000.0,
+                                 lambda: set_region_loss(1.0))
+        harness.clock.call_later(LOSS_END_S * 1000.0,
+                                 lambda: set_region_loss(0.0))
+    joins = spec.join_times_s()
+    for i in sorted(range(len(joins)), key=lambda i: (joins[i], i)):
+        harness.run(max(joins[i] * 1000.0 - harness.clock.now(), 0.0))
+        peer = f"p{i}"
+        harness.add_peer(
+            peer, uplink_bps=spec.uplink_bps,
+            p2p_config=dict(CELLULAR_CFG if peer in CELLULAR
+                            else BROADBAND_CFG))
+    harness.run(spec.watch_s * 1000.0 - harness.clock.now())
+    recorder.close()
+    assert sampler.windows == spec.n_windows
+    return recorder.path
+
+
+def part_a(root, spec):
+    """Merge exactness + path independence + determinism."""
+    print(f"slo-gate A: merge exactness "
+          f"({spec.total_peers} peers, {spec.n_windows} windows)")
+    shard = run_plane(spec, os.path.join(root, "a"), True)
+    _meta, events = read_shard(shard)
+    single = frames_from_events(events)
+    paths = split_shard(shard, os.path.join(root, "a-split"), 4)
+    merged = frames_from_shards(paths)
+    check(merged == single,
+          "4-shard mux merge == single-shard frames exactly "
+          "(quantile columns included)")
+    check(single.n_windows == spec.n_windows,
+          f"full window count reconstructed "
+          f"({single.n_windows}/{spec.n_windows})")
+
+    # path independence: incremental tail-follow of GROWING shards,
+    # cut at arbitrary byte offsets (torn tails mid-poll), equals
+    # the batch replay
+    grow_dir = os.path.join(root, "a-grow")
+    os.makedirs(grow_dir)
+    contents = []
+    grow_paths = []
+    for path in paths:
+        with open(path, "rb") as fh:
+            contents.append(fh.read())
+        grow_paths.append(os.path.join(grow_dir,
+                                       os.path.basename(path)))
+        open(grow_paths[-1], "wb").close()
+    mux = ShardMuxFollower(grow_paths)
+    steps = 7
+    offsets = [0] * len(contents)
+    rows = 0
+    for step in range(1, steps + 1):
+        for i, data in enumerate(contents):
+            # deliberately not newline-aligned: the torn tail must
+            # stay buffered in the file until its newline lands
+            target = (len(data) * step) // steps + (i * 13 if
+                                                   step < steps else 0)
+            target = min(target, len(data))
+            with open(grow_paths[i], "ab") as fh:
+                fh.write(data[offsets[i]:target])
+            offsets[i] = target
+        rows += len(mux.poll())
+    check(mux.frame() == single,
+          f"incremental mux tail-follow (7 torn-tail growth steps) "
+          f"== batch replay ({rows} rows)")
+
+    # determinism: same seed, same merged frames
+    shard2 = run_plane(spec, os.path.join(root, "a2"), True)
+    paths2 = split_shard(shard2, os.path.join(root, "a2-split"), 4)
+    check(frames_from_shards(paths2) == merged,
+          "same-seed rerun reproduces the merged frames exactly")
+    return shard, paths
+
+
+def part_b(root, spec, paths):
+    """Dead shard: excluded and counted, never silently merged."""
+    print("slo-gate B: dead-shard watermark stall")
+    cut_at = spec.n_windows // 2
+    dead_dir = os.path.join(root, "b")
+    os.makedirs(dead_dir)
+    dead_paths = []
+    victim = None
+    for i, path in enumerate(paths):
+        out = os.path.join(dead_dir, os.path.basename(path))
+        dead_paths.append(out)
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.readlines()
+        if i != 1:
+            with open(out, "w", encoding="utf-8") as fh:
+                fh.writelines(lines)
+            continue
+        victim = os.path.basename(path)[:-len(".jsonl")]
+        marks = 0
+        with open(out, "w", encoding="utf-8") as fh:
+            for line in lines:
+                fh.write(line)
+                if f'"{TWIN_WINDOW_MARK}"' in line \
+                        and '"kind": "mark"' in line:
+                    marks += 1
+                    if marks >= cut_at:
+                        break
+    registry = MetricsRegistry()
+    mux = ShardMuxFollower(dead_paths, dead_after_polls=3,
+                           registry=registry)
+    for _ in range(12):  # files are static: polls past the first
+        mux.poll()       # are pure no-progress stall evidence
+    check(mux.windows == spec.n_windows,
+          f"all {spec.n_windows} windows closed despite the dead "
+          f"shard (got {mux.windows})")
+    excluded = [i for i, e in enumerate(mux.exclusions) if e]
+    check(excluded == list(range(cut_at, spec.n_windows))
+          and all(mux.exclusions[i] == (victim,) for i in excluded),
+          f"windows {cut_at}..{spec.n_windows - 1} each record the "
+          f"dead shard {victim} as excluded ({len(excluded)} "
+          f"windows)")
+    dead = {labels.get("shard"): v for labels, v in
+            registry.series("mux.shard_dead")}
+    excl = {labels.get("shard"): v for labels, v in
+            registry.series("mux.excluded_windows")}
+    check(dead == {victim: 1},
+          f"mux.shard_dead counted exactly once for {victim}: {dead}")
+    check(excl == {victim: spec.n_windows - cut_at},
+          f"mux.excluded_windows counted per window: {excl}")
+
+
+def part_c(root, spec, shard, paths):
+    """Controller decisions are shard-layout independent."""
+    print("slo-gate C: controller single-vs-multi-shard identity")
+    # the forecast spec is the control-gate scenario family (scarce
+    # supply) so the knob lattice moves the forecast and the replay
+    # actually actuates; it shares the recorded shard's membership
+    # shape (same audience, same windows)
+    scenario = {"seed": spec.seed, "n_peers": spec.n_peers,
+                "wave_peers": spec.wave_peers,
+                "uplink_bps": 900_000.0, "cdn_bps": 1_200_000.0,
+                "watch_s": spec.watch_s, "window_s": spec.window_s}
+    spec_path = os.path.join(root, "control_spec.json")
+    with open(spec_path, "w", encoding="utf-8") as fh:
+        json.dump({"scenario": scenario, "bands_path": BANDS_PATH,
+                   "swarm_id": "slo-gate", **CONTROL_SPEC}, fh)
+    cache_dir = os.path.join(root, "cache")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def replay(tag, shards):
+        out = os.path.join(root, f"{tag}.json")
+        args = [sys.executable,
+                os.path.join(_REPO, "tools", "control.py"),
+                "--spec", spec_path,
+                "--actuate-log",
+                os.path.join(root, f"{tag}_acts.jsonl"),
+                "--cache-dir", cache_dir, "--out", out]
+        for s in shards:
+            args.extend(["--shard", s])
+        proc = subprocess.run(args, env=env, capture_output=True,
+                              text=True)
+        return proc, out
+
+    proc, single_out = replay("single", [shard])
+    check(proc.returncode == 0,
+          f"single-shard replay exited 0 (stderr: "
+          f"{proc.stderr.strip()[-200:]})")
+    proc, mux_out = replay("mux", paths)
+    check(proc.returncode == 0,
+          f"4-shard replay exited 0 (stderr: "
+          f"{proc.stderr.strip()[-200:]})")
+    with open(single_out, encoding="utf-8") as fh:
+        single_doc = json.load(fh)
+    with open(mux_out, encoding="utf-8") as fh:
+        mux_doc = json.load(fh)
+    check(json.dumps(single_doc["decisions"], sort_keys=True)
+          == json.dumps(mux_doc["decisions"], sort_keys=True),
+          f"decision sequences bit-identical single vs 4-shard "
+          f"ingest ({single_doc['ticks']} ticks)")
+    actuations = [d for d in single_doc["decisions"]
+                  if d["action"] == "actuate"]
+    check(len(actuations) >= 1,
+          f"the identity is not vacuous: {len(actuations)} "
+          f"actuation(s)")
+    epochs = {}
+    for tag in ("single", "mux"):
+        with open(os.path.join(root, f"{tag}_acts.jsonl"),
+                  encoding="utf-8") as fh:
+            epochs[tag] = [json.loads(line)["epoch"]
+                           for line in fh if line.strip()]
+    check(epochs["single"] == epochs["mux"]
+          and epochs["single"] == [d["epoch"] for d in actuations],
+          f"actuation logs hold identical epochs: {epochs}")
+
+
+def measure_slo(root, spec, regional_loss, tag):
+    """One run through the full pipeline: record, split per cohort
+    region, mux with per-shard rows, evaluate the committed SLOs
+    (the evaluator's marks recorded for the consumers)."""
+    shard = run_plane(spec, os.path.join(root, tag), regional_loss)
+    paths = split_shard(
+        shard, os.path.join(root, f"{tag}-split"), 2,
+        prefix="region",
+        assign=lambda peer: 1 if peer in CELLULAR else 0)
+    mux = ShardMuxFollower(paths, per_shard=True)
+    mux.poll()
+    registry = MetricsRegistry()
+    slo_recorder = FlightRecorder(os.path.join(root, f"{tag}-slo"),
+                                  "slo00", registry=registry)
+    evaluator = evaluate_mux(mux, SLO_SPECS, registry=registry,
+                             recorder=slo_recorder,
+                             cohort_of=cohort_of,
+                             warmup_windows=SLO_WARMUP_WINDOWS)
+    slo_recorder.close()
+    return evaluator, registry, os.path.join(root, f"{tag}-slo")
+
+
+def alert_digest(alert):
+    """The committed-artifact view of one alert (the deterministic
+    attribution facts)."""
+    return {"slo": alert["slo"], "metric": alert["metric"],
+            "quantile": alert["quantile"],
+            "window": alert["window"], "t_s": alert["t_s"],
+            "burn_fast": alert["burn_fast"],
+            "burn_slow": alert["burn_slow"],
+            "fast_windows": alert["fast_windows"],
+            "slow_windows": alert["slow_windows"],
+            "worst_shard": alert["worst_shard"],
+            "worst_cohort": alert["worst_cohort"]}
+
+
+def part_d(root, spec, write_artifact):
+    """The SLO layer: clean run silent, regional loss attributed."""
+    print("slo-gate D: SLO burn-rate alerts")
+    clean_ev, _reg, _dir = measure_slo(root, spec, False, "d-clean")
+    loss_ev, loss_reg, slo_dir = measure_slo(root, spec, True,
+                                             "d-loss")
+    check(len(clean_ev.alerts) == 0,
+          f"clean run fires ZERO alerts "
+          f"({json.dumps(clean_ev.summary())})")
+    delivery = [a for a in loss_ev.alerts
+                if a["slo"] == "delivery-offload"]
+    check(len(loss_ev.alerts) == 1 and len(delivery) == 1,
+          f"regional loss fires exactly the delivery alert "
+          f"({[a['slo'] for a in loss_ev.alerts]})")
+    if delivery:
+        alert = delivery[0]
+        check(alert["worst_cohort"] is not None
+              and alert["worst_cohort"]["cohort"] == "cellular",
+              f"alert names the cellular cohort: "
+              f"{alert['worst_cohort']}")
+        check(alert["worst_shard"] is not None
+              and alert["worst_shard"]["shard"] == "region01",
+              f"alert names the cellular region's shard: "
+              f"{alert['worst_shard']}")
+        loss_w0 = int(LOSS_START_S // spec.window_s)
+        check(loss_w0 <= alert["window"] <= loss_w0 + 5,
+              f"alert fired inside the loss window "
+              f"(window {alert['window']}, loss opens at "
+              f"{loss_w0})")
+        check(alert["burn_fast"] > 2.0 and alert["burn_slow"] > 2.0,
+              f"both burn windows above threshold "
+              f"(fast {alert['burn_fast']}, slow "
+              f"{alert['burn_slow']})")
+    alerts_counted = {labels.get("slo"): v for labels, v in
+                      loss_reg.series("slo.alerts")}
+    check(alerts_counted == {"delivery-offload": 1},
+          f"slo.alerts counted exactly once: {alerts_counted}")
+
+    # the committed artifact
+    doc = {
+        "meta": {
+            "what": "fleet SLO objectives + the gate's measured "
+                    "burn-rate results (tools/slo_gate.py "
+                    "--write-artifact)",
+            "scenario": {
+                "peers": spec.total_peers,
+                "broadband": spec.total_peers - len(CELLULAR),
+                "cellular": len(CELLULAR),
+                "watch_s": spec.watch_s, "window_s": spec.window_s,
+                "loss_window_s": [LOSS_START_S, LOSS_END_S],
+                "warmup_windows": SLO_WARMUP_WINDOWS,
+                "seed": spec.seed},
+        },
+        "slos": [s.as_dict() for s in SLO_SPECS],
+        "results": {
+            "clean": clean_ev.summary(),
+            "regional_loss": {
+                "summary": loss_ev.summary(),
+                "alerts": [alert_digest(a) for a in loss_ev.alerts],
+            },
+        },
+    }
+    if write_artifact:
+        atomic_write_text(ARTIFACT_PATH,
+                          json.dumps(doc, indent=1) + "\n")
+        print(f"# slo-gate: wrote {ARTIFACT_PATH}", file=sys.stderr)
+    elif not os.path.exists(ARTIFACT_PATH):
+        check(False, f"committed artifact {ARTIFACT_PATH} missing — "
+                     f"run --write-artifact")
+    else:
+        with open(ARTIFACT_PATH, encoding="utf-8") as fh:
+            committed = json.load(fh)
+        check(committed.get("slos") == doc["slos"],
+              "committed SLO specs match the gate's objectives")
+        check(committed.get("results") == doc["results"],
+              "measured burn-rate results match the committed "
+              "SLO_r12.json exactly")
+    return slo_dir
+
+
+def part_consumers(slo_dir):
+    """The satellite consumers hold on the SLO event stream."""
+    from fleet_console import render_frame
+    from trace_export import export_dir
+
+    events = export_dir(slo_dir)["traceEvents"]
+    alerts = [e for e in events if e.get("ph") == "i"
+              and str(e.get("name", "")).startswith("slo:")]
+    check(len(alerts) == 1,
+          f"Perfetto export renders the SLO alert instant on its "
+          f"own row ({len(alerts)})")
+    burn_tracks = {e.get("name") for e in events
+                   if e.get("ph") == "C"
+                   and str(e.get("name", "")).startswith("slo burn")}
+    check(len(burn_tracks) >= 1,
+          f"Perfetto export renders burn-rate counter tracks "
+          f"({sorted(burn_tracks)})")
+    panel = render_frame(trace_dir=slo_dir, slo=True)
+    check("slo" in panel and "burn" in panel
+          and "delivery-offload" in panel,
+          f"console --slo panel renders (got: {panel[:200]!r})")
+    empty = render_frame(trace_dir=slo_dir and os.path.dirname(
+        slo_dir), slo=True)
+    check("no SLO events" in empty,
+          f"console --slo degrades gracefully without SLO events "
+          f"(got: {empty[:120]!r})")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--write-artifact", action="store_true",
+                    help="re-measure and rewrite the committed "
+                         "SLO_r12.json (deliberate recalibration, "
+                         "the --write-bands pattern)")
+    args = ap.parse_args()
+    spec = gate_spec()
+    global CELLULAR
+    CELLULAR = cellular_ids(spec)
+    with tempfile.TemporaryDirectory(prefix="slo-gate-") as root:
+        shard, paths = part_a(root, spec)
+        part_b(root, spec, paths)
+        part_c(root, spec, shard, paths)
+        slo_dir = part_d(root, spec, args.write_artifact)
+        part_consumers(slo_dir)
+
+    failed = [what for ok, what in CHECKS if not ok]
+    print(f"slo-gate: {len(CHECKS) - len(failed)}/{len(CHECKS)} "
+          f"checks passed")
+    if failed:
+        for what in failed:
+            print(f"slo-gate FAILED: {what}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

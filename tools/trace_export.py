@@ -25,7 +25,15 @@ causally-ordered timeline:
   artifact), PAIRED twin calibration tracks: per scenario, one
   counter track per frame metric carrying BOTH planes' window
   values as two series (``sim`` / ``real``) — a sim↔real divergence
-  renders as two visibly separating lines in ui.perfetto.dev.
+  renders as two visibly separating lines in ui.perfetto.dev.  The
+  quantile frame columns (``rebuffer_ms_p50/p95/p99``,
+  engine/digest.py) each get their OWN track, so the tail and the
+  median render as separate lines;
+- SLO events (engine/slo.py) on their own row and tracks:
+  ``slo_alert`` marks as instants on the ``slo`` thread (worst
+  shard/cohort attribution in ``args``), ``slo_window`` marks as
+  per-objective burn-rate (fast+slow series) and budget-remaining
+  counter tracks.
 
 Timestamps are microseconds relative to the earliest event across
 all shards; span events use their recorded start stamp + measured
@@ -60,13 +68,15 @@ from hlsjs_p2p_wrapper_tpu.engine.twinframe import (  # noqa: E402
 
 #: thread ids within each host's process (named via thread_name
 #: metadata): spans + fault instants on DISPATCH, lease steps on
-#: LEASE, control-tick marks on CONTROL — their own Perfetto row, so
-#: a chaos window, the forecast dispatch spans, and the knob change
-#: line up visually on one timeline; counter tracks attach to the
-#: process, not a thread
+#: LEASE, control-tick marks on CONTROL, SLO alert instants on SLO
+#: — their own Perfetto row, so a chaos window, the forecast
+#: dispatch spans, the knob change, and the burn alert line up
+#: visually on one timeline; counter tracks attach to the process,
+#: not a thread
 TID_DISPATCH = 1
 TID_LEASE = 2
 TID_CONTROL = 3
+TID_SLO = 4
 
 
 def _micros(t, t0) -> float:
@@ -128,6 +138,8 @@ def export_trace(events, host_meta=None) -> dict:
         out.append({"ph": "M", "name": "thread_name", "pid": pid,
                     "tid": TID_CONTROL,
                     "args": {"name": "control"}})
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": TID_SLO, "args": {"name": "slo"}})
     # cumulative per-host counter tracks
     counts = {host: {"retries": 0, "cache_hits": 0, "cache_misses": 0,
                      "rows": 0, "twin_cdn_bytes": 0,
@@ -157,6 +169,37 @@ def export_trace(events, host_meta=None) -> dict:
                         "ts": _micros(event["t"], t0),
                         "args": {"actuations":
                                  counts[host]["actuations"]}})
+        elif kind == "mark" and event.get("name") == "slo_window":
+            # per-objective burn-rate + budget counter tracks (the
+            # SLO layer's slo_window marks, engine/slo.py): the
+            # budget draining and both burn windows as lines
+            slo = event.get("slo", "?")
+            args = {}
+            if event.get("burn_fast") is not None:
+                args["fast"] = event["burn_fast"]
+                args["slow"] = event.get("burn_slow")
+            if args:
+                out.append({"ph": "C", "pid": pid,
+                            "name": f"slo burn {slo}",
+                            "ts": _micros(event["t"], t0),
+                            "args": args})
+            if event.get("budget_remaining") is not None:
+                out.append({"ph": "C", "pid": pid,
+                            "name": f"slo budget {slo}",
+                            "ts": _micros(event["t"], t0),
+                            "args": {"remaining":
+                                     event["budget_remaining"]}})
+        elif kind == "mark" and event.get("name") == "slo_alert":
+            # the alert instant on its own SLO row, attribution in
+            # args (worst shard/cohort, burn rates)
+            out.append({
+                "ph": "i", "s": "t", "pid": pid, "tid": TID_SLO,
+                "name": f"slo:{event.get('slo', '?')}",
+                "cat": "slo", "ts": _micros(event["t"], t0),
+                "args": {k: event.get(k) for k in
+                         ("metric", "quantile", "window",
+                          "burn_fast", "burn_slow", "worst_shard",
+                          "worst_cohort")}})
         elif kind == "lease":
             out.append(_lease_instant(event, pid, t0))
         elif kind == "row":

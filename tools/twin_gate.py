@@ -80,11 +80,15 @@ CHAOS_SPECS = "loss@40-70,latency@90-110"
 #: by two windows in the SIM ONLY (a scenario-mapping error)
 PERTURB_SHIFT_WINDOWS = 2
 
-#: metrics the gate REQUIRES bands for (the agreement trio + rates);
-#: a band artifact missing one of these is a gate failure, not a
-#: silently-skipped check
+#: metrics the gate REQUIRES bands for (the agreement trio + rates +
+#: the fleet round's stall-quantile tail columns — the jnp plane's
+#: binned digest vs the event plane's must agree within bands, not
+#: just the means); a band artifact missing one of these is a gate
+#: failure, not a silently-skipped check
 REQUIRED_METRICS = ("offload", "rebuffer", "present_peers", "joins",
-                    "cdn_rate_bps", "p2p_rate_bps", "stalled_peers")
+                    "cdn_rate_bps", "p2p_rate_bps", "stalled_peers",
+                    "rebuffer_ms_p50", "rebuffer_ms_p95",
+                    "rebuffer_ms_p99")
 
 
 def gate_scenarios():
