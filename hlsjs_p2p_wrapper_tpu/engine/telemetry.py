@@ -80,17 +80,22 @@ class Counter:
     ``_count`` contract: bursts are exactly when unlocked ``+=``
     drops increments).
 
-    ``_listeners`` is the registry's shared bump-listener list
-    (:meth:`MetricsRegistry.add_listener`) — a directly-constructed
-    Counter has none.  Listeners fire OUTSIDE the value lock (they
-    may buffer to disk) and only on ``inc``: ``set_value`` mirrors an
-    externally-accumulated total, which no event stream could replay
+    ``_listeners`` is the tuple of registry bump-listeners whose
+    name filter admits this instrument, bound by the registry at
+    creation and rebound on :meth:`MetricsRegistry.add_listener` /
+    ``remove_listener`` — a directly-constructed Counter has none.
+    Filtering at bind time means an instrument outside every
+    listener's filter pays ZERO per-bump listener cost (the armed
+    flight recorder stops taxing families it would only discard).
+    Listeners fire OUTSIDE the value lock (they may buffer to disk)
+    and only on ``inc``: ``set_value`` mirrors an externally-
+    accumulated total, which no event stream could replay
     additively, so it stays invisible by design."""
 
     kind = "counter"
 
-    #: shared with the owning registry's listener list; the empty
-    #: tuple default keeps direct construction listener-free
+    #: bound by the owning registry per instrument; the empty tuple
+    #: default keeps direct construction listener-free
     _listeners: tuple = ()
 
     def __init__(self, name: str, labels: Optional[Dict] = None):
@@ -271,25 +276,50 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._instruments: Dict[Tuple[str, _Labels], object] = {}
-        # counter-bump listeners, shared BY REFERENCE with every
-        # registry-owned Counter: add_listener after the fact reaches
-        # instruments created before it (the flight recorder attaches
-        # once and sees every later bump, whoever memoized the handle)
-        self._bump_listeners: list = []
+        # counter-bump listener specs ``(listener, name_filter)``;
+        # every registry-owned Counter carries the tuple of listeners
+        # whose filter admits its name, rebound here on add/remove so
+        # attaching after the fact still reaches instruments created
+        # before it (the flight recorder attaches once and sees every
+        # later bump, whoever memoized the handle)
+        self._listener_specs: list = []
 
-    def add_listener(self, listener) -> None:
+    def _listeners_for(self, name: str) -> tuple:
+        return tuple(listener for listener, name_filter
+                     in self._listener_specs
+                     if name_filter is None or name_filter(name))
+
+    def _rebind_listeners(self) -> None:
+        # caller holds self._lock
+        for inst in self._instruments.values():
+            if isinstance(inst, Counter):
+                inst._listeners = self._listeners_for(inst.name)
+
+    def add_listener(self, listener, name_filter=None) -> None:
         """Subscribe ``listener(name, labels, n)`` to every counter
         ``inc`` on this registry — the flight recorder's correlation
         hook (engine/tracer.py): one bump, one causally-ordered
-        event.  Listeners run outside the instrument lock and must
-        not raise (a tracing failure must never fail the counted
-        operation — buffer, don't I/O, in the hot path)."""
-        if listener not in self._bump_listeners:
-            self._bump_listeners.append(listener)
+        event.  ``name_filter`` (a ``name -> bool`` predicate)
+        restricts the subscription at BIND time: instruments it
+        rejects never call the listener, so filtered-out families
+        pay nothing per bump.  Listeners run outside the instrument
+        lock and must not raise (a tracing failure must never fail
+        the counted operation — buffer, don't I/O, in the hot
+        path)."""
+        with self._lock:
+            if any(listener == sub for sub, _ in self._listener_specs):
+                return
+            self._listener_specs.append((listener, name_filter))
+            self._rebind_listeners()
 
     def remove_listener(self, listener) -> None:
-        if listener in self._bump_listeners:
-            self._bump_listeners.remove(listener)
+        with self._lock:
+            kept = [spec for spec in self._listener_specs
+                    if spec[0] != listener]
+            if len(kept) == len(self._listener_specs):
+                return
+            self._listener_specs = kept
+            self._rebind_listeners()
 
     def _get(self, cls, name: str, labels: Dict, **kwargs):
         key = (name, _label_key(labels))
@@ -306,7 +336,7 @@ class MetricsRegistry:
                                        if edges is None else edges)
                 inst = cls(name, labels, **kwargs)
                 if cls is Counter:
-                    inst._listeners = self._bump_listeners
+                    inst._listeners = self._listeners_for(name)
                 self._instruments[key] = inst
             elif not isinstance(inst, cls):
                 raise ValueError(

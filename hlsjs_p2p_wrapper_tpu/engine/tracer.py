@@ -12,20 +12,26 @@ unifying layer: a single append-only structured EVENT STREAM with a
 propagated trace context, written per host and merged causally.
 
 **The stream.**  A :class:`FlightRecorder` owns one per-host shard
-(``<trace_dir>/<host_id>.jsonl``) whose first line is a ``meta``
-record (``run_id`` / ``host``) and whose every later line is one
-event::
+(``<trace_dir>/<host_id>.jsonl``) whose first line is a JSONL
+``meta`` record (``run_id`` / ``host``) and whose every later
+record is one event::
 
     {"t": <clock>, "host": "host01", "seq": 17, "kind": "span",
      "name": "dispatch", "dur_s": 0.41,
      "ctx": {"group": 0, "chunk": 3, "attempt": 0}}
 
-Events are BUFFERED in memory and made durable by :meth:`flush` —
-the same append + flush + fsync + torn-tail-tolerant record
-discipline the sweep journal uses (one fsync per drained chunk, not
-per event; readers share :func:`~.artifact_cache
-.read_jsonl_tolerant`, so a SIGKILL mid-append costs at most the
-torn tail line).  The dispatch engine flushes finalize events
+By default (``binary=True``) events land as the compact CRC-framed
+records of :mod:`~.recordio` — hot families as fixed-width frames,
+everything else as framed chunked JSON — while ``binary=False``
+writes plain JSON lines; either way the record DICTS above are
+exactly what every reader returns, and a shard may mix both freely
+(readers sniff the format per record on the lead byte).  Events are
+BUFFERED in memory and made durable by :meth:`flush` — the same
+append + flush + fsync + torn-tail-tolerant record discipline the
+sweep journal uses (one fsync per drained chunk, not per event;
+readers share :func:`~.recordio.read_records`, so a SIGKILL
+mid-append costs at most the torn tail frame or line, and a flipped
+bit costs exactly one counted record).  The dispatch engine flushes finalize events
 BEFORE the journal fsyncs its row keys, so "journaled" always
 implies "its finalize event is on disk" — the direction the trace
 gate asserts.  Two hosts never share a shard (the journal-shard
@@ -85,7 +91,8 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from .artifact_cache import _digest, read_jsonl_tolerant
+from . import recordio
+from .artifact_cache import _digest
 from .telemetry import MetricsRegistry
 
 #: the registry counter families the trace gate replays and the
@@ -148,7 +155,7 @@ class FlightRecorder:
     def __init__(self, trace_dir: str, host_id: str = "host00", *,
                  run_id: Optional[str] = None, clock=time.time,
                  registry: Optional[MetricsRegistry] = None,
-                 counter_filter=None):
+                 counter_filter=None, binary: bool = True):
         #: optional predicate on the counter FAMILY name: when set,
         #: only matching bumps become events (explicit emits — spans,
         #: marks, rows, leases — are never filtered).  For recorders
@@ -166,10 +173,17 @@ class FlightRecorder:
         self._clock = clock
         self._lock = threading.Lock()
         self._seq = 0
-        self._buffer: List[str] = []
+        self._buffer: List[bytes] = []
         self._local = threading.local()
         self._registries: List[MetricsRegistry] = []
-        self._fh = open(self.path, "a", encoding="utf-8")
+        #: ``binary=True`` (the default) frames hot families through
+        #: the recordio codec; ``binary=False`` keeps the pre-0.18
+        #: all-JSONL shard.  Either way the file is ONE mixed-format
+        #: stream read back by the same sniffing reader, so the
+        #: parameter changes bytes, never meaning.
+        self.binary = binary
+        self._encoder = recordio.ShardEncoder() if binary else None
+        self._fh = open(self.path, "ab")
         self._write_now({"kind": "meta", "run_id": self.run_id,
                          "host": host_id})
         if registry is not None:
@@ -208,9 +222,13 @@ class FlightRecorder:
 
     def _write_now(self, record: dict) -> None:
         """One immediately-durable record (the shard meta header):
-        whole line, flush, fsync."""
+        whole line, flush, fsync.  The meta stays a JSONL line even
+        in binary mode — it is the shard's self-describing head, and
+        `head -1` / any text tool must keep working on it."""
+        line = (json.dumps(record)  # jsonl-ok: meta header line
+                + "\n").encode("utf-8")
         with self._lock:
-            self._fh.write(json.dumps(record) + "\n")
+            self._fh.write(line)
             self._fh.flush()
             os.fsync(self._fh.fileno())
 
@@ -226,7 +244,14 @@ class FlightRecorder:
         with self._lock:
             record["seq"] = self._seq
             self._seq += 1
-            self._buffer.append(json.dumps(record))
+            if self._encoder is not None:
+                # encode under the lock: the shard's string table
+                # must be appended in buffer order
+                self._buffer.append(self._encoder.encode(record))
+            else:
+                self._buffer.append(
+                    (json.dumps(record)  # jsonl-ok: binary=False
+                     + "\n").encode("utf-8"))
         return record
 
     def flush(self, fsync: bool = True) -> None:
@@ -247,8 +272,7 @@ class FlightRecorder:
         with self._lock:
             if not self._buffer:
                 return
-            self._fh.write("".join(line + "\n"
-                                   for line in self._buffer))
+            self._fh.write(b"".join(self._buffer))
             self._buffer.clear()
             self._fh.flush()
             if fsync:
@@ -301,7 +325,12 @@ class FlightRecorder:
         ``dispatch_faults{reason=oom,action=bisect}`` increment to
         the exact (group, chunk, attempt) that suffered it."""
         if registry not in self._registries:
-            registry.add_listener(self._on_bump)
+            # the filter rides into the registry as the listener's
+            # bind-time name_filter, so instruments outside it never
+            # call back at all (zero per-bump cost, not a cheap
+            # early return)
+            registry.add_listener(self._on_bump,
+                                  name_filter=self._counter_filter)
             self._registries.append(registry)
         return self
 
@@ -313,7 +342,26 @@ class FlightRecorder:
     def _on_bump(self, name: str, labels, n) -> None:
         if (self._counter_filter is not None
                 and not self._counter_filter(name)):
+            # belt-and-suspenders: bind-time filtering already keeps
+            # filtered instruments from calling here, but a listener
+            # invoked directly (tests, foreign registries) must still
+            # honor the filter
             return
+        encoder = self._encoder
+        if encoder is not None \
+                and not getattr(self._local, "stack", None):
+            # the armed hot path: no context frames, so skip the
+            # record dict entirely — clock, labels memo, one framed
+            # struct.pack under the buffer lock
+            labels_s = _labels_str(labels)
+            t = self._clock()
+            with self._lock:
+                encoded = encoder.encode_bump(
+                    t, self.host_id, name, labels_s, n, self._seq)
+                if encoded is not None:
+                    self._seq += 1
+                    self._buffer.append(encoded)
+                    return
         self.emit("counter", name=name, labels=_labels_str(labels),
                   n=n)
 
@@ -346,10 +394,14 @@ def shard_paths(trace_dir: str) -> List[str]:
 def read_shard(path: str) -> Tuple[Optional[dict], List[dict]]:
     """One shard's ``(meta, events)`` — torn-tail tolerant, so a
     shard read mid-write (or SIGKILLed mid-append) yields the
-    durable prefix and never raises on the tail."""
+    durable prefix and never raises on the tail.  Format-sniffing
+    (:func:`~.recordio.read_records`): binary, JSONL, and mixed
+    shards all decode here, so every pre-0.18 consumer reads new
+    shards with zero call-site changes."""
     meta = None
     events = []
-    for record in read_jsonl_tolerant(path):
+    records, _stats = recordio.read_records(path)
+    for record in records:
         if record.get("kind") == "meta":
             meta = record
         else:

@@ -61,10 +61,18 @@ them on the virtual window clock with explicit per-shard watermarks
 — merged rows bit-identical to single-shard ingest under any peer
 partition, dead shards excluded-and-counted (``mux.*`` families),
 per-shard sub-frames for the SLO layer's attribution
-(engine/slo.py).  :func:`frames_from_shards` is the batch form.
+(engine/slo.py).  :func:`frames_from_shards` is the batch form,
+and it replays binary shards (engine/recordio.py — the default
+recorder format) through a VECTORIZED columnar tier when it can:
+mmap'd frame columns, window partitioning by ``searchsorted`` over
+the mark positions, per-key ``cumsum`` prefix totals — guarded by
+conservative qualification checks (any doubt routes to the
+always-correct dict-tier mux) and asserted bit-identical to it on
+every gate.
 
-Pure stdlib + host arithmetic — no jax import, so frames compare
-anywhere the artifacts travel (the triage-tool discipline).  Frames
+Pure stdlib + host arithmetic — no jax import (numpy only, lazily,
+for the columnar replay), so frames compare anywhere the artifacts
+travel (the triage-tool discipline).  Frames
 carry VirtualClock-derived timestamps only; this file is under
 tools/lint.py's injectable-clock rule, so a naked wall-clock read
 here is a lint failure by construction.
@@ -72,11 +80,11 @@ here is a lint failure by construction.
 
 from __future__ import annotations
 
-import json
 import os
 from collections import deque
 from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
 
+from . import recordio
 from .digest import (DEFAULT_EDGES, QuantileDigest,
                      quantiles_from_counts)
 
@@ -199,6 +207,14 @@ class FrameBuilder:
         if value != self._stall_ms.get(peer, 0.0):
             self._stalled.add(peer)
         self._stall_ms[peer] = value
+
+    def mark_stalled(self, peer: str) -> None:
+        """Mark ``peer``'s stall clock as having MOVED this window
+        even when the delta was zero — the columnar replay's pairing
+        for :meth:`add_stall`'s unconditional mark
+        (:meth:`set_stall_total` alone cannot distinguish a
+        zero-delta stall event from no event at all)."""
+        self._stalled.add(peer)
 
     # -- membership (both feeders) ------------------------------------
 
@@ -402,16 +418,25 @@ def frames_from_events(events: Iterable[dict], *,
 class ShardFollower:
     """Tolerant tail-follow of one flight-recorder shard: each
     :meth:`poll` yields the records that became COMPLETE since the
-    last poll — only whole lines are consumed (a torn tail stays
-    buffered in the file until its newline lands), and a line that
-    fails to parse is skipped, the ``read_jsonl_tolerant``
-    discipline applied to a growing file.  (Moved here from
-    engine/controller.py so the mux below can reuse it without the
-    observation plane importing the control plane.)"""
+    last poll — only whole records are consumed (a torn tail stays
+    buffered in the decoder until its closing bytes land), and a
+    record that fails to decode is counted and skipped, the
+    torn-tail discipline applied to a growing file.  The decoder is
+    a persistent :class:`~.recordio.RecordDecoder`, so binary,
+    JSONL, and mixed shards all follow identically.  (Moved here
+    from engine/controller.py so the mux below can reuse it without
+    the observation plane importing the control plane.)"""
 
     def __init__(self, path: str):
         self.path = path
         self._offset = 0
+        self._decoder = recordio.RecordDecoder()
+
+    @property
+    def stats(self) -> "recordio.DecodeStats":
+        """The follower's running decode accounting (bad frames /
+        torn tails), for the mux's corruption counters."""
+        return self._decoder.stats
 
     def poll(self) -> List[dict]:
         try:
@@ -420,20 +445,10 @@ class ShardFollower:
                 data = fh.read()
         except OSError:
             return []
-        end = data.rfind(b"\n")
-        if end < 0:
+        if not data:
             return []
-        chunk = data[:end + 1]
-        self._offset += len(chunk)
-        records = []
-        for line in chunk.split(b"\n"):
-            if not line.strip():
-                continue
-            try:
-                records.append(json.loads(line))
-            except ValueError:
-                continue  # torn/corrupt line: skip, never raise
-        return records
+        self._offset += len(data)
+        return self._decoder.feed(data)
 
 
 class _MuxLane:
@@ -772,15 +787,227 @@ class ShardMuxFollower:
 
 
 def frames_from_shards(paths: Iterable[str], *,
-                       source: str = "real") -> ObservationFrame:
-    """Batch replay of a finished shard set through the mux — by
-    construction the same partitioning as an incremental tail-follow
-    of the same shards (it IS the mux, applied to files that no
-    longer grow), and bit-identical to :func:`frames_from_events` of
-    the same traffic in one shard."""
+                       source: str = "real",
+                       engine: str = "auto") -> ObservationFrame:
+    """Batch replay of a finished shard set into the merged frame.
+
+    ``engine="auto"`` (the default) replays through the COLUMNAR
+    fast path when the shard set allows it — mmap'd vectorized
+    decode (:func:`~.recordio.frame_columns`), per-key running
+    totals sampled at the ``twin_window`` marks by ``searchsorted``
+    — and falls back to the mux dict tier whenever it cannot prove
+    bit-identity (misaligned marks, a key accumulating across
+    shards, hot families in the JSON tier, corruption).  Both
+    engines produce the SAME rows: the fast path assigns each key's
+    cumulative total (an f8 prefix sum — the identical additions in
+    the identical order as the incremental feed) into the one shared
+    :class:`FrameBuilder` before each window close, so
+    ``engine="mux"`` vs the default is a throughput choice, never a
+    semantic one — the PR 12 exactness contract, kept.
+    ``engine="columns"`` asserts the fast path (raises when it
+    declines; tests and the bench's decode-throughput rider)."""
+    paths = list(paths)
+    if engine in ("auto", "columns"):
+        frame = _frames_from_shard_columns(paths, source)
+        if frame is not None:
+            return frame
+        if engine == "columns":
+            raise ValueError(
+                "columnar replay declined these shards (no numpy, "
+                "misaligned marks, cross-shard keys, or hot events "
+                "in the JSON tier) — use engine='auto' for the mux "
+                "fallback")
+    elif engine != "mux":
+        raise ValueError(f"unknown frames_from_shards engine "
+                         f"{engine!r}")
     mux = ShardMuxFollower(paths, source=source)
     mux.poll()
     return mux.frame()
+
+
+def _shard_sort_ids(paths: List[str]) -> Optional[List[str]]:
+    """The mux's basename shard ids for a path list, or None when
+    they collide (the mux widens with parent components; the fast
+    path just hands the job back to it)."""
+    ids = []
+    for path in paths:
+        name = os.path.basename(os.path.normpath(path))
+        ids.append(name[:-len(".jsonl")]
+                   if name.endswith(".jsonl") else name)
+    return ids if len(set(ids)) == len(ids) else None
+
+
+def _twin_groups(np, cols):
+    """One shard's twin provenance in columnar form: per-key
+    ``(positions, running totals)`` for the cumulative families
+    (``twin.fetch_bytes`` by (peer, src), ``twin.stall_ms`` by
+    peer) and the pos-ordered membership events.  None when the
+    columnar form cannot reproduce the event-order contract — a hot
+    family riding the JSON tier (ctx-bearing bumps interleave with
+    the frame runs) or two label renderings colliding on one key."""
+    strings = cols.strings
+    membership: List[Tuple[int, str, str, float]] = []
+    for pos, record in cols.py_events:
+        if record.get("kind") != "counter":
+            continue
+        name = record.get("name", "")
+        if name in ("twin.fetch_bytes", "twin.stall_ms"):
+            return None
+        if name == "twin.peer":
+            labels = parse_labels(record.get("labels", ""))
+            event = labels.get("event")
+            if event in ("join", "leave"):
+                membership.append((pos, labels.get("peer", ""),
+                                   event, record.get("t", 0.0)))
+    fetch: Dict[Tuple[str, str], tuple] = {}
+    stall: Dict[str, tuple] = {}
+    if len(cols.ctr_pos):
+        name_ids = cols.ctr_name
+        labels_ids = cols.ctr_labels
+        for name_id in np.unique(name_ids).tolist():
+            # an unresolved id (its K_STR definition lost to a
+            # counted corruption) drops its rows — exactly the dict
+            # tier's unresolved-record accounting
+            name = strings.get(name_id)
+            if name not in ("twin.fetch_bytes", "twin.stall_ms",
+                            "twin.peer"):
+                continue
+            rows = np.flatnonzero(name_ids == name_id)
+            if name == "twin.peer":
+                row_pos = cols.ctr_pos[rows]
+                row_t = cols.ctr_t[rows]
+                row_labels = labels_ids[rows]
+                for j in range(len(rows)):
+                    labels_text = strings.get(int(row_labels[j]))
+                    if labels_text is None:
+                        continue
+                    labels = parse_labels(labels_text)
+                    event = labels.get("event")
+                    if event in ("join", "leave"):
+                        membership.append(
+                            (int(row_pos[j]),
+                             labels.get("peer", ""), event,
+                             float(row_t[j])))
+                continue
+            row_labels = labels_ids[rows]
+            for label_id in np.unique(row_labels).tolist():
+                labels_text = strings.get(label_id)
+                if labels_text is None:
+                    continue
+                labels = parse_labels(labels_text)
+                peer = labels.get("peer", "")
+                sel = rows[row_labels == label_id]
+                pos_g = cols.ctr_pos[sel]
+                # np.cumsum is the same left-to-right f8 additions
+                # the incremental feeders perform — prefix sums are
+                # bit-identical, which is the whole exactness trick
+                csum = np.cumsum(cols.ctr_n[sel])
+                if name == "twin.fetch_bytes":
+                    key = (peer, labels.get("src", ""))
+                    if key in fetch:
+                        return None
+                    fetch[key] = (pos_g, csum)
+                else:
+                    if peer in stall:
+                        return None
+                    stall[peer] = (pos_g, csum)
+    membership.sort(key=lambda item: item[0])
+    return fetch, stall, membership
+
+
+def _frames_from_shard_columns(paths: List[str], source: str
+                               ) -> Optional[ObservationFrame]:
+    """The columnar batch replay behind :func:`frames_from_shards`:
+    decode every shard to columns, prove the shard set replays
+    exactly (aligned marks, shard-local keys), then drive the one
+    shared :class:`FrameBuilder` from prefix sums sampled at the
+    marks.  Returns None whenever the mux dict tier must own the
+    job instead."""
+    try:
+        import numpy as np
+    except ImportError:      # pragma: no cover - numpy is baked in
+        return None
+    if not paths:
+        return None
+    if len({os.path.realpath(path) for path in paths}) != len(paths):
+        return None  # the mux's duplicate-shard refusal owns this
+    ids = _shard_sort_ids(paths)
+    if ids is None:
+        return None
+    order = sorted(range(len(paths)), key=lambda i: ids[i])
+    cols_list = []
+    for i in order:
+        try:
+            cols = recordio.frame_columns(paths[i])
+        except OSError:
+            return None
+        if cols is None:
+            return None
+        cols_list.append(cols)
+    first = cols_list[0]
+    n_marks = len(first.mark_pos)
+    if n_marks == 0:
+        return None
+    for cols in cols_list[1:]:
+        if len(cols.mark_pos) != n_marks \
+                or not np.array_equal(cols.mark_t, first.mark_t):
+            return None  # misaligned fleet: mux exclusions own this
+    shard_groups = []
+    seen_fetch: set = set()
+    seen_stall: set = set()
+    for cols in cols_list:
+        groups = _twin_groups(np, cols)
+        if groups is None:
+            return None
+        fetch, stall, _membership = groups
+        if seen_fetch & fetch.keys() or seen_stall & stall.keys():
+            # a key accumulating across shards interleaves additions
+            # in poll order — only the mux reproduces that
+            return None
+        seen_fetch |= fetch.keys()
+        seen_stall |= stall.keys()
+        shard_groups.append(groups)
+    builder = FrameBuilder(source,
+                           float(first.mark_window_ms[0]) / 1000.0)
+    totals = [[] for _ in range(n_marks)]
+    member_sched = [[] for _ in range(n_marks)]
+    for cols, (fetch, stall, membership) in zip(cols_list,
+                                                shard_groups):
+        mark_pos = cols.mark_pos
+        for (peer, src), (pos_g, csum) in fetch.items():
+            idx = np.searchsorted(pos_g, mark_pos, side="left")
+            for k in np.flatnonzero(
+                    np.diff(idx, prepend=0)).tolist():
+                totals[k].append(("b", peer, src,
+                                  float(csum[idx[k] - 1])))
+        for peer, (pos_g, csum) in stall.items():
+            idx = np.searchsorted(pos_g, mark_pos, side="left")
+            for k in np.flatnonzero(
+                    np.diff(idx, prepend=0)).tolist():
+                totals[k].append(("s", peer, None,
+                                  float(csum[idx[k] - 1])))
+        if membership:
+            mpos = np.asarray([m[0] for m in membership],
+                              dtype=np.int64)
+            windows = np.searchsorted(mark_pos, mpos, side="left")
+            for w, (_pos, peer, event, t) in zip(windows.tolist(),
+                                                 membership):
+                if w < n_marks:
+                    member_sched[w].append((peer, event, t))
+    for k in range(n_marks):
+        for peer, event, t in member_sched[k]:
+            if event == "join":
+                builder.set_join(peer, t)
+            else:
+                builder.set_leave(peer, t)
+        for what, peer, src, value in totals[k]:
+            if what == "b":
+                builder.set_bytes_total(peer, src, value)
+            else:
+                builder.set_stall_total(peer, value)
+                builder.mark_stalled(peer)
+        builder.close_window(float(first.mark_t[k]))
+    return builder.frame()
 
 
 def frames_from_timelines(columns, samples, *,

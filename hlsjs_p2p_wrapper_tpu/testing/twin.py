@@ -201,12 +201,22 @@ class TwinSampler:
     the open window)."""
 
     def __init__(self, harness: SwarmHarness, window_ms: float,
-                 recorder=None, source: str = "real"):
+                 recorder=None, source: str = "real",
+                 flush_every: int = 1):
         self.harness = harness
         self.window_ms = float(window_ms)
         self.recorder = recorder
         self.builder = FrameBuilder(source, window_ms / 1000.0)
         self.windows = 0
+        #: flush the recorder every Nth window instead of every one —
+        #: the batch-extraction setting (run_real_plane), where nobody
+        #: tails the shard live and per-window flush syscalls were a
+        #: measured share of the armed cost (bench.py
+        #: ``detail.fleet_ingest.armed``).  Live consumers (the
+        #: control/SLO gates' in-process tails) keep the default 1:
+        #: a window marked is a window visible.  SIGKILL now costs at
+        #: most the UNFLUSHED windows (≤ flush_every), not one.
+        self.flush_every = max(int(flush_every), 1)
         self._arm()
 
     def _arm(self) -> None:
@@ -229,11 +239,12 @@ class TwinSampler:
         if self.recorder is not None:
             self.recorder.mark(TWIN_WINDOW_MARK, window=self.windows,
                                window_ms=self.window_ms)
-            # OS-write durability is the per-window contract: a
+            # OS-write durability is the per-batch contract: a
             # SIGKILL'd writer keeps every flushed window; per-window
             # fsyncs only guard host crashes and were a measured
             # double-digit share of the armed cost (tracer.flush)
-            self.recorder.flush(fsync=False)
+            if (self.windows + 1) % self.flush_every == 0:
+                self.recorder.flush(fsync=False)
         self.windows += 1
         self._arm()
 
@@ -296,8 +307,11 @@ def run_real_plane(scenario: TwinScenario,
                                   registry=harness.metrics,
                                   counter_filter=_is_twin_family)
         shard_path = recorder.path
+    # batch extraction: nobody tails this shard live, so flush every
+    # 4th window (the recorder's close() lands the final partial
+    # batch) — a SIGKILL'd run keeps every flushed window exactly
     sampler = TwinSampler(harness, scenario.window_s * 1000.0,
-                          recorder=recorder)
+                          recorder=recorder, flush_every=4)
     # replay joins in TIME order, not list order: the wave cohort sits
     # after the base audience in join_times_s() but may land before
     # its tail (n_peers >= 10 at the default spacing), and the clamp
@@ -429,7 +443,8 @@ def scenario_from_observation(spec: TwinScenario, join_ms,
 
 
 def split_shard(shard_path: str, out_dir: str, n_shards: int,
-                prefix: str = "mux", assign=None) -> List[str]:
+                prefix: str = "mux", assign=None,
+                binary: bool = False) -> List[str]:
     """Re-shard ONE recorded flight-recorder shard into ``n_shards``
     per-host-shaped shards: every peer's ``twin.*`` events land on
     the shard ``crc32(peer) % n_shards`` picks (a peer lives on
@@ -443,11 +458,19 @@ def split_shard(shard_path: str, out_dir: str, n_shards: int,
     This is the gate's ground-truth construction: because the split
     preserves each peer's event order and window assignment exactly,
     a correct mux merge of the split MUST reproduce the single-shard
-    frames bit-for-bit (``tools/slo_gate.py``)."""
+    frames bit-for-bit (``tools/slo_gate.py``).
+
+    ``binary=True`` re-frames each output shard through its own
+    :class:`~.engine.recordio.ShardEncoder` (per-shard string
+    tables, meta line still JSONL) — the fleet-shaped input for the
+    columnar replay and its bench; the default keeps the splits as
+    plain JSONL, which the gate's text-level truncation checks
+    manipulate directly."""
     import json
     import os
     import zlib
 
+    from ..engine.recordio import ShardEncoder
     from ..engine.tracer import read_shard
     from ..engine.twinframe import TWIN_WINDOW_MARK, parse_labels
 
@@ -455,17 +478,29 @@ def split_shard(shard_path: str, out_dir: str, n_shards: int,
     meta, events = read_shard(shard_path)
     paths = [os.path.join(out_dir, f"{prefix}{i:02d}.jsonl")
              for i in range(n_shards)]
-    handles = [open(path, "w", encoding="utf-8") for path in paths]
+    handles = [open(path, "wb") for path in paths]
+    encoders = [ShardEncoder() if binary else None
+                for _ in range(n_shards)]
+
+    def write(i, event):
+        if encoders[i] is not None:
+            handles[i].write(encoders[i].encode(event))
+        else:
+            handles[i].write(
+                (json.dumps(event)  # jsonl-ok: text-mode split
+                 + "\n").encode("utf-8"))
+
     try:
         for i, fh in enumerate(handles):
             header = dict(meta or {"kind": "meta"})
             header["host"] = f"{prefix}{i:02d}"
-            fh.write(json.dumps(header) + "\n")
+            fh.write((json.dumps(header)  # jsonl-ok: meta header
+                      + "\n").encode("utf-8"))
         for event in events:
             if event.get("kind") == "mark" \
                     and event.get("name") == TWIN_WINDOW_MARK:
-                for fh in handles:
-                    fh.write(json.dumps(event) + "\n")
+                for i in range(n_shards):
+                    write(i, event)
                 continue
             peer = parse_labels(event.get("labels", "")).get("peer")
             if not peer:
@@ -474,7 +509,7 @@ def split_shard(shard_path: str, out_dir: str, n_shards: int,
                 shard = int(assign(peer)) % n_shards
             else:
                 shard = zlib.crc32(peer.encode()) % n_shards
-            handles[shard].write(json.dumps(event) + "\n")
+            write(shard, event)
     finally:
         for fh in handles:
             fh.close()

@@ -1,0 +1,454 @@
+"""The binary flight-recorder codec (engine/recordio.py): frames
+must round-trip EXACTLY (dict-for-dict, type-for-type, including the
+int-vs-float clock distinction), tolerate torn tails at EVERY byte
+prefix (SIGKILL discipline: the durable prefix decodes, the tail
+costs at most the torn frame), isolate a flipped bit to ONE counted
+bad record, mix freely with JSONL in the same shard, and decode to
+the same records whether read incrementally (tail-follow), batch
+(read_records), or columnar (frame_columns/mmap).  The lint rule
+that defends the hot path is unit-tested here too."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.engine import recordio
+from hlsjs_p2p_wrapper_tpu.engine.recordio import (
+    FRAME_BYTES, K_CONT, K_COUNTER, MAGIC, PAYLOAD_BYTES,
+    RecordDecoder, ShardEncoder, columns_from_bytes, frame,
+    frame_columns, read_records)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+META = {"kind": "meta", "run_id": "r1", "host": "h"}
+
+
+def _bump(t, n, seq, name="twin.fetch_bytes",
+          labels="peer=p00,src=cdn"):
+    return {"t": t, "host": "h", "kind": "counter", "name": name,
+            "labels": labels, "n": n, "seq": seq}
+
+
+def _mark(t, window, window_ms, seq):
+    return {"t": t, "host": "h", "kind": "mark",
+            "name": "twin_window", "window": window,
+            "window_ms": window_ms, "seq": seq}
+
+
+def _slo(t, seq, *, quantile="p95", value=12.5, good=True,
+         firing=False):
+    return {"t": t, "host": "h", "kind": "mark",
+            "name": "slo_window", "seq": seq, "slo": "rebuffer",
+            "metric": "twin.stall_ms", "quantile": quantile,
+            "value": value, "good": good, "burn_fast": 1.25,
+            "burn_slow": 0.5, "budget_remaining": 0.875,
+            "firing": firing, "window": 2, "t_s": 8.0}
+
+
+def _records():
+    """A representative mixed stream: hot fixed-codec records,
+    K_JSON fallthroughs (ctx-bearing bump, span), every slo_window
+    flag combination."""
+    return [
+        _bump(1.0, 4096, 0),
+        _bump(1, 1, 1, labels="peer=p01,src=p2p"),   # int t, int n
+        _mark(8.0, 0, 125.0, 2),
+        {"t": 8.5, "host": "h", "kind": "counter",
+         "name": "twin.fetch_bytes", "labels": "peer=p00,src=cdn",
+         "n": 9, "seq": 3, "ctx": {"group": 1}},     # 8 keys: K_JSON
+        _slo(9.0, 4),
+        _slo(9, 5, quantile=None, value=None, good=None,
+             firing=True),
+        {"t": 10.0, "host": "h", "kind": "span", "name": "poll",
+         "ms": 1.5, "seq": 6},
+        _bump(11.0, -2.5, 7, name="twin.stall_ms",
+              labels="peer=p01"),
+        _mark(16, 1, 125, 8),                        # int t, int ms
+    ]
+
+
+def _shard_bytes(records=None, meta=True):
+    enc = ShardEncoder()
+    parts = []
+    if meta:
+        parts.append((json.dumps(META)  # jsonl-ok: meta header
+                      + "\n").encode("utf-8"))
+    for record in (_records() if records is None else records):
+        parts.append(enc.encode(record))
+    return b"".join(parts)
+
+
+def _decode(data):
+    dec = RecordDecoder()
+    out = dec.feed(data)
+    out.extend(dec.finish())
+    return out, dec.stats
+
+
+# -- exact round trip ----------------------------------------------------
+
+def test_round_trip_exact_dicts_and_types():
+    """Every record comes back as the EXACT dict the JSONL path
+    would have parsed — same keys, same values, same int/float/bool
+    types (``1`` is not ``1.0``, ``True`` is not ``1``)."""
+    records = _records()
+    out, stats = _decode(_shard_bytes(records, meta=False))
+    assert out == records
+    for got, want in zip(out, records):
+        for key, value in want.items():
+            assert type(got[key]) is type(value), key
+    assert stats.bad_records == 0 and stats.torn == 0
+    assert stats.records == len(records)
+
+
+def test_hot_families_use_fixed_frames_not_json():
+    """The measured-hot families land as one fixed frame each after
+    their one-time string definitions — the size contract the
+    bench's rows/s numbers rest on."""
+    enc = ShardEncoder()
+    first = enc.encode(_bump(1.0, 10, 0))
+    # host + name + labels K_STR defs, then the K_COUNTER frame
+    assert len(first) == 4 * FRAME_BYTES
+    assert first.count(bytes([MAGIC])) >= 4
+    steady = enc.encode(_bump(2.0, 11, 1))
+    assert len(steady) == FRAME_BYTES
+    assert steady[1] == K_COUNTER
+    slo_first = enc.encode(_slo(3.0, 2))
+    assert len(slo_first) == 4 * FRAME_BYTES  # slo/metric/quantile
+    assert len(enc.encode(_slo(4.0, 3))) == FRAME_BYTES
+
+
+def test_encode_bump_fast_path_matches_record_path():
+    """``encode_bump`` (the armed recorder's no-dict path) emits
+    byte-identical frames to ``encode`` on the equivalent record
+    dict — the two paths can never drift."""
+    via_record = ShardEncoder()
+    via_args = ShardEncoder()
+    for t, n, seq in ((1.0, 4096, 0), (2, 3, 1), (2.5, -1.5, 2)):
+        record = _bump(t, n, seq)
+        assert via_args.encode_bump(
+            t, "h", record["name"], record["labels"], n, seq) == \
+            via_record.encode(record)
+
+
+def test_edge_values_round_trip():
+    """u32 boundaries, zero, negative and integer deltas, empty
+    labels, non-ASCII names: exact or an exact K_JSON fallback."""
+    records = [
+        _bump(0, 0, 0, name="n\u00e9", labels=""),
+        _bump(-1.5, 2 ** 31, 0xFFFFFFFF),
+        _mark(0.0, 0xFFFFFFFF, 0, 0),
+        _bump(1.0, 5, 2 ** 32),        # seq over u32: K_JSON
+        _bump(2.0, 7, -1),             # negative seq: K_JSON
+        _bump(3.0, True, 3),           # bool n: K_JSON, stays bool
+        _bump(4.0, 8, 4, name="x" * 200),  # name too long: K_JSON
+    ]
+    enc = ShardEncoder()
+    data = b"".join(enc.encode(r) for r in records)
+    out, stats = _decode(data)
+    assert out == records
+    assert type(out[5]["n"]) is bool
+    assert stats.bad_records == 0
+
+
+def test_json_chunking_exact_multiple_boundary():
+    """A K_JSON body that is an exact multiple of the payload width
+    needs (and gets) an empty terminating continuation — and a body
+    spanning several chunks reassembles exactly."""
+    for target in (PAYLOAD_BYTES, 3 * PAYLOAD_BYTES):
+        record = None
+        for pad in range(target + 1):
+            candidate = {"kind": "span", "pad": "a" * pad}
+            if len(json.dumps(candidate)) == target:
+                record = candidate
+                break
+        assert record is not None
+        enc = ShardEncoder()
+        data = enc.encode(record)
+        assert len(data) == (target // PAYLOAD_BYTES + 1) \
+            * FRAME_BYTES
+        assert data[-FRAME_BYTES + 1] == K_CONT
+        out, stats = _decode(data)
+        assert out == [record] and stats.bad_records == 0
+
+
+# -- torn tails ----------------------------------------------------------
+
+def test_torn_tail_at_every_byte_prefix():
+    """Truncating the shard at EVERY byte offset — a SIGKILL can
+    land anywhere — always yields a clean prefix of the full decode:
+    no crash, no phantom record, no bad-record count, and the torn
+    tail (if any) is counted."""
+    data = _shard_bytes()
+    full, _ = _decode(data)
+    for cut in range(len(data) + 1):
+        out, stats = _decode(data[:cut])
+        assert out == full[:len(out)], cut
+        assert stats.bad_records == 0, cut
+        # mid-frame or mid-line costs at most the torn tail (a cut
+        # inside a chunked K_JSON can tear both the frame and the
+        # pending chunk sequence)
+        assert stats.torn <= 2, cut
+        if cut == len(data):
+            assert out == full and stats.torn == 0
+
+
+def test_sigkilled_file_prefix_identity(tmp_path):
+    """The batch reader on a truncated FILE (the actual SIGKILL
+    artifact) matches the in-memory truncation decode."""
+    data = _shard_bytes()
+    cut = len(data) - FRAME_BYTES // 2  # mid-frame
+    path = tmp_path / "shard.jsonl"
+    path.write_bytes(data[:cut])
+    records, stats = read_records(str(path))
+    want, want_stats = _decode(data[:cut])
+    assert records == want
+    assert stats.torn == want_stats.torn == 1
+
+
+def test_finish_salvages_complete_unterminated_text_tail():
+    """``read_jsonl_tolerant`` parity: a final text record whose
+    writer never reached the newline still parses — only an
+    INCOMPLETE tail counts torn."""
+    tail = {"kind": "span", "name": "last", "seq": 9}
+    line = json.dumps(tail).encode("utf-8")  # jsonl-ok: test data
+    out, stats = _decode(_shard_bytes() + line)  # no trailing \n
+    assert out[-1] == tail and stats.torn == 0
+    out, stats = _decode(_shard_bytes() + line[:-4])
+    assert out[-1] != tail and stats.torn == 1
+
+
+# -- corruption isolation ------------------------------------------------
+
+def test_flipped_payload_bit_costs_one_counted_record():
+    """A single flipped bit inside a frame payload fails that one
+    frame's CRC: exactly one record lost, exactly one counted, every
+    other record intact."""
+    records = [_bump(float(i), i, i) for i in range(8)]
+    data = _shard_bytes(records, meta=False)
+    # frame 3 = K_STR defs (3) then bumps; corrupt the 6th frame's
+    # payload (a steady-state K_COUNTER)
+    victim = 5 * FRAME_BYTES + 10
+    corrupt = bytearray(data)
+    corrupt[victim] ^= 0x40
+    out, stats = _decode(bytes(corrupt))
+    assert stats.bad_records == 1
+    assert len(out) == len(records) - 1
+    assert [r for r in records if r not in out] == [records[2]]
+
+
+def test_flipped_magic_byte_resyncs_at_verified_frame():
+    """Corrupting a frame's MAGIC byte makes its head look like
+    text; the decoder proves resynchronization at the next verified
+    frame instead of eating the stream — one episode counted."""
+    records = [_bump(float(i), i, i) for i in range(8)]
+    data = bytearray(_shard_bytes(records, meta=False))
+    data[4 * FRAME_BYTES] = ord("{")  # 5th frame's magic
+    out, stats = _decode(bytes(data))
+    assert stats.bad_records >= 1
+    assert len(out) == len(records) - 1
+    lost = [r for r in records if r not in out]
+    assert lost == [records[1]]
+
+
+def test_corrupt_text_line_does_not_cascade():
+    """An unparsable JSONL line between binary runs costs one
+    record; the frames on both sides decode."""
+    head = _shard_bytes([_bump(1.0, 1, 0)], meta=False)
+    enc2 = ShardEncoder()
+    tail = enc2.encode(_bump(2.0, 2, 1))
+    data = head + b"this is not json\n" + tail
+    out, stats = _decode(data)
+    assert len(out) == 2 and stats.bad_records == 1
+
+
+# -- mixed-format shards -------------------------------------------------
+
+def test_mixed_binary_and_jsonl_round_trip(tmp_path):
+    """One shard, three eras: JSONL meta header, binary frames, a
+    raw JSONL event line (old tooling appended mid-stream), more
+    frames — the sniffing reader returns every record in file
+    order."""
+    enc = ShardEncoder()
+    legacy = {"t": 5.0, "host": "h", "kind": "mark",
+              "name": "legacy", "seq": 99}
+    data = (
+        (json.dumps(META) + "\n").encode()  # jsonl-ok: meta header
+        + enc.encode(_bump(1.0, 1, 0))
+        + (json.dumps(legacy)  # jsonl-ok: simulated legacy writer
+           + "\n").encode()
+        + enc.encode(_mark(8.0, 0, 125.0, 1)))
+    path = tmp_path / "mixed.jsonl"
+    path.write_bytes(data)
+    records, stats = read_records(str(path))
+    assert records == [META, _bump(1.0, 1, 0), legacy,
+                       _mark(8.0, 0, 125.0, 1)]
+    assert stats.bad_records == 0 and stats.torn == 0
+
+
+def test_pure_jsonl_shard_still_reads():
+    """An all-text shard (binary=False recorders, old artifacts)
+    decodes unchanged through the same reader."""
+    records = _records()
+    data = b"".join(
+        (json.dumps(r) + "\n").encode()  # jsonl-ok: legacy shard
+        for r in records)
+    out, stats = _decode(data)
+    assert out == records and stats.bad_records == 0
+
+
+# -- incremental == batch == columnar ------------------------------------
+
+@pytest.mark.parametrize("chunk", [1, 7, FRAME_BYTES - 1,
+                                   FRAME_BYTES, 257])
+def test_tail_follow_chunking_invariant(chunk):
+    """Feeding the decoder any byte split (a tail-follower's polls)
+    yields exactly the batch decode — record-for-record and
+    stat-for-stat."""
+    data = _shard_bytes()
+    batch, batch_stats = _decode(data)
+    dec = RecordDecoder()
+    out = []
+    for start in range(0, len(data), chunk):
+        out.extend(dec.feed(data[start:start + chunk]))
+    out.extend(dec.finish())
+    assert out == batch
+    assert dec.stats.as_dict() == batch_stats.as_dict()
+
+
+def test_mmap_columns_match_incremental_decode(tmp_path):
+    """The columnar tier (mmap'd ``frame_columns``) extracts the
+    same hot rows — positions, clocks, resolved strings, deltas —
+    that the incremental dict tier decodes, and buckets the same
+    rare records into ``py_events``."""
+    np = pytest.importorskip("numpy")
+    data = _shard_bytes()
+    path = tmp_path / "shard.jsonl"
+    path.write_bytes(data)
+    cols = frame_columns(str(path))
+    assert cols is not None
+    assert columns_from_bytes(data).ctr_t.tolist() == \
+        cols.ctr_t.tolist()
+    dec = RecordDecoder()
+    records = []
+    for start in range(0, len(data), 13):
+        records.extend(dec.feed(data[start:start + 13]))
+    records.extend(dec.finish())
+    assert cols.meta == META
+    # counters: one row each, same order, strings resolved.
+    # Positions number FRAMES (string defs included), so only the
+    # relative order is comparable to the record stream.
+    bumps = [r for r in records
+             if r.get("kind") == "counter" and len(r) == 7]
+    assert cols.ctr_t.tolist() == [float(r["t"]) for r in bumps]
+    assert cols.ctr_n.tolist() == [float(r["n"]) for r in bumps]
+    assert [cols.strings[i] for i in cols.ctr_name.tolist()] == \
+        [r["name"] for r in bumps]
+    assert [cols.strings[i] for i in cols.ctr_labels.tolist()] == \
+        [r["labels"] for r in bumps]
+    marks = [r for r in records if r.get("name") == "twin_window"]
+    assert cols.mark_t.tolist() == [float(r["t"]) for r in marks]
+    assert cols.mark_window_ms.tolist() == \
+        [float(r["window_ms"]) for r in marks]
+    # positions are strictly increasing and the counter/mark
+    # interleaving matches the record stream (the searchsorted
+    # partition depends on exactly this)
+    merged = sorted(
+        [(p, "c") for p in cols.ctr_pos.tolist()]
+        + [(p, "m") for p in cols.mark_pos.tolist()])
+    assert len({p for p, _ in merged}) == len(merged)
+    want_order = ["c" if r.get("kind") == "counter" else "m"
+                  for r in records
+                  if (r.get("kind") == "counter" and len(r) == 7)
+                  or r.get("name") == "twin_window"]
+    assert [tag for _, tag in merged] == want_order
+    # rare records (ctx bump, spans) keep their dicts; binary slo
+    # marks are skipped by design on the columnar path
+    assert [r for _, r in sorted(cols.py_events)] == \
+        [r for r in records
+         if (r.get("kind") == "counter" and len(r) == 8)
+         or r.get("kind") == "span"]
+    assert cols.stats.bad_records == 0 and cols.stats.torn == 0
+
+
+def test_columns_count_corruption_like_dict_tier(tmp_path):
+    """Corruption inside a frame run sends the run through the dict
+    tier's resync — the columnar stats agree with the decoder's."""
+    pytest.importorskip("numpy")
+    records = [_bump(float(i), i, i) for i in range(8)]
+    data = bytearray(_shard_bytes(records, meta=False))
+    data[5 * FRAME_BYTES + 10] ^= 0x40
+    cols = columns_from_bytes(bytes(data))
+    survivors, stats = _decode(bytes(data))
+    assert cols.stats.bad_records == stats.bad_records == 1
+    # the corrupt run is settled by the dict tier, so its surviving
+    # bumps arrive as py_events rather than columns — same records
+    assert [r for _, r in sorted(cols.py_events)] == survivors
+    assert len(survivors) == len(records) - 1
+
+
+def test_empty_and_meta_only_shards(tmp_path):
+    """Zero-byte and header-only shards: every reader returns empty
+    cleanly (the mmap path must survive ``ValueError`` on empty)."""
+    pytest.importorskip("numpy")
+    empty = tmp_path / "empty.jsonl"
+    empty.write_bytes(b"")
+    assert read_records(str(empty))[0] == []
+    cols = frame_columns(str(empty))
+    assert cols.n_records == 0 and len(cols.ctr_pos) == 0
+    meta_only = tmp_path / "meta.jsonl"
+    meta_only.write_bytes(
+        (json.dumps(META) + "\n").encode())  # jsonl-ok: meta header
+    assert read_records(str(meta_only))[0] == [META]
+    assert frame_columns(str(meta_only)).meta == META
+
+
+def test_unresolvable_string_id_counts_once():
+    """A K_COUNTER whose K_STR definition never landed (lost to an
+    earlier corruption) is one counted bad record, not a crash."""
+    import struct
+    payload = recordio._COUNTER.pack(1.0, 0, 7, 8, 9, 1.0, 0)
+    out, stats = _decode(frame(K_COUNTER, payload))
+    assert out == [] and stats.bad_records == 1
+    assert isinstance(struct.calcsize("<dIIIIdB"), int)
+
+
+# -- the lint rule -------------------------------------------------------
+
+def test_lint_recorder_codec_discipline(tmp_path):
+    """The rule that defends the hot path: a naked ``json.dumps``
+    call in a recorder file is a finding; the same call with an
+    inline ``# jsonl-ok: <why>`` on the CALL line passes; a comment
+    on a neighboring line does not count."""
+    import lint as lint_tool
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import json\n"
+        "def emit(record):\n"
+        "    return json.dumps(record) + '\\n'\n")
+    findings = lint_tool.check_recorder_codec_discipline(str(bad))
+    assert len(findings) == 1 and ":3:" in findings[0]
+    above = tmp_path / "above.py"
+    above.write_text(
+        "import json\n"
+        "def emit(record):\n"
+        "    # jsonl-ok: not on the call line\n"
+        "    return json.dumps(record) + '\\n'\n")
+    assert len(lint_tool.check_recorder_codec_discipline(
+        str(above))) == 1
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import json\n"
+        "def emit(record):\n"
+        "    return json.dumps(record)  # jsonl-ok: meta header\n")
+    assert lint_tool.check_recorder_codec_discipline(
+        str(good)) == []
+    # the rule is wired to the recorder files
+    assert any(f.endswith("engine/tracer.py")
+               for f in lint_tool.RECORDER_FILES)
+    assert any(f.endswith("engine/recordio.py")
+               for f in lint_tool.RECORDER_FILES)

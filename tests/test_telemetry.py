@@ -265,9 +265,32 @@ def test_counter_bump_listener_fires_on_inc_only():
         ("dispatch_faults", {"action": "retry", "reason": "oom"}, 1),
         ("fabric_claims", {"action": "claim"}, 3),
     ]
-    reg.remove_listener(reg._bump_listeners[0])
+    reg.remove_listener(reg._listener_specs[0][0])
     pre.inc()
     assert len(seen) == 2
+
+
+def test_listener_name_filter_binds_per_instrument():
+    """A ``name_filter`` restricts the subscription at bind time:
+    rejected instruments never call the listener (their
+    ``_listeners`` tuple is empty — zero per-bump cost), accepted
+    ones do — including instruments memoized before attach, via the
+    rebind."""
+    reg = MetricsRegistry()
+    pre = reg.counter("twin.fetch_bytes", peer="p1")
+    other = reg.counter("dispatch_faults", reason="oom")
+    seen = []
+    reg.add_listener(
+        lambda name, labels, n: seen.append((name, n)),
+        name_filter=lambda name: name.startswith("twin."))
+    pre.inc(7)
+    other.inc()
+    reg.counter("twin.stall_ms", peer="p1").inc(3)
+    assert seen == [("twin.fetch_bytes", 7), ("twin.stall_ms", 3)]
+    assert other._listeners == ()
+    reg.remove_listener(reg._listener_specs[0][0])
+    pre.inc()
+    assert len(seen) == 2 and pre._listeners == ()
 
 
 # -- span tracing ------------------------------------------------------

@@ -335,20 +335,44 @@ def test_same_seed_reruns_are_frame_identical(tmp_path):
     assert a.event_frames == b.event_frames
 
 
+def _mark_end_offsets(data: bytes):
+    """Byte offset just past each ``twin_window`` mark record —
+    frame-aware (binary shards) and line-aware (JSONL shards), the
+    truncation boundaries the torn-tail tests cut at."""
+    from hlsjs_p2p_wrapper_tpu.engine import recordio
+    offsets = []
+    pos = 0
+    while pos < len(data):
+        if data[pos] == recordio.MAGIC:
+            end = pos + recordio.FRAME_BYTES
+            if end > len(data):
+                break
+            if data[pos + 1] == recordio.K_TWIN_WINDOW:
+                offsets.append(end)
+            pos = end
+        else:
+            nl = data.find(b"\n", pos)
+            if nl < 0:
+                break
+            if b'"twin_window"' in data[pos:nl]:
+                offsets.append(nl + 1)
+            pos = nl + 1
+    return offsets
+
+
 def test_torn_shard_reconstructs_surviving_windows(tmp_path):
     """A shard torn mid-record (the SIGKILL disk state): the
     torn-tail reader yields the durable prefix and every window whose
     mark survived reconstructs EXACTLY."""
     result = run_real_plane(SMALL, trace_dir=str(tmp_path))
-    with open(result.shard_path, encoding="utf-8") as fh:
-        lines = fh.readlines()
+    with open(result.shard_path, "rb") as fh:
+        data = fh.read()
     # keep everything through the 3rd window mark, then a torn tail
-    marks = [i for i, line in enumerate(lines)
-             if '"twin_window"' in line]
+    marks = _mark_end_offsets(data)
     assert len(marks) == SMALL.n_windows
-    torn = lines[:marks[2] + 1] + ['{"t": 99, "kind": "coun']
-    with open(result.shard_path, "w", encoding="utf-8") as fh:
-        fh.writelines(torn)
+    torn = data[:marks[2]] + b"\xf5\x02\x21\x00half a frame"
+    with open(result.shard_path, "wb") as fh:
+        fh.write(torn)
     _meta, events = read_shard(result.shard_path)
     frame = frames_from_events(events)
     assert frame.n_windows == 3
@@ -375,8 +399,8 @@ def test_sigkilled_writer_frames_match_uninterrupted_run(tmp_path):
         marks = 0
         while time.time() < deadline and marks < 4:
             if shard.exists():
-                with open(shard, encoding="utf-8") as fh:
-                    marks = fh.read().count('"twin_window"')
+                with open(shard, "rb") as fh:
+                    marks = len(_mark_end_offsets(fh.read()))
             if proc.poll() is not None:
                 pytest.fail("child finished before the kill")
             time.sleep(0.05)

@@ -414,6 +414,57 @@ def check_traffic_discipline(path):
     return findings
 
 
+#: the flight-recorder hot path (the binary-codec round): event
+#: emission in these files goes through the recordio encoder
+#: registry (engine/recordio.py ``ShardEncoder``) — a naked
+#: ``json.dumps`` here is a hot-family record silently bypassing the
+#: framed CRC codec, which is exactly how the JSONL hot path would
+#: regrow.  The meta header, the K_JSON framed fallback itself, and
+#: the text-mode compatibility shard are the legitimate sites; each
+#: says so inline.
+RECORDER_FILES = (
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "tracer.py"),
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "recordio.py"),
+    os.path.join("hlsjs_p2p_wrapper_tpu", "testing", "twin.py"),
+)
+
+
+def check_recorder_codec_discipline(path):
+    """Recorder-codec discipline: every ``json.dumps`` CALL on the
+    flight-recorder write path must either be the codec (the framed
+    ``K_JSON`` fallback), or carry an inline ``# jsonl-ok: <why>``
+    justification — naked line-oriented emission of hot families
+    un-does the binary hot path one convenient call at a time."""
+    findings = []
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []  # check_file already reports the syntax error
+    lines = source.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        named_dumps = (isinstance(func, ast.Attribute)
+                       and func.attr == "dumps"
+                       and isinstance(func.value, ast.Name)
+                       and func.value.id == "json")
+        if not named_dumps:
+            continue
+        if "# jsonl-ok:" in lines[node.lineno - 1]:
+            continue
+        findings.append(
+            f"{path}:{node.lineno}: naked json.dumps on the flight-"
+            f"recorder hot path — route the record through the "
+            f"recordio encoder (ShardEncoder.encode / encode_json) "
+            f"so hot families stay framed and CRC-checked; "
+            f"'# jsonl-ok: <why>' if a text line is genuinely "
+            f"required (meta header, compatibility shard)")
+    return findings
+
+
 #: the policy-search plane (the closed-loop round): drivers promise
 #: "same seed ⇒ identical proposal sequence ⇒ identical frontier"
 #: (make optimize-gate asserts it at process level), and a single
@@ -714,6 +765,9 @@ def main(argv=None):
             all_findings.extend(check_traffic_discipline(path))
         if path.endswith(RNG_FILES):
             all_findings.extend(check_rng_discipline(path))
+        if path.endswith(RECORDER_FILES):
+            all_findings.extend(
+                check_recorder_codec_discipline(path))
         if path.endswith(DIGEST_FILES):
             all_findings.extend(check_digest_seed_free(path))
     all_findings.extend(check_static_knobs(

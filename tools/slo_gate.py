@@ -218,6 +218,19 @@ def part_a(root, spec):
           f"full window count reconstructed "
           f"({single.n_windows}/{spec.n_windows})")
 
+    # binary==JSONL frame exactness: the same traffic re-split as
+    # BINARY shards must reproduce the frames bit-identically on
+    # BOTH decode engines (the recordio columnar tier and the
+    # dict-tier mux), so the shard format can never bend a frame
+    bin_paths = split_shard(shard, os.path.join(root, "a-bin"), 4,
+                            binary=True)
+    check(frames_from_shards(bin_paths, engine="columns") == single,
+          "binary 4-shard columnar replay == JSONL-path frames "
+          "bit-identically")
+    check(frames_from_shards(bin_paths, engine="mux") == single,
+          "binary shards through the dict-tier mux == the same "
+          "frames (engine-independent)")
+
     # path independence: incremental tail-follow of GROWING shards,
     # cut at arbitrary byte offsets (torn tails mid-poll), equals
     # the batch replay
