@@ -152,11 +152,20 @@ class ShardEncoder:
 
     # -- string interning ------------------------------------------------
 
-    def _intern(self, text: str, defs: List[bytes]) -> Optional[int]:
+    def _intern(self, text: str, defs: List[bytes],
+                added: List[str]) -> Optional[int]:
         """The id for ``text``, appending its one-time ``K_STR``
-        definition frame to ``defs`` on first sight; None when the
-        string cannot be interned (too long for one frame — the
-        caller's codec declines and the record rides K_JSON)."""
+        definition frame to ``defs`` and its text to ``added`` on
+        first sight; None when the string cannot be interned (too
+        long for one frame — the caller's codec declines and the
+        record rides K_JSON).  Interning is TENTATIVE until the
+        whole record encodes: a codec that declines after a
+        successful intern must :meth:`_rollback` its ``added`` list,
+        because the definition frames only exist in the discarded
+        ``defs`` — an id left committed would cache-hit on a later
+        record and reference a definition never written to the
+        shard, turning every later record of that family into an
+        unresolvable-id bad record at decode."""
         cached = self._ids.get(text)
         if cached is not None:
             return cached
@@ -168,8 +177,18 @@ class ShardEncoder:
         ident = self._next_id
         self._next_id += 1
         self._ids[text] = ident
+        added.append(text)
         defs.append(frame(K_STR, _STR_DEF.pack(ident) + raw))
         return ident
+
+    def _rollback(self, added: List[str]) -> None:
+        """Un-commit the ids a declining encode call interned (their
+        K_STR frames die with the caller's ``defs`` list).  Ids are
+        assigned sequentially and emission is serialized, so popping
+        in reverse restores the table exactly."""
+        for text in reversed(added):
+            del self._ids[text]
+            self._next_id -= 1
 
     # -- the never-fails fallback ---------------------------------------
 
@@ -198,27 +217,39 @@ class ShardEncoder:
         trace context).  Steady state is two memo hits and one
         ``struct.pack``; None means the bump needs the full record
         path (odd types, uninternable strings)."""
-        if not (_is_real(t) and _is_real(n) and _is_u32(seq)):
+        if not (_is_real(t) and _is_real(n) and _is_u32(seq)
+                and type(name) is str and type(labels) is str):
             return None
         defs: List[bytes] = []
+        added: List[str] = []
+        memo_key = None
         ids = self._bump_memo.get((name, labels))
         if ids is None:
-            if not (type(name) is str and type(labels) is str):
-                return None
-            name_id = self._intern(name, defs)
-            labels_id = self._intern(labels, defs)
+            name_id = self._intern(name, defs, added)
+            labels_id = self._intern(labels, defs, added)
             if name_id is None or labels_id is None:
+                self._rollback(added)
                 return None
-            ids = self._bump_memo[(name, labels)] = (name_id,
-                                                     labels_id)
-        host_id = (self._intern(host, defs)
+            ids = (name_id, labels_id)
+            memo_key = (name, labels)
+        host_id = (self._intern(host, defs, added)
                    if type(host) is str else None)
         if host_id is None:
+            self._rollback(added)
             return None
         flags = ((_F_T_INT if type(t) is int else 0)
                  | (_F_N_INT if type(n) is int else 0))
-        defs.append(frame(K_COUNTER, _COUNTER.pack(
-            t, seq, host_id, ids[0], ids[1], n, flags)))
+        try:
+            body = _COUNTER.pack(t, seq, host_id, ids[0], ids[1],
+                                 n, flags)
+        except (struct.error, OverflowError):
+            # e.g. an int clock/delta too large for f8: the record
+            # rides K_JSON, exactly — never widened, never raised
+            self._rollback(added)
+            return None
+        if memo_key is not None:
+            self._bump_memo[memo_key] = ids
+        defs.append(frame(K_COUNTER, body))
         return b"".join(defs)
 
     def _encode_counter(self, record: dict) -> Optional[bytes]:
@@ -241,13 +272,19 @@ class ShardEncoder:
                 and _is_u32(window) and type(host) is str):
             return None
         defs: List[bytes] = []
-        host_id = self._intern(host, defs)
+        added: List[str] = []
+        host_id = self._intern(host, defs, added)
         if host_id is None:
             return None
         flags = ((_F_T_INT if type(t) is int else 0)
                  | (_F_WMS_INT if type(window_ms) is int else 0))
-        defs.append(frame(K_TWIN_WINDOW, _TWIN_WINDOW.pack(
-            t, seq, host_id, window, window_ms, flags)))
+        try:
+            body = _TWIN_WINDOW.pack(t, seq, host_id, window,
+                                     window_ms, flags)
+        except (struct.error, OverflowError):
+            self._rollback(added)
+            return None
+        defs.append(frame(K_TWIN_WINDOW, body))
         return b"".join(defs)
 
     _SLO_KEYS = frozenset((
@@ -281,23 +318,30 @@ class ShardEncoder:
                 and type(record.get("t_s")) is float):
             return None
         defs: List[bytes] = []
-        host_id = self._intern(host, defs)
-        slo_id = self._intern(slo, defs)
-        metric_id = self._intern(metric, defs)
+        added: List[str] = []
+        host_id = self._intern(host, defs, added)
+        slo_id = self._intern(slo, defs, added)
+        metric_id = self._intern(metric, defs, added)
         quantile_id = (0 if quantile is None
-                       else self._intern(quantile, defs))
+                       else self._intern(quantile, defs, added))
         if None in (host_id, slo_id, metric_id, quantile_id):
+            self._rollback(added)
             return None
         flags = ((_F_T_INT if type(t) is int else 0)
                  | (_F_FIRING if firing else 0)
                  | (_F_GOOD_SET if good is not None else 0)
                  | (_F_GOOD_TRUE if good else 0)
                  | (_F_VALUE_SET if value is not None else 0))
-        defs.append(frame(K_SLO_WINDOW, _SLO_WINDOW.pack(
-            t, seq, host_id, slo_id, metric_id, quantile_id,
-            window, value if value is not None else 0.0,
-            record["burn_fast"], record["burn_slow"],
-            record["budget_remaining"], record["t_s"], flags)))
+        try:
+            body = _SLO_WINDOW.pack(
+                t, seq, host_id, slo_id, metric_id, quantile_id,
+                window, value if value is not None else 0.0,
+                record["burn_fast"], record["burn_slow"],
+                record["budget_remaining"], record["t_s"], flags)
+        except (struct.error, OverflowError):
+            self._rollback(added)
+            return None
+        defs.append(frame(K_SLO_WINDOW, body))
         return b"".join(defs)
 
     # -- dispatch --------------------------------------------------------
@@ -350,8 +394,13 @@ def _resync(data, start: int, limit: int) -> int:
             # partial candidate frame at the tail: resume here so an
             # incremental reader can verify it once the bytes land
             return candidate
-        # newline candidate: the next byte starts a fresh line
-        if nl_at + 1 < limit and data[nl_at + 1] not in (MAGIC,):
+        # newline candidate: accept only when the next byte opens a
+        # JSON object (every text-tier record is a dict, so a real
+        # record line starts with "{"); a MAGIC byte is left for the
+        # frame branch to verify, and anything else is more of the
+        # same corruption episode — skipping it instead of resyncing
+        # onto garbage text is what keeps one episode at ONE count
+        if nl_at + 1 < limit and data[nl_at + 1] == ord("{"):
             return nl_at + 1
         pos = nl_at + 1
     return limit
@@ -862,6 +911,12 @@ def _columns_from_buffer(np, data: bytes, stats: DecodeStats
         pos_base += n_frames
         # hot column extraction: counters
         cmask = kinds == K_COUNTER
+        # every CRC-verified hot frame is one decoded record —
+        # K_SLO_WINDOW included even though the frame reducer never
+        # consumes it, for stat parity with the dict tier
+        stats.records += int(cmask.sum()) \
+            + int((kinds == K_TWIN_WINDOW).sum()) \
+            + int((kinds == K_SLO_WINDOW).sum())
         if cmask.any():
             crows = matrix[cmask]
             ctr_chunks.append((
@@ -913,6 +968,7 @@ def _columns_from_buffer(np, data: bytes, stats: DecodeStats
                         if record is None:
                             stats.bad_records += 1
                         else:
+                            stats.records += 1
                             _bucket_record(
                                 record, int(positions[row_i]),
                                 mark_rows, py_events)
@@ -926,6 +982,7 @@ def _columns_from_buffer(np, data: bytes, stats: DecodeStats
                         if record is None:
                             stats.bad_records += 1
                         else:
+                            stats.records += 1
                             _bucket_record(
                                 record, int(positions[row_i]),
                                 mark_rows, py_events)
@@ -934,6 +991,7 @@ def _columns_from_buffer(np, data: bytes, stats: DecodeStats
         offset = run_end
     stats.bad_records += decoder.stats.bad_records
     stats.torn += decoder.stats.torn
+    stats.records += decoder.stats.records
     if decoder._pending_json is not None:
         stats.torn += 1
     if ctr_chunks:

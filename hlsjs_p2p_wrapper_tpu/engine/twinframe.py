@@ -943,6 +943,12 @@ def _frames_from_shard_columns(paths: List[str], source: str
             return None
         if cols is None:
             return None
+        if cols.stats.bad_records or cols.stats.torn:
+            # corrupt or torn shard: the frame contents would still
+            # match (both tiers drop the same bad records), but only
+            # the mux surfaces the corruption accounting (mux.*
+            # counter families) — it owns damaged shards
+            return None
         cols_list.append(cols)
     first = cols_list[0]
     n_marks = len(first.mark_pos)

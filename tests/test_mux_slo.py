@@ -96,6 +96,49 @@ def test_split_merge_equals_single(tmp_path):
     assert merged.n_windows == 3
 
 
+def write_binary_shard(path, events):
+    """The same stream as :func:`write_shard`, but through the
+    recordio fixed codecs (the meta header stays JSONL); ``seq`` is
+    appended so the hot records qualify for the fixed frames."""
+    from hlsjs_p2p_wrapper_tpu.engine.recordio import ShardEncoder
+    enc = ShardEncoder()
+    with open(path, "wb") as fh:
+        fh.write((json.dumps({"kind": "meta", "host": "h"})
+                  + "\n").encode("utf-8"))
+        for seq, event in enumerate(events):
+            fh.write(enc.encode(dict(event, seq=seq)))
+
+
+def test_columns_engine_declines_corrupt_shard_to_mux(tmp_path):
+    """A corrupt or torn binary shard must NOT replay through the
+    columnar fast path: the frame contents would still match (both
+    tiers drop the same bad frame), but only the mux surfaces the
+    corruption accounting (``mux.*`` counter families).
+    ``engine="columns"`` refuses; the default falls back to the
+    mux."""
+    pytest.importorskip("numpy")
+    a, _ = two_shard_events(2)
+    path = tmp_path / "a.jsonl"
+    write_binary_shard(path, a)
+    clean = frames_from_shards([str(path)], engine="columns")
+    assert clean == frames_from_shards([str(path)], engine="mux")
+    assert clean.n_windows == 2
+    data = bytearray(path.read_bytes())
+    data[-40] ^= 0x01  # payload bit of the final twin_window mark
+    path.write_bytes(bytes(data))
+    with pytest.raises(ValueError):
+        frames_from_shards([str(path)], engine="columns")
+    degraded = frames_from_shards([str(path)])  # auto: mux owns it
+    assert degraded.n_windows == clean.n_windows - 1
+    # a torn tail (the SIGKILL artifact) declines the same way
+    torn = tmp_path / "torn.jsonl"
+    write_binary_shard(torn, a)
+    whole = torn.read_bytes()
+    torn.write_bytes(whole[:-30])  # mid-frame cut
+    with pytest.raises(ValueError):
+        frames_from_shards([str(torn)], engine="columns")
+
+
 def test_interleaved_torn_tails_on_two_shards(tmp_path):
     """Both shards grow with torn tails at different moments; only
     whole lines are ever consumed and the merge waits for BOTH

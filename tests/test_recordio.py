@@ -372,6 +372,10 @@ def test_mmap_columns_match_incremental_decode(tmp_path):
         [r for r in records
          if (r.get("kind") == "counter" and len(r) == 8)
          or r.get("kind") == "span"]
+    # stat parity with the dict tier — records included, so the
+    # mux.* accounting surfaced from either tier agrees
+    assert cols.stats.as_dict() == dec.stats.as_dict()
+    assert cols.stats.records == len(records)
     assert cols.stats.bad_records == 0 and cols.stats.torn == 0
 
 
@@ -385,6 +389,7 @@ def test_columns_count_corruption_like_dict_tier(tmp_path):
     cols = columns_from_bytes(bytes(data))
     survivors, stats = _decode(bytes(data))
     assert cols.stats.bad_records == stats.bad_records == 1
+    assert cols.stats.as_dict() == stats.as_dict()
     # the corrupt run is settled by the dict tier, so its surviving
     # bumps arrive as py_events rather than columns — same records
     assert [r for _, r in sorted(cols.py_events)] == survivors
@@ -405,6 +410,72 @@ def test_empty_and_meta_only_shards(tmp_path):
         (json.dumps(META) + "\n").encode())  # jsonl-ok: meta header
     assert read_records(str(meta_only))[0] == [META]
     assert frame_columns(str(meta_only)).meta == META
+
+
+def test_declined_encode_never_leaks_interned_ids():
+    """A codec that declines AFTER interning strings (an oversized
+    host/labels/quantile discovered late) must roll its tentative
+    ids back: the K_STR definition frames die with the declined
+    encode, so a leaked id would cache-hit on a later record of the
+    same family and reference a definition never written — every
+    later record of that family would decode as an unresolvable-id
+    bad record."""
+    # counter: name + labels intern, then the oversized host declines
+    enc = ShardEncoder()
+    bad_host = dict(_bump(1.0, 1, 0), host="H" * 100)
+    follow = _bump(2.0, 2, 1)
+    out, stats = _decode(enc.encode(bad_host) + enc.encode(follow))
+    assert out == [bad_host, follow]
+    assert stats.bad_records == 0
+    # counter: name interns, then the oversized labels declines
+    enc = ShardEncoder()
+    bad_labels = _bump(1.0, 1, 0, name="fresh.family",
+                       labels="L" * 100)
+    follow = _bump(2.0, 2, 1, name="fresh.family", labels="peer=p")
+    out, stats = _decode(enc.encode(bad_labels)
+                         + enc.encode(follow))
+    assert out == [bad_labels, follow]
+    assert stats.bad_records == 0
+    # slo_window: host/slo/metric intern, then the quantile declines
+    enc = ShardEncoder()
+    bad_q = _slo(1.0, 0, quantile="q" * 100)
+    follow = _slo(2.0, 1)
+    out, stats = _decode(enc.encode(bad_q) + enc.encode(follow))
+    assert out == [bad_q, follow]
+    assert stats.bad_records == 0
+
+
+def test_oversized_int_values_ride_json_without_raising():
+    """An int too large for f8 cannot ride a fixed codec: the pack
+    overflow declines the record to K_JSON (exact big-int round
+    trip, interns rolled back) — ``encode`` never raises."""
+    enc = ShardEncoder()
+    huge = _bump(10 ** 400, 1, 0)       # int clock beyond f8
+    follow = _bump(1.0, 2, 1)
+    out, stats = _decode(enc.encode(huge) + enc.encode(follow))
+    assert out == [huge, follow] and stats.bad_records == 0
+    assert type(out[0]["t"]) is int
+    enc = ShardEncoder()
+    huge_mark = _mark(1.0, 0, 10 ** 400, 0)  # window_ms beyond f8
+    out, stats = _decode(enc.encode(huge_mark))
+    assert out == [huge_mark] and stats.bad_records == 0
+
+
+def test_corrupt_frame_with_embedded_newline_counts_once():
+    """One corruption episode, ONE count: a corrupt frame whose
+    payload contains a newline followed by garbage text must not
+    resync onto the garbage (and fail to parse it as a second bad
+    record) — resync requires a JSON-looking line head or a
+    CRC-verified frame."""
+    enc = ShardEncoder()
+    head = enc.encode(_bump(1.0, 1, 0))
+    victim = bytearray(frame(K_COUNTER,
+                             b"\ngarbage text, not a record"))
+    victim[-1] ^= 0xFF  # break the CRC
+    tail = enc.encode(_bump(2.0, 2, 1))
+    out, stats = _decode(head + bytes(victim) + tail)
+    assert out == [_bump(1.0, 1, 0), _bump(2.0, 2, 1)]
+    assert stats.bad_records == 1
 
 
 def test_unresolvable_string_id_counts_once():
