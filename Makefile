@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
 	coverage soak scaling-artifact warmstart-gate chaos-gate \
 	fleet-gate trace-gate tracker-gate net-chaos-gate optimize-gate \
-	twin-gate control-gate population-gate slo-gate
+	twin-gate control-gate population-gate slo-gate c10k-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -218,6 +218,22 @@ population-gate:
 slo-gate:
 	$(PY) tools/slo_gate.py
 
+# C10K real plane (ISSUE 19, engine/net.py selector-loop core +
+# tools/c10k_pack.py agent packs): ≥1,000 REAL peers on one host —
+# ≥4 worker processes of 256 full agents each, coordinated through
+# the PR 6 fabric work ledger against ONE tracker endpoint
+# multiplexed on ONE selector loop — every foreground fetch must
+# complete under a per-unit-seeded chaos window, every fabric unit
+# finalize, zero fd/thread/PeerState leaks in packs and parent, each
+# unit's fired fault schedule re-derivable from the seed alone, the
+# packs' binary flight-recorder shards must ingest, and the
+# multi-process announce storm must beat the serialized loop ≥3× on
+# hosts with ≥4 cores (measured + waived below that — the GIL
+# escape is core-bound).  C10K_PACKS / C10K_PEERS_PER_PACK /
+# C10K_GROUPS resize it.
+c10k-gate:
+	$(PY) tools/c10k_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -228,6 +244,6 @@ examples:
 
 check: lint test dryrun warmstart-gate chaos-gate fleet-gate \
 	trace-gate tracker-gate net-chaos-gate optimize-gate twin-gate \
-	control-gate population-gate slo-gate
+	control-gate population-gate slo-gate c10k-gate
 
 all: check bench

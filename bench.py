@@ -629,18 +629,102 @@ def announce_storm_benchmark():
         finally:
             network.close()
 
+    def measure_multiproc(n_procs, per_proc):
+        """The GIL-escape arm (ISSUE 19): the same closed-loop storm,
+        but the announcers live in ``n_procs`` WORKER PROCESSES
+        (hlsjs_p2p_wrapper_tpu/testing/announce_worker.py) — each
+        owns a whole interpreter, so worker CPU no longer contends
+        with the tracker's on one GIL.  Same total announcer count
+        as the thread arm; the tracker side is identical."""
+        import subprocess
+        import sys
+
+        registry = MetricsRegistry()
+        network = TcpNetwork(psk=psk, registry=registry)
+        tracker = Tracker(network.loop, registry=registry)
+        tracker_ep = network.register()
+        TrackerEndpoint(tracker, tracker_ep, concurrent=True)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+        env["P2P_SWARM_PSK"] = psk.decode()
+        workers = []
+        try:
+            for _ in range(n_procs):
+                workers.append(subprocess.Popen(
+                    [sys.executable, "-m",
+                     "hlsjs_p2p_wrapper_tpu.testing.announce_worker",
+                     tracker_ep.peer_id, str(per_proc), str(ops_each),
+                     "8"],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    env=env, text=True))
+            for w in workers:
+                ready = w.stdout.readline()
+                assert ready.startswith("READY"), ready
+            start = time.perf_counter()
+            for w in workers:  # all-READY barrier, then release
+                w.stdin.write("GO\n")
+                w.stdin.flush()
+            results = []
+            for w in workers:
+                line = w.stdout.readline()
+                assert line.startswith("RESULT "), line
+                result = json.loads(line[len("RESULT "):])
+                assert "error" not in result, result
+                results.append(result)
+            wall = time.perf_counter() - start
+            total = sum(r["announces"] for r in results)
+            assert total == n_procs * per_proc * ops_each
+            assert tracker.announce_count == total, \
+                (tracker.announce_count, total)
+            p50s = sorted(r["rtt_p50_us"] for r in results)
+            return {
+                "wall_s": round(wall, 3),
+                "announces_per_sec": round(total / wall, 1),
+                "rtt_p50_us": p50s[len(p50s) // 2],
+                "rtt_p99_us": max(r["rtt_p99_us"] for r in results),
+            }
+        finally:
+            for w in workers:
+                try:
+                    w.stdin.close()
+                except OSError:
+                    pass
+                w.wait(timeout=10.0)
+            network.close()
+
     concurrent = measure(concurrent=True)
     serial = measure(concurrent=False)
+    n_procs = int(os.environ.get("ANNOUNCE_STORM_PROCS", 4))
+    multiproc = measure_multiproc(n_procs,
+                                  max(n_threads // n_procs, 1))
+    host_cores = os.cpu_count() or 1
     return {
         "what": f"{n_threads} adapter threads x {ops_each} closed-loop "
                 "ANNOUNCE->PEERS round trips over PSK TCP: inline "
                 "reader-thread delivery (concurrent=True) vs the "
-                "single dispatch loop",
+                "single dispatch loop, plus the same announcer count "
+                f"split across {n_procs} worker PROCESSES (the "
+                "GIL-escape arm)",
         "threads": n_threads, "announces_per_thread": ops_each,
         "concurrent": concurrent, "loop_serialized": serial,
         "speedup_announces": round(
             concurrent["announces_per_sec"]
             / serial["announces_per_sec"], 2),
+        "multiproc": multiproc,
+        "multiproc_procs": n_procs,
+        "host_cores": host_cores,
+        # the headline this round: worker processes vs the serialized
+        # single-process loop — BENCH_r13 pinned the thread arm at
+        # 0.96× (pure GIL queueing); process workers are the escape.
+        # The measured ratio only demonstrates it on a multi-core
+        # host: with fewer cores than 1 tracker + N workers need,
+        # the OS scheduler re-serializes what the GIL no longer does.
+        "multiproc_speedup_vs_serialized": round(
+            multiproc["announces_per_sec"]
+            / serial["announces_per_sec"], 2),
+        "multiproc_note": (
+            "GIL-escape speedup is core-bound: host has "
+            f"{host_cores} core(s); a >=3x ratio needs >=4"),
     }
 
 

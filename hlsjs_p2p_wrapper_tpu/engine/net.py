@@ -77,12 +77,15 @@ trust boundary and WebRTC gave it DTLS for free):
 
 from __future__ import annotations
 
+import errno
 import heapq
 import hmac
 import itertools
 import logging
 import os
+import selectors
 import socket
+import ssl
 import struct
 import threading
 import time
@@ -90,7 +93,7 @@ from typing import Callable, Dict, Optional
 
 from ..core.clock import TimerHandle
 from .faults import FaultPolicy
-from .netfaults import FaultSocket
+from .netfaults import FaultSocket, _FaultHold
 from .telemetry import MetricsRegistry
 
 log = logging.getLogger(__name__)
@@ -256,7 +259,7 @@ class _SafeTls:
                 raise socket.timeout("timed out")  # OSError: caller drops
             with self._lock:
                 try:
-                    return self._tls.recv(n)
+                    return self._tls.recv(n)  # loop-ok: legacy threaded TLS read
                 except ssl.SSLWantReadError:
                     want_write = False
                 except ssl.SSLWantWriteError:
@@ -315,17 +318,59 @@ class _SafeTls:
 
 
 class NetLoop:
-    """Single-threaded dispatcher + Clock implementation: timers and
-    inbound frames all execute on one thread."""
+    """Single-threaded selector event loop + Clock implementation (the
+    C10K round): ONE thread multiplexes every registered non-blocking
+    socket through ``selectors.DefaultSelector`` (epoll/kqueue) AND
+    runs the timer heap + posted-callback queue the Clock protocol
+    needs.  Timers, inbound frames, handshake stages, and write
+    flushes all execute on this thread — an agent constructed with
+    ``clock=network.loop`` stays single-threaded by construction, now
+    with the socket I/O itself on the same thread instead of two
+    threads per connection.
+
+    Selector mutations (:meth:`register` / :meth:`modify` /
+    :meth:`unregister`) are loop-thread-only by contract — cross-
+    thread callers go through :meth:`post` / :meth:`run_soon`.  A
+    non-blocking socketpair waker makes ``post``/``call_later`` safe
+    from any thread while the loop is parked in ``select``.
+
+    Loop health is observable once a registry is attached
+    (:meth:`attach_registry`, done by ``TcpNetwork``):
+    ``net.loop.sockets`` (registered fds), ``net.loop.iteration_ms``
+    (latency histogram per select-dispatch cycle),
+    ``net.loop.stalls`` (one callback hogged the loop past
+    ``STALL_MS``), and ``net.loop.backpressure_high_water_bytes``
+    (high-water of pending write-buffer bytes across the loop's
+    connections)."""
+
+    #: a single callback running longer than this starves every other
+    #: socket on the loop — counted as ``net.loop.stalls``
+    STALL_MS = 100.0
+
+    _ids = itertools.count()
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self.name = f"netloop-{next(NetLoop._ids)}"
+        self._lock = threading.Lock()
         self._heap: list = []
         self._seq = itertools.count()
         self._queue: list = []
         self._stopped = False
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="p2p-netloop")
+        self._sel = selectors.DefaultSelector()
+        # self-pipe waker: post()/call_later() from another thread
+        # must interrupt a parked select(); loop-thread posts skip it
+        # (the next timeout computation sees the queue)
+        self._waker_r, self._waker_w = socket.socketpair()
+        self._waker_r.setblocking(False)
+        self._waker_w.setblocking(False)
+        self._sel.register(self._waker_r, selectors.EVENT_READ, None)
+        self._wake_pending = False
+        self._m: Optional[dict] = None  # metric handles once attached
+        self._io_count = 0
+        self._pending_write = 0
+        self._pending_write_high = 0
+        self._thread = threading.Thread(  # loop-ok: THE loop thread itself
+            target=self._run, daemon=True, name="p2p-netloop")
         self._thread.start()
 
     # -- Clock protocol ------------------------------------------------
@@ -335,33 +380,148 @@ class NetLoop:
     def call_later(self, delay_ms: float, fn: Callable[[], None]) -> TimerHandle:
         handle = TimerHandle()
         due = self.now() + max(float(delay_ms), 0.0)
-        with self._cond:
+        with self._lock:
             heapq.heappush(self._heap, (due, next(self._seq), fn, handle))
-            self._cond.notify()
+        self._wake()
         return handle
 
     # -- dispatch ------------------------------------------------------
-    def post(self, fn: Callable[[], None]) -> None:
-        """Run ``fn`` on the loop thread as soon as possible."""
-        with self._cond:
+    def post(self, fn: Callable[[], None]) -> bool:
+        """Run ``fn`` on the loop thread as soon as possible.  Returns
+        False when the loop is already stopped (the callback will
+        never run — callers owning an fd must fall back to closing it
+        directly)."""
+        with self._lock:
+            if self._stopped:
+                return False
             self._queue.append(fn)
-            self._cond.notify()
+        self._wake()
+        return True
+
+    def run_soon(self, fn: Callable[[], None]) -> bool:
+        """``fn()`` synchronously when already on the loop thread,
+        else :meth:`post` — for teardown paths (selector unregister
+        before fd close) that must not reorder behind a busy loop
+        when the caller IS the loop.  Returns False when the loop is
+        stopped and the callback will never run."""
+        if threading.current_thread() is self._thread:
+            fn()
+            return True
+        return self.post(fn)
+
+    def on_loop_thread(self) -> bool:
+        return threading.current_thread() is self._thread
+
+    def _wake(self) -> None:
+        if threading.current_thread() is self._thread:
+            return  # the loop re-checks its queues before selecting
+        with self._lock:
+            if self._wake_pending or self._stopped:
+                return
+            self._wake_pending = True
+        try:
+            self._waker_w.send(b"\x00")  # loop-ok: non-blocking self-pipe write, not socket traffic
+        except OSError:
+            pass  # loop torn down under the caller; nothing to wake
+
+    # -- selector surface (loop-thread-only) ---------------------------
+    def register(self, fileobj, events: int, callback) -> None:
+        """Register ``fileobj`` for ``events``; ``callback(mask)``
+        runs on the loop thread when ready.  Loop-thread-only."""
+        self._sel.register(fileobj, events, callback)
+        self._io_count += 1
+        if self._m is not None:
+            self._m["sockets"].set(self._io_count)
+
+    def modify(self, fileobj, events: int, callback) -> None:
+        self._sel.modify(fileobj, events, callback)
+
+    def unregister(self, fileobj) -> bool:
+        """Drop a registration (loop-thread-only; MUST precede the fd
+        close, or a recycled descriptor inherits the stale selector
+        key).  Returns False when the fileobj was not registered."""
+        try:
+            self._sel.unregister(fileobj)
+        except (KeyError, ValueError):
+            return False
+        self._io_count -= 1
+        if self._m is not None:
+            self._m["sockets"].set(self._io_count)
+        return True
+
+    def selector_size(self) -> int:
+        """Registered socket count, waker excluded (tests assert a
+        torn-down handshake leaves no key behind)."""
+        return max(0, len(self._sel.get_map()) - 1)
+
+    # -- telemetry -----------------------------------------------------
+    def attach_registry(self, registry: MetricsRegistry) -> None:
+        """Wire the loop-health instruments into ``registry`` (first
+        attach wins — a loop shared by several networks reports
+        once)."""
+        if self._m is not None:
+            return
+        self._m = {
+            "sockets": registry.gauge("net.loop.sockets",
+                                      loop=self.name),
+            "iter": registry.histogram(
+                "net.loop.iteration_ms", loop=self.name,
+                buckets=(0.1, 0.5, 1.0, 5.0, 20.0, 50.0, 100.0,
+                         500.0, 2000.0)),
+            "stalls": registry.counter("net.loop.stalls",
+                                       loop=self.name),
+            "backpressure": registry.gauge(
+                "net.loop.backpressure_high_water_bytes",
+                loop=self.name),
+        }
+
+    def note_pending_write(self, delta: int) -> None:
+        """Connections report write-buffer growth/drain here; the
+        loop-wide high-water feeds the backpressure gauge."""
+        with self._lock:
+            self._pending_write += delta
+            if self._pending_write > self._pending_write_high:
+                self._pending_write_high = self._pending_write
+                high = self._pending_write_high
+            else:
+                return
+        if self._m is not None:
+            self._m["backpressure"].set(high)
+
+    def _run_cb(self, fn, mask) -> None:
+        t0 = time.monotonic()  # clock-ok: stall-accounting span
+        try:
+            if mask is None:
+                fn()
+            else:
+                fn(mask)
+        except Exception:  # noqa: BLE001
+            log.exception("unhandled error on net loop")
+        if self._m is not None:
+            elapsed_ms = (time.monotonic() - t0) * 1000.0  # clock-ok: stall-accounting span
+            if elapsed_ms >= self.STALL_MS:
+                self._m["stalls"].inc()
 
     def _run(self) -> None:
         while True:
-            with self._cond:
+            with self._lock:
                 if self._stopped:
-                    return
-                now = self.now()
+                    break
                 timeout = None
                 if self._queue:
                     timeout = 0.0
                 elif self._heap:
-                    timeout = max(0.0, (self._heap[0][0] - now) / 1000.0)
-                if timeout != 0.0:
-                    self._cond.wait(timeout)
+                    timeout = max(0.0,
+                                  (self._heap[0][0] - self.now())
+                                  / 1000.0)
+            try:
+                events = self._sel.select(timeout)
+            except OSError:
+                break  # selector closed under a racing stop()
+            t0 = time.monotonic()  # clock-ok: iteration-latency span
+            with self._lock:
                 if self._stopped:
-                    return
+                    break
                 batch, self._queue = self._queue, []
                 now = self.now()
                 while self._heap and self._heap[0][0] <= now:
@@ -370,15 +530,49 @@ class NetLoop:
                         handle._fired = True
                         batch.append(fn)
             for fn in batch:
-                try:
-                    fn()
-                except Exception:  # noqa: BLE001
-                    log.exception("unhandled error on net loop")
+                self._run_cb(fn, None)
+            live = self._sel.get_map()
+            for key, mask in events:
+                if key.data is None:  # the waker
+                    try:
+                        while self._waker_r.recv(4096):  # loop-ok: non-blocking self-pipe drain
+                            pass
+                    except OSError:
+                        pass
+                    with self._lock:
+                        self._wake_pending = False
+                    continue
+                # a callback earlier in this very batch may have
+                # unregistered this key (teardown) — or closed the fd
+                # and dialed a NEW socket onto the same number; the
+                # identity check drops exactly those stale events
+                cur = live.get(key.fd)
+                if cur is None or cur.fileobj is not key.fileobj:
+                    continue
+                self._run_cb(key.data, mask)
+            if self._m is not None:
+                self._m["iter"].observe(
+                    (time.monotonic() - t0) * 1000.0)  # clock-ok: iteration-latency span
+        # loop exit owns the teardown: selector + waker pair
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+        for sock in (self._waker_r, self._waker_w):
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def stop(self) -> None:
-        with self._cond:
+        with self._lock:
+            if self._stopped:
+                return
             self._stopped = True
-            self._cond.notify()
+        try:
+            self._waker_w.send(b"\x00")  # loop-ok: non-blocking self-pipe wake for stop
+        except OSError:
+            pass  # loop already past its select
 
 
 class ReconnectPolicy:
@@ -579,8 +773,9 @@ class _Connection:
         #: sanctioned, or it races close() into a spurious redial
         self._heal_pending = sock is None
         self._cond = threading.Condition()
-        self._writer = threading.Thread(target=self._write_loop, daemon=True,
-                                        name=f"p2p-writer-{remote_id}")
+        self._writer = threading.Thread(  # loop-ok: legacy threads transport
+            target=self._write_loop, daemon=True,
+            name=f"p2p-writer-{remote_id}")
 
     def start(self) -> None:
         """Begin I/O.  Called AFTER the endpoint has registered this
@@ -592,8 +787,9 @@ class _Connection:
         double-reader race the sock-based check here used to cause)."""
         self._writer.start()
         if self._inbound:
-            threading.Thread(target=self.endpoint._reader_loop, args=(self,),
-                             daemon=True).start()
+            threading.Thread(  # loop-ok: legacy threads transport
+                target=self.endpoint._reader_loop, args=(self,),
+                daemon=True).start()
 
     def enqueue(self, frame: bytes) -> bool:
         with self._cond:
@@ -686,7 +882,7 @@ class _Connection:
                                      frame, tag))
                 else:
                     wire = _LEN.pack(len(frame)) + frame
-                sock.sendall(wire)
+                sock.sendall(wire)  # loop-ok: legacy threaded writer's blocking send
                 elapsed = time.monotonic() - t0  # clock-ok: EWMA measurement
                 self.endpoint.bytes_sent += len(frame)
             except OSError:
@@ -762,7 +958,7 @@ class _Connection:
                 # would let a stale reader grab a newer link's socket
                 # after a fast die-and-heal cycle (two readers on one
                 # socket steal bytes from each other)
-                threading.Thread(target=endpoint._reader_loop,
+                threading.Thread(target=endpoint._reader_loop,  # loop-ok: legacy threads transport
                                  args=(self, sock, self.recv_key),
                                  daemon=True).start()
                 if redialing or attempt > 0:
@@ -977,7 +1173,7 @@ def _read_exact(sock: socket.socket, n: int,
                 if remaining <= 0:
                     return None
                 sock.settimeout(remaining)
-            chunk = sock.recv(n - len(buf))
+            chunk = sock.recv(n - len(buf))  # loop-ok: legacy handshake read
         except OSError:
             return None  # connection torn down under us (or expired)
         if not chunk:
@@ -1001,7 +1197,7 @@ def _send_with_deadline(sock: socket.socket, data: bytes,
     if remaining <= 0:
         raise socket.timeout("handshake deadline exceeded")
     sock.settimeout(remaining)
-    sock.sendall(data)
+    sock.sendall(data)  # loop-ok: legacy threaded handshake send (deadline-bounded)
 
 
 def _read_frame(sock: socket.socket,
@@ -1081,10 +1277,22 @@ class TcpEndpoint:
         self._reconnect_listeners: list = []
         self._probe_timer = None
 
+        # deployment-scale knobs (TcpNetwork construction): instance
+        # attributes so ONE big endpoint (a tracker serving a whole
+        # fleet) can outgrow the class defaults without patching them
+        # for every endpoint in the process
+        if network.max_connections is not None:
+            self.MAX_CONNECTIONS = network.max_connections
+        if network.max_pending_handshakes is not None:
+            self.MAX_PENDING_HANDSHAKES = network.max_pending_handshakes
+        backlog = (network.listen_backlog
+                   if network.listen_backlog is not None
+                   else self.LISTEN_BACKLOG)
+
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, 0))
-        self._listener.listen(16)
+        self._listener.listen(backlog)
         self.peer_id = f"{host}:{self._listener.getsockname()[1]}"
         # registry handles pre-created (BEFORE the accept thread can
         # fire a flood reject): these bump during exactly the
@@ -1114,9 +1322,22 @@ class TcpEndpoint:
         for state in ("open", "half_open", "closed"):
             self._m_counts[("circuit", state)] = registry.counter(
                 "net.circuit", endpoint=self.peer_id, state=state)
-        threading.Thread(target=self._accept_loop, daemon=True,
-                         name=f"p2p-accept-{self.peer_id}").start()
+        self._begin_accept()
         self._arm_probe_timer()
+
+    def _begin_accept(self) -> None:
+        """Start taking inbound connections.  The threaded transport
+        dedicates an accept thread; ``_LoopEndpoint`` registers the
+        listener on the selector instead."""
+        threading.Thread(target=self._accept_loop, daemon=True,  # loop-ok: legacy threads transport
+                         name=f"p2p-accept-{self.peer_id}").start()
+
+    def _make_connection(self, remote_id: str,
+                         sock=None) -> "_Connection":
+        """Connection factory — the one seam the loop transport
+        overrides to mint per-connection state machines instead of
+        thread pairs."""
+        return _Connection(self, remote_id, sock)
 
     def _count(self, counter: str, reason: Optional[str] = None,
                n: int = 1) -> None:
@@ -1309,7 +1530,8 @@ class TcpEndpoint:
                             # every link busy; like a full queue
                             drop = "admission"
                         else:
-                            conn = started = _Connection(self, dest_id)
+                            conn = started = \
+                                self._make_connection(dest_id)
                             self._conns[dest_id] = conn
         if drop is not None:
             self._count("send_drops", drop)
@@ -1333,7 +1555,7 @@ class TcpEndpoint:
     def _accept_loop(self) -> None:
         while not self.closed:
             try:
-                sock, _addr = self._listener.accept()
+                sock, _addr = self._listener.accept()  # loop-ok: legacy threaded accept loop
             except OSError:
                 return
             with self._conn_lock:
@@ -1353,7 +1575,7 @@ class TcpEndpoint:
                 except OSError:
                     pass
                 continue
-            threading.Thread(target=self._handshake_tracked,
+            threading.Thread(target=self._handshake_tracked,  # loop-ok: legacy threads transport
                              args=(sock,), daemon=True).start()
 
     def _handshake_tracked(self, sock: socket.socket) -> None:
@@ -1386,6 +1608,12 @@ class TcpEndpoint:
     #: flood must not pin one thread + fd per dial for the whole
     #: handshake timeout
     MAX_PENDING_HANDSHAKES = 64
+    #: kernel accept backlog.  Sized for the loop transport, where a
+    #: pack of hundreds of peers may dial one tracker endpoint inside
+    #: a single RTT; the threaded transport drains accepts fast
+    #: enough that the old 16 never mattered, and a deeper backlog
+    #: costs nothing there
+    LISTEN_BACKLOG = 128
 
     def _handshake_inbound(self, sock: socket.socket) -> None:
         # the whole identity handshake runs under ONE absolute
@@ -1487,11 +1715,18 @@ class TcpEndpoint:
             return
         if isinstance(sock, FaultSocket):
             sock.arm_frames()  # send-fault indices count frames only
-        conn = _Connection(self, remote_id, sock)
+        conn = self._make_connection(remote_id, sock)
         if frame_keys is not None:
             # acceptor sends on the a2c key, verifies on c2a — set
             # before start() spawns the reader (happens-before)
             conn.recv_key, conn.send_key = frame_keys
+        self._admit_inbound(conn)
+
+    def _admit_inbound(self, conn: "_Connection") -> bool:
+        """Register an authenticated inbound connection (shared by
+        the blocking and staged handshake paths).  Returns True with
+        the connection started, False after closing it (endpoint
+        closed, or admission refused at the cap)."""
         victim = None
         with self._conn_lock:
             # a handshake racing close() must not register a fresh
@@ -1504,7 +1739,7 @@ class TcpEndpoint:
                 # reuse: an inbound link doubles as our outbound to
                 # them; a stale dead entry must not shadow the fresh
                 # link
-                existing = self._conns.get(remote_id)
+                existing = self._conns.get(conn.remote_id)
                 if existing is not None and not existing.closed:
                     # crossed dial: both sides connected
                     # simultaneously.  This inbound IS the remote's
@@ -1522,13 +1757,14 @@ class TcpEndpoint:
                 else:
                     register, victim = self._evict_for_admission_locked()
                     if register:
-                        self._conns[remote_id] = conn
+                        self._conns[conn.remote_id] = conn
         if victim is not None:
             victim.close()  # outside the lock: close() re-enters _forget
         if not register:
             conn.close()
-            return
+            return False
         conn.start()
+        return True
 
     def _reader_loop(self, conn: _Connection, sock=None,
                      recv_key=None) -> None:
@@ -1621,6 +1857,12 @@ class TcpEndpoint:
             self._probe_timer = None
         if probe_timer is not None:
             probe_timer.cancel()
+        self._close_listener()
+        for conn in conns:  # outside the lock: close() calls _forget()
+            conn.close()
+        self.network._forget_endpoint(self)
+
+    def _close_listener(self) -> None:
         try:
             # shutdown BEFORE close, like _Connection.close: close()
             # alone does not wake a thread blocked in accept() — the
@@ -1649,9 +1891,1345 @@ class TcpEndpoint:
             self._listener.close()
         except OSError:
             pass
-        for conn in conns:  # outside the lock: close() calls _forget()
-            conn.close()
-        self.network._forget_endpoint(self)
+
+
+class _LoopConnection(_Connection):
+    """One TCP link as a per-connection state machine on the NetLoop
+    selector core (the C10K round) — same wire protocol, framing,
+    MAC discipline, healing policy, and counter semantics as the
+    threaded :class:`_Connection`, with the writer/reader thread pair
+    replaced by non-blocking callbacks:
+
+    - partial reads accumulate in ``_rbuf`` until a full
+      length-prefixed record parses;
+    - partial writes keep the in-flight wire + offset in
+      ``_wire``/``_wire_off`` and resume on the next writable event;
+    - the wire for a frame is built LAZILY at flush start (MAC key +
+      sequence snapshotted then), so a frame that survives a link
+      death re-MACs under the healed link's fresh keys;
+    - dials/redials are staged through :class:`_LoopDial` with the
+      exact per-attempt accounting of ``_Connection._establish``
+      (circuit gate → reconnect count → backoff timer);
+    - fault verdicts come from ``FaultSocket.stage_frame`` /
+      ``_FaultHold`` instead of blocking sleeps.
+
+    Threading contract: ``enqueue``/``probe``/``close``/``_link_down``
+    are callable from ANY thread (the engine and the probe timer use
+    them); every fd operation — selector registration and the final
+    ``close()`` of a socket — happens ONLY on the loop thread, so a
+    freshly dialed socket can never collide with a stale selector key
+    for a recycled descriptor.  Foreign threads ``shutdown()`` (which
+    wakes the loop with EOF/error) and post the fd teardown."""
+
+    def __init__(self, endpoint: "TcpEndpoint", remote_id: str,
+                 sock=None):
+        super().__init__(endpoint, remote_id, sock)
+        self.loop = endpoint.loop
+        # loop-thread-private I/O state (no lock: single-threaded by
+        # construction; _link_down from foreign threads never touches
+        # these — the posted teardown resets them on the loop)
+        self._rbuf = bytearray()
+        self._recv_seq = 0
+        self._wire = None          # staged bytes of the in-flight frame
+        self._wire_off = 0
+        self._wire_kind = "send"   # "send" | "rst" | "partial"
+        self._wire_staged = False  # fault verdict already taken?
+        self._wire_delayed = False  # injected latency already applied?
+        self._wire_t0 = 0.0
+        self._wedged = False       # injected partial-write stall
+        self._read_paused = False  # _FaultHold on recv
+        self._write_paused = False  # _FaultHold / injected latency
+        self._flush_on_read = False  # TLS wants READ to finish a send
+        self._registered_sock = None
+        self._events = 0
+        self._dial: Optional["_LoopDial"] = None
+        self._attempt = 0
+        self._redialing = False
+        self._dial_reason = "connect"
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if self._inbound:
+            self.loop.run_soon(self._attach_inbound)
+        else:
+            self.loop.run_soon(self._begin_dial)
+
+    def _attach_inbound(self) -> None:  # loop thread
+        with self._cond:
+            sock = self.sock
+            if self.closed or sock is None:
+                return
+        try:
+            sock.setblocking(False)
+        except OSError:
+            self._link_down("recv", sock)
+            return
+        self._update_interest()
+        # bytes the handshake's reads overshot into (the first
+        # frames can ride the same segment as the final MAC record)
+        # are already drained from the kernel — select will never
+        # re-report them, so parse them NOW
+        if self._rbuf and not self._parse_records(sock):
+            return
+
+    # -- selector interest ---------------------------------------------
+
+    def _update_interest(self) -> None:  # loop thread
+        with self._cond:
+            sock = self.sock
+            closed = self.closed
+            pending = self._wire is not None or bool(self._queue)
+        if closed or sock is None:
+            return
+        want = 0
+        if not self._read_paused:
+            want |= selectors.EVENT_READ
+        if pending and not (self._wedged or self._write_paused):
+            want |= selectors.EVENT_WRITE
+        if self._registered_sock is not sock:
+            if self._registered_sock is not None:
+                self.loop.unregister(self._registered_sock)
+                self._registered_sock = None
+            if want:
+                self.loop.register(sock, want, self._on_io)
+                self._registered_sock = sock
+            self._events = want
+            return
+        if want == self._events:
+            return
+        if want == 0:
+            self.loop.unregister(sock)
+            self._registered_sock = None
+        else:
+            self.loop.modify(sock, want, self._on_io)
+        self._events = want
+
+    def _kick(self) -> None:  # loop thread (posted by enqueue)
+        if self.closed:
+            return
+        self._update_interest()
+
+    def enqueue(self, frame: bytes) -> bool:
+        queued = super().enqueue(frame)
+        if queued:
+            self.loop.note_pending_write(len(frame))
+            self.loop.run_soon(self._kick)
+        return queued
+
+    # -- I/O callbacks -------------------------------------------------
+
+    def _on_io(self, mask: int) -> None:  # loop thread
+        with self._cond:
+            sock = self.sock
+            if self.closed or sock is None:
+                return
+        if sock is not self._registered_sock:
+            return  # stale event for a replaced link
+        if mask & selectors.EVENT_READ:
+            self._on_readable(sock)
+            with self._cond:
+                if self.closed or self.sock is not sock:
+                    return
+        if mask & selectors.EVENT_WRITE:
+            self._flush(sock)
+
+    def _resume_read(self) -> None:  # loop thread (fault-hold timer)
+        if self.closed:
+            return
+        self._read_paused = False
+        self._update_interest()
+
+    def _resume_write(self) -> None:  # loop thread (delay/hold timer)
+        if self.closed:
+            return
+        self._write_paused = False
+        self._update_interest()
+
+    def _on_readable(self, sock) -> None:  # loop thread
+        # drain until EAGAIN: an SSLSocket buffers decrypted bytes
+        # internally, so stopping after one recv would strand them
+        # (the kernel fd never signals readable for them again)
+        while True:
+            try:
+                data = sock.recv(65536)  # loop-ok: non-blocking recv on the loop
+            except _FaultHold as hold:
+                self._read_paused = True
+                self.loop.call_later(hold.retry_ms, self._resume_read)
+                self._update_interest()
+                return
+            except (ssl.SSLWantReadError, ssl.SSLWantWriteError):
+                break
+            except BlockingIOError:
+                break
+            except OSError:
+                self._link_down("recv", sock)
+                return
+            if not data:
+                self._link_down("recv", sock)
+                return
+            self._rbuf += data
+            if not self._parse_records(sock):
+                return
+        if self._flush_on_read:
+            self._flush_on_read = False
+            self._write_paused = False
+            self._update_interest()
+            self._flush(sock)
+
+    def _parse_records(self, sock) -> bool:  # loop thread
+        """Deliver every complete record buffered so far.  Returns
+        False when the link died (or was replaced) under a handler."""
+        while True:
+            with self._cond:
+                if self.closed or self.sock is not sock:
+                    return False
+                recv_key = self.recv_key
+            max_wire = MAX_FRAME_BYTES + (FRAME_MAC_LEN
+                                          if recv_key is not None else 0)
+            if len(self._rbuf) < _LEN.size:
+                return True
+            (length,) = _LEN.unpack_from(self._rbuf)
+            if length > max_wire:
+                self._link_down("recv", sock)  # poisoned stream
+                return False
+            if len(self._rbuf) < _LEN.size + length:
+                return True
+            frame = bytes(self._rbuf[_LEN.size:_LEN.size + length])
+            del self._rbuf[:_LEN.size + length]
+            if recv_key is not None:
+                if len(frame) < FRAME_MAC_LEN:
+                    log.warning("dropping %s: untagged frame on an "
+                                "authenticated link", self.remote_id)
+                    self.endpoint._count("mac_drops")
+                    self._link_down("mac", sock)
+                    return False
+                body, tag = (frame[:-FRAME_MAC_LEN],
+                             frame[-FRAME_MAC_LEN:])
+                if not hmac.compare_digest(
+                        tag, _frame_tag(recv_key, self._recv_seq, body)):
+                    log.warning("dropping %s: frame MAC mismatch "
+                                "(injection or splice?)", self.remote_id)
+                    self.endpoint._count("mac_drops")
+                    self._link_down("mac", sock)
+                    return False
+                self._recv_seq += 1
+                frame = body
+            self.last_activity = time.monotonic()  # clock-ok: eviction hint
+            self._mark_progress()
+            endpoint = self.endpoint
+            endpoint.bytes_received += len(frame)
+            # delivery runs HERE — the loop thread IS the dispatch
+            # thread, so the single-threaded-engine contract holds by
+            # construction (deliver_inline is a no-op distinction on
+            # this transport).  A handler bug costs this frame, not
+            # the loop (same containment as NetLoop._run_cb).
+            if not endpoint.closed and endpoint.on_receive is not None:
+                try:
+                    endpoint.on_receive(self.remote_id, frame)
+                except Exception:  # noqa: BLE001
+                    log.exception("unhandled error in frame handler")
+
+    # -- write path ----------------------------------------------------
+
+    def _flush(self, sock) -> None:  # loop thread
+        while True:
+            if self._wire is None:
+                with self._cond:
+                    if self.closed or self.sock is not sock:
+                        return
+                    if not self._queue:
+                        self._update_interest()
+                        return
+                    frame = self._queue[0]
+                    send_key = self.send_key
+                    send_seq = self._send_seq
+                    if send_key is not None:
+                        self._send_seq += 1
+                    t0 = time.monotonic()  # clock-ok: stall-floor timebase
+                    self._send_started = t0
+                if send_key is not None:
+                    tag = _frame_tag(send_key, send_seq, frame)
+                    wire = b"".join((_LEN.pack(len(frame) + len(tag)),
+                                     frame, tag))
+                else:
+                    wire = _LEN.pack(len(frame)) + frame
+                self._wire = wire
+                self._wire_off = 0
+                self._wire_kind = "send"
+                self._wire_staged = False
+                self._wire_delayed = False
+                self._wire_t0 = t0
+            else:
+                with self._cond:
+                    if self.closed or self.sock is not sock \
+                            or not self._queue:
+                        return
+                    frame = self._queue[0]
+            if not self._wire_staged:
+                if isinstance(sock, FaultSocket):
+                    verdict, arg = sock.stage_frame(
+                        self._wire, delayed=self._wire_delayed)
+                    if verdict == "delay":
+                        self._wire_delayed = True
+                        self._write_paused = True
+                        self.loop.call_later(arg, self._resume_write)
+                        self._update_interest()
+                        return
+                    if verdict == "swallow":
+                        # the wire never sees the record, but the
+                        # sender accounts it sent (the MAC-sequence
+                        # desync downstream is the injected fault)
+                        self._complete_frame(sock, frame,
+                                             self._wire_t0)
+                        continue
+                    self._wire_kind = verdict
+                    self._wire = arg
+                    self._wire_off = 0
+                self._wire_staged = True
+            view = memoryview(self._wire)
+            while self._wire_off < len(view):
+                try:
+                    n = sock.send(view[self._wire_off:])  # loop-ok: non-blocking send on the loop
+                except _FaultHold as hold:
+                    self._write_paused = True
+                    self.loop.call_later(hold.retry_ms,
+                                         self._resume_write)
+                    self._update_interest()
+                    return
+                except ssl.SSLWantWriteError:
+                    self._update_interest()
+                    return
+                except ssl.SSLWantReadError:
+                    # TLS needs inbound bytes to make write progress;
+                    # writable-spin until then would starve the loop
+                    self._flush_on_read = True
+                    self._write_paused = True
+                    self._update_interest()
+                    return
+                except BlockingIOError:
+                    self._update_interest()
+                    return
+                except OSError:
+                    with self._cond:
+                        self._send_started = None
+                    self._link_down("send_error", sock)
+                    return
+                self._wire_off += n
+            if self._wire_kind == "rst":
+                # half the frame left, then the injected reset: the
+                # frame stays queued for the healed link (peek/pop
+                # discipline), exactly like the blocking shim's
+                # ConnectionResetError out of sendall
+                with self._cond:
+                    self._send_started = None
+                self._link_down("send_error", sock)
+                return
+            if self._wire_kind == "partial":
+                # half the frame then a wedge: keep _send_started so
+                # the idle probe is what tears the half-open link
+                self._wedged = True
+                self._update_interest()
+                return
+            self._complete_frame(sock, frame, self._wire_t0)
+
+    def _complete_frame(self, sock, frame, t0) -> None:  # loop thread
+        elapsed = time.monotonic() - t0  # clock-ok: EWMA measurement
+        self.endpoint.bytes_sent += len(frame)
+        self._wire = None
+        self._wire_off = 0
+        self._wire_staged = False
+        with self._cond:
+            self._send_started = None
+            if self._queue and self._queue[0] is frame:
+                self._queue.pop(0)
+                self._queued_bytes -= len(frame)
+                self.loop.note_pending_write(-len(frame))
+            if elapsed > 0.0:
+                inst_bps = len(frame) * 8.0 / elapsed
+                self._drain_bps = (inst_bps if self._drain_bps == 0.0
+                                   else 0.8 * self._drain_bps
+                                   + 0.2 * inst_bps)
+
+    # -- link death / healing ------------------------------------------
+
+    def _link_down(self, reason: str, sock) -> None:
+        """Any-thread-safe (the probe timer and engine threads call
+        this): state flips under ``_cond``, the socket is shutdown()
+        immediately (wakes the loop), and the fd teardown + redial
+        run on the loop thread in FIFO order — teardown strictly
+        before any new dial, so a recycled descriptor can never meet
+        a stale selector key."""
+        heal = self.endpoint._heal
+        circuit = (self.endpoint._circuit_for(self.remote_id)
+                   if heal is not None else None)
+        tripped = None
+        with self._cond:
+            if self.closed or sock is None or self.sock is not sock:
+                return  # stale report from an already-replaced link
+            self.sock = None
+            self._down_reason = reason
+            self.send_key = self.recv_key = None
+            self._send_started = None
+            redial = heal is not None and (bool(self._queue)
+                                           or (reason == "probe"
+                                               and not self._inbound))
+            if circuit is not None and not self._progressed:
+                tripped = circuit.record_failure(
+                    self.endpoint._hclock(), heal)
+                if tripped is not None:
+                    redial = False
+            self._heal_pending = redial
+            self._cond.notify_all()
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        if not self.loop.run_soon(lambda: self._teardown_sock(sock)):
+            try:
+                sock.close()  # loop already stopped: nothing to race
+            except OSError:
+                pass
+        if tripped is not None:
+            self.endpoint._count("circuit", "open")
+            self.endpoint._trace("circuit_open", remote=self.remote_id)
+        if not redial:
+            self.close("circuit_open" if tripped is not None
+                       else "closed")
+        else:
+            self.loop.run_soon(self._begin_redial)
+
+    def _teardown_sock(self, sock) -> None:  # loop thread
+        if self._registered_sock is sock:
+            self.loop.unregister(sock)
+            self._registered_sock = None
+            self._events = 0
+        with self._cond:
+            current = self.sock
+        if current is None or current is sock:
+            # loop-private I/O state belongs to the dead link; a
+            # healed link re-initializes its own on install (the
+            # guard keeps a late teardown from clobbering it)
+            self._wire = None
+            self._wire_staged = False
+            self._wedged = False
+            self._read_paused = self._write_paused = False
+            self._flush_on_read = False
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    # -- outbound dial machinery ---------------------------------------
+
+    def _begin_dial(self) -> None:  # loop thread
+        with self._cond:
+            if self.closed:
+                return
+            self._redialing = self._down_reason is not None
+            self._dial_reason = self._down_reason or "connect"
+        self._attempt = 0
+        self._dial_attempt()
+
+    def _begin_redial(self) -> None:  # loop thread
+        with self._cond:
+            if self.closed or not self._heal_pending \
+                    or self.sock is not None:
+                return
+        self._begin_dial()
+
+    def _dial_attempt(self) -> None:  # loop thread
+        # per-attempt accounting mirrors _Connection._establish
+        # exactly: circuit gate → reconnect count/trace → dial
+        endpoint = self.endpoint
+        with self._cond:
+            if self.closed:
+                return
+        circuit = endpoint._circuit_for(self.remote_id)
+        if circuit is not None:
+            allowed, probe = circuit.allow_attempt(endpoint._hclock())
+            if not allowed:
+                self.close(drop_reason="circuit_open")
+                return
+            if probe is not None:
+                endpoint._count("circuit", "half_open")
+        if self._redialing or self._attempt > 0:
+            endpoint._count("reconnects", self._dial_reason)
+            endpoint._trace("reconnect", remote=self.remote_id,
+                            reason=self._dial_reason,
+                            attempt=self._attempt)
+        self._dial = _LoopDial(self)
+        self._dial.start()
+
+    def _dial_failed(self, dial: "_LoopDial") -> None:  # loop thread
+        if dial is not self._dial:
+            return  # aborted by close(); nothing more to do
+        self._dial = None
+        endpoint = self.endpoint
+        heal = endpoint._heal
+        circuit = endpoint._circuit_for(self.remote_id)
+        if circuit is not None and heal is not None:
+            tripped = circuit.record_failure(endpoint._hclock(), heal)
+            if tripped is not None:
+                endpoint._count("circuit", "open")
+                endpoint._trace("circuit_open", remote=self.remote_id)
+                self.close(drop_reason="circuit_open")
+                return
+        self._attempt += 1
+        if heal is None or self._attempt > heal.max_retries:
+            self.close(drop_reason="giveup")
+            return
+        self.loop.call_later(heal.backoff_s(self._attempt - 1) * 1000.0,
+                             self._dial_attempt)
+
+    def _dial_succeeded(self, dial: "_LoopDial", sock,
+                        send_key, recv_key) -> None:  # loop thread
+        if dial is not self._dial:
+            try:
+                sock.close()  # close() raced the dial; we own cleanup
+            except OSError:
+                pass
+            return
+        self._dial = None
+        with self._cond:
+            installed = not self.closed
+            if installed:
+                self.sock = sock
+                self._heal_pending = False
+                # whatever its origin, the link is now one WE dialed
+                # — probe-healing is ours from here
+                self._inbound = False
+                self.send_key, self.recv_key = send_key, recv_key
+                self._send_seq = 0
+                self._down_reason = None
+                self._progressed = False
+                self._send_started = None
+        if not installed:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        # fresh link session: loop-private I/O state starts clean
+        # (fresh buffer OBJECT — a foreign-thread _link_down must
+        # never mutate the one a stale parse might still hold).  The
+        # dial's read overshoot seeds the buffer: the acceptor's
+        # first frames can ride the same segment as its last
+        # handshake record, and select never re-reports drained bytes
+        self._rbuf = bytearray(dial._rbuf)
+        self._recv_seq = 0
+        self._wire = None
+        self._wire_off = 0
+        self._wire_staged = False
+        self._wedged = False
+        self._read_paused = self._write_paused = False
+        self._flush_on_read = False
+        self._update_interest()
+        if self._redialing or self._attempt > 0:
+            self.endpoint._notify_reconnect(self.remote_id)
+        if self._rbuf and not self._parse_records(sock):
+            return
+
+    # -- teardown ------------------------------------------------------
+
+    def _flush_pending(self) -> bool:
+        """Would giving the loop a moment let queued frames still
+        reach the wire?  Advisory (endpoint close drain): True while
+        bytes are queued AND a live link, an in-flight dial, or a
+        sanctioned redial could drain them."""
+        with self._cond:
+            if self.closed or self._wedged:
+                return False
+            if self._queued_bytes <= 0 and self._wire is None:
+                return False
+            return (self.sock is not None or self._dial is not None
+                    or self._heal_pending)
+
+    def close(self, drop_reason: str = "closed") -> None:
+        with self._cond:
+            if self.closed:
+                return
+            self.closed = True
+            dropped = len(self._queue)
+            dropped_bytes = self._queued_bytes
+            self._queue.clear()
+            self._queued_bytes = 0
+            self._send_started = None
+            sock = self.sock
+            self._cond.notify_all()
+        if dropped:
+            self.endpoint._count("send_drops", drop_reason, n=dropped)
+        if dropped_bytes:
+            self.loop.note_pending_write(-dropped_bytes)
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+        def teardown() -> None:
+            dial, self._dial = self._dial, None
+            if dial is not None:
+                dial.abort()
+            if sock is not None:
+                self._teardown_sock(sock)
+
+        if not self.loop.run_soon(teardown):
+            # loop already stopped: no selector left to race, close
+            # the fd directly so it cannot leak
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        self.endpoint._forget(self)
+
+
+class _LoopDial:
+    """ONE staged outbound connect + preamble/PSK handshake attempt
+    on the loop thread — the non-blocking mirror of
+    ``_Connection._connect_with_preamble`` with the same record
+    order, nonce-length checks, and single absolute deadline
+    (``HANDSHAKE_TIMEOUT_S``, read at dial time so tests patching the
+    module global keep binding).  Reports exactly once into
+    ``conn._dial_succeeded`` / ``conn._dial_failed``."""
+
+    _CONNECTING, _TLS, _SEND, _READ_A_NONCE, _SEND_MAC = range(5)
+
+    def __init__(self, conn: _LoopConnection):
+        self.conn = conn
+        self.endpoint = conn.endpoint
+        self.loop = conn.loop
+        self.sock = None
+        self._host = ""
+        self._stage = self._CONNECTING
+        self._out = bytearray()
+        self._rbuf = bytearray()
+        self._raw_preamble = b""
+        self._c_nonce: Optional[bytes] = None
+        self._keys = (None, None)
+        self._stalled = False
+        self._registered = False
+        self._events = 0
+        self._done = False
+        self._deadline_timer = None
+
+    def start(self) -> None:  # loop thread
+        network = self.endpoint.network
+        try:
+            host, port_s = self.conn.remote_id.rsplit(":", 1)
+            port = int(port_s)
+        except ValueError:
+            self._fail()
+            return
+        self._host = host
+        plan = network.fault_plan
+        if plan is not None:
+            kind = plan.on_connect()
+            if kind == "refuse":
+                self._fail()  # injected connect refusal
+                return
+            self._stalled = kind == "stall"
+        family = socket.AF_INET6 if ":" in host else socket.AF_INET
+        try:
+            sock = socket.socket(family, socket.SOCK_STREAM)
+            sock.setblocking(False)
+            # peer ids are listener addresses (numeric in practice);
+            # a hostname resolves synchronously here, same as the
+            # threaded create_connection did on its writer thread
+            err = sock.connect_ex((host, port))
+        except OSError:
+            self._fail()
+            return
+        if err not in (0, errno.EINPROGRESS, errno.EWOULDBLOCK,
+                       errno.EALREADY):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._fail()
+            return
+        self.sock = sock
+        self._deadline_timer = self.loop.call_later(
+            HANDSHAKE_TIMEOUT_S * 1000.0, self._on_deadline)
+        self._set_interest(selectors.EVENT_WRITE)
+
+    # -- plumbing ------------------------------------------------------
+
+    def _set_interest(self, events: int) -> None:  # loop thread
+        if events == 0:
+            if self._registered:
+                self.loop.unregister(self.sock)
+                self._registered = False
+            self._events = 0
+            return
+        if not self._registered:
+            self.loop.register(self.sock, events, self._on_io)
+            self._registered = True
+        elif events != self._events:
+            self.loop.modify(self.sock, events, self._on_io)
+        self._events = events
+
+    def _pause(self, retry_ms: float) -> None:
+        self._set_interest(0)
+        self.loop.call_later(retry_ms, self._resume)
+
+    def _resume(self) -> None:
+        if self._done:
+            return
+        self._dispatch()
+
+    def _on_io(self, mask: int) -> None:  # loop thread
+        if self._done:
+            return
+        if self._stage == self._CONNECTING:
+            err = self.sock.getsockopt(socket.SOL_SOCKET,
+                                       socket.SO_ERROR)
+            if err:
+                self._fail()
+                return
+            self._connected()
+            return
+        self._dispatch()
+
+    def _dispatch(self) -> None:
+        if self._stage == self._TLS:
+            self._tls_step()
+        elif self._stage in (self._SEND, self._SEND_MAC):
+            self._flush_out()
+        elif self._stage == self._READ_A_NONCE:
+            self._set_interest(selectors.EVENT_READ)
+            self._read_step()
+
+    # -- stages --------------------------------------------------------
+
+    def _connected(self) -> None:
+        ctx = self.endpoint.network.ssl_client_context
+        if ctx is not None:
+            raw = self.sock
+            # unregister BEFORE wrap_socket: the wrap detaches raw's
+            # fd into the SSLSocket, leaving a dead fileobj behind
+            self._set_interest(0)
+            try:
+                self.sock = ctx.wrap_socket(
+                    raw, server_hostname=self._host,
+                    do_handshake_on_connect=False)
+            except (OSError, ValueError):
+                self._fail()
+                return
+            self._stage = self._TLS
+            self._tls_step()
+            return
+        self._post_channel_setup()
+
+    def _tls_step(self) -> None:
+        try:
+            self.sock.do_handshake()
+        except ssl.SSLWantReadError:
+            self._set_interest(selectors.EVENT_READ)
+            return
+        except ssl.SSLWantWriteError:
+            self._set_interest(selectors.EVENT_WRITE)
+            return
+        except (OSError, ValueError):
+            self._fail()
+            return
+        self._post_channel_setup()
+
+    def _post_channel_setup(self) -> None:
+        network = self.endpoint.network
+        plan = network.fault_plan
+        if plan is not None:
+            # the fault shim rides ABOVE any TLS wrap and UNDER the
+            # identity handshake, exactly like the threaded path
+            if self._registered:
+                self.loop.unregister(self.sock)
+                self._registered = False
+                self._events = 0
+            shim = FaultSocket(self.sock, plan, stalled=self._stalled)
+            shim.setblocking(False)
+            self.sock = shim
+        raw = self.endpoint.peer_id.encode()
+        self._raw_preamble = raw
+        self._out += _LEN.pack(len(raw)) + raw
+        psk = network.psk
+        if psk is not None:
+            self._c_nonce = os.urandom(NONCE_LEN)
+            self._out += _LEN.pack(len(self._c_nonce)) + self._c_nonce
+        self._stage = self._SEND
+        self._flush_out()
+
+    def _flush_out(self) -> None:
+        while self._out:
+            try:
+                n = self.sock.send(memoryview(self._out))  # loop-ok: non-blocking handshake send
+            except _FaultHold as hold:
+                self._pause(hold.retry_ms)
+                return
+            except ssl.SSLWantWriteError:
+                self._set_interest(selectors.EVENT_WRITE)
+                return
+            except ssl.SSLWantReadError:
+                self._set_interest(selectors.EVENT_READ)
+                return
+            except BlockingIOError:
+                self._set_interest(selectors.EVENT_WRITE)
+                return
+            except OSError:
+                self._fail()
+                return
+            del self._out[:n]
+        if self._stage == self._SEND:
+            if self.endpoint.network.psk is None:
+                self._succeed()
+                return
+            self._stage = self._READ_A_NONCE
+            self._set_interest(selectors.EVENT_READ)
+            self._read_step()  # TLS may have buffered it already
+            return
+        self._succeed()  # _SEND_MAC flushed
+
+    def _read_step(self) -> None:
+        a_nonce = None
+        while a_nonce is None:
+            if len(self._rbuf) >= _LEN.size:
+                (length,) = _LEN.unpack_from(self._rbuf)
+                if length > MAX_AUTH_BYTES:
+                    self._fail()
+                    return
+                if len(self._rbuf) >= _LEN.size + length:
+                    a_nonce = bytes(
+                        self._rbuf[_LEN.size:_LEN.size + length])
+                    del self._rbuf[:_LEN.size + length]
+                    break
+            try:
+                data = self.sock.recv(4096)  # loop-ok: non-blocking handshake recv
+            except _FaultHold as hold:
+                self._pause(hold.retry_ms)
+                return
+            except ssl.SSLWantReadError:
+                self._set_interest(selectors.EVENT_READ)
+                return
+            except ssl.SSLWantWriteError:
+                self._set_interest(selectors.EVENT_WRITE)
+                return
+            except BlockingIOError:
+                self._set_interest(selectors.EVENT_READ)
+                return
+            except OSError:
+                self._fail()
+                return
+            if not data:
+                self._fail()
+                return
+            self._rbuf += data
+        # exact-length check (see NONCE_LEN): a variable-length nonce
+        # makes the NUL-joined MAC/KDF input ambiguous
+        if len(a_nonce) != NONCE_LEN:
+            self._fail()
+            return
+        psk = self.endpoint.network.psk
+        mac = _psk_response(psk, a_nonce, self._c_nonce,
+                            self._raw_preamble)
+        self._out += _LEN.pack(len(mac)) + mac
+        self._keys = _derive_frame_keys(psk, a_nonce, self._c_nonce,
+                                        self._raw_preamble)
+        self._stage = self._SEND_MAC
+        self._flush_out()
+
+    # -- outcomes ------------------------------------------------------
+
+    def _succeed(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+        self._set_interest(0)  # the connection takes over the fd
+        sock = self.sock
+        if isinstance(sock, FaultSocket):
+            sock.arm_frames()  # send-fault indices count frames only
+        send_key, recv_key = self._keys
+        self.conn._dial_succeeded(self, sock, send_key, recv_key)
+
+    def _fail(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+        if self.sock is not None:
+            self._set_interest(0)
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        self.conn._dial_failed(self)
+
+    def _on_deadline(self) -> None:
+        self._fail()
+
+    def abort(self) -> None:  # loop thread (close() teardown)
+        if self._done:
+            return
+        self._done = True
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+        if self.sock is not None:
+            self._set_interest(0)
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+class _LoopHandshake:
+    """ONE staged inbound handshake on the loop thread — the
+    non-blocking mirror of ``TcpEndpoint._handshake_inbound`` with
+    the same stage order (TLS → preamble → identity → a_nonce →
+    c_nonce → MAC), the same reject-reason taxonomy, and one absolute
+    deadline for the whole exchange.  On success the socket hands off
+    to ``endpoint._admit_inbound``; on any reject the selector key is
+    dropped and the fd closed on this thread (the leak-freedom the
+    handshake tests pin)."""
+
+    _TLS, _PREAMBLE, _SEND_NONCE, _C_NONCE, _MAC = range(5)
+
+    def __init__(self, endpoint: "TcpEndpoint", sock):
+        self.endpoint = endpoint
+        self.loop = endpoint.loop
+        self.sock = sock
+        self._stage = self._PREAMBLE
+        self._rbuf = bytearray()
+        self._out = bytearray()
+        self._a_nonce: Optional[bytes] = None
+        self._c_nonce: Optional[bytes] = None
+        self._preamble: Optional[bytes] = None
+        self._remote_id: Optional[str] = None
+        self._observed_host = ""
+        self._registered = False
+        self._events = 0
+        self._done = False
+        self._deadline_timer = None
+
+    def start(self) -> None:  # loop thread
+        try:
+            self.sock.setblocking(False)
+        except OSError:
+            self._reject("socket")
+            return
+        self._deadline_timer = self.loop.call_later(
+            HANDSHAKE_TIMEOUT_S * 1000.0, self._on_deadline)
+        ctx = self.endpoint.network.ssl_server_context
+        if ctx is not None:
+            raw = self.sock
+            try:
+                self.sock = ctx.wrap_socket(
+                    raw, server_side=True, do_handshake_on_connect=False)
+            except (OSError, ValueError):
+                self.sock = raw
+                self._reject("tls")
+                return
+            self._stage = self._TLS
+            self._tls_step()
+            return
+        self._post_channel_setup()
+
+    # -- plumbing (same shape as _LoopDial's) --------------------------
+
+    def _set_interest(self, events: int) -> None:
+        if events == 0:
+            if self._registered:
+                self.loop.unregister(self.sock)
+                self._registered = False
+            self._events = 0
+            return
+        if not self._registered:
+            self.loop.register(self.sock, events, self._on_io)
+            self._registered = True
+        elif events != self._events:
+            self.loop.modify(self.sock, events, self._on_io)
+        self._events = events
+
+    def _pause(self, retry_ms: float) -> None:
+        self._set_interest(0)
+        self.loop.call_later(retry_ms, self._resume)
+
+    def _resume(self) -> None:
+        if self._done:
+            return
+        self._dispatch()
+
+    def _on_io(self, mask: int) -> None:  # loop thread
+        if self._done:
+            return
+        self._dispatch()
+
+    def _dispatch(self) -> None:
+        if self._stage == self._TLS:
+            self._tls_step()
+        elif self._stage == self._SEND_NONCE:
+            self._flush_out()
+        else:
+            self._read_step()
+
+    def _stage_reason(self) -> str:
+        if self._stage == self._TLS:
+            return "tls"
+        if self._stage == self._PREAMBLE:
+            return "preamble"
+        if self._stage == self._SEND_NONCE:
+            return "socket"
+        return "psk"
+
+    # -- stages --------------------------------------------------------
+
+    def _tls_step(self) -> None:
+        try:
+            self.sock.do_handshake()
+        except ssl.SSLWantReadError:
+            self._set_interest(selectors.EVENT_READ)
+            return
+        except ssl.SSLWantWriteError:
+            self._set_interest(selectors.EVENT_WRITE)
+            return
+        except (OSError, ValueError):
+            self._reject("tls")
+            return
+        self._post_channel_setup()
+
+    def _post_channel_setup(self) -> None:
+        network = self.endpoint.network
+        if network.fault_plan is not None:
+            # accepted links get the fault shim too (send-side faults
+            # apply wherever the serve traffic actually rides)
+            if self._registered:
+                self.loop.unregister(self.sock)
+                self._registered = False
+                self._events = 0
+            shim = FaultSocket(self.sock, network.fault_plan)
+            shim.setblocking(False)
+            self.sock = shim
+        self._stage = self._PREAMBLE
+        self._read_step()
+
+    def _read_step(self) -> None:
+        while not self._done:
+            max_bytes = (self.endpoint.MAX_PREAMBLE_BYTES
+                         if self._stage == self._PREAMBLE
+                         else MAX_AUTH_BYTES)
+            if len(self._rbuf) >= _LEN.size:
+                (length,) = _LEN.unpack_from(self._rbuf)
+                if length > max_bytes:
+                    # reject at HEADER-parse time: an unauthenticated
+                    # connection must not get to stream a claimed-
+                    # gigabyte body before the bound applies
+                    self._reject(self._stage_reason())
+                    return
+                if len(self._rbuf) >= _LEN.size + length:
+                    record = bytes(
+                        self._rbuf[_LEN.size:_LEN.size + length])
+                    del self._rbuf[:_LEN.size + length]
+                    if not self._on_record(record):
+                        return
+                    continue
+            try:
+                data = self.sock.recv(4096)  # loop-ok: non-blocking handshake recv
+            except _FaultHold as hold:
+                self._pause(hold.retry_ms)
+                return
+            except ssl.SSLWantReadError:
+                self._set_interest(selectors.EVENT_READ)
+                return
+            except ssl.SSLWantWriteError:
+                self._set_interest(selectors.EVENT_WRITE)
+                return
+            except BlockingIOError:
+                self._set_interest(selectors.EVENT_READ)
+                return
+            except OSError:
+                self._reject(self._stage_reason())
+                return
+            if not data:
+                self._reject(self._stage_reason())
+                return
+            self._rbuf += data
+
+    def _on_record(self, record: bytes) -> bool:
+        """Advance the state machine by one parsed record.  Returns
+        True to keep reading (another record expected), False when
+        the handshake finished, failed, or switched to a send
+        stage."""
+        if self._stage == self._PREAMBLE:
+            return self._on_preamble(record)
+        if self._stage == self._C_NONCE:
+            # exact-length check (see NONCE_LEN): boundary-ambiguity
+            # splice defense, same as the blocking path
+            if len(record) != NONCE_LEN:
+                log.warning("rejecting unauthenticated inbound "
+                            "claiming %r from %s", self._remote_id,
+                            self._observed_host)
+                self._reject("psk")
+                return False
+            self._c_nonce = record
+            self._stage = self._MAC
+            return True
+        # _MAC
+        psk = self.endpoint.network.psk
+        if not hmac.compare_digest(
+                record, _psk_response(psk, self._a_nonce,
+                                      self._c_nonce, self._preamble)):
+            log.warning("rejecting unauthenticated inbound claiming "
+                        "%r from %s", self._remote_id,
+                        self._observed_host)
+            self._reject("psk")
+            return False
+        keys = _derive_frame_keys(psk, self._a_nonce, self._c_nonce,
+                                  self._preamble)
+        self._admit(keys)
+        return False
+
+    def _on_preamble(self, record: bytes) -> bool:
+        endpoint = self.endpoint
+        network = endpoint.network
+        try:
+            remote_id = record.decode("utf-8")
+        except UnicodeDecodeError:
+            self._reject("preamble")
+            return False
+        self._preamble = record
+        self._remote_id = remote_id
+        claimed_host = remote_id.rsplit(":", 1)[0]
+        try:
+            observed_host = self.sock.getpeername()[0]
+        except OSError:
+            self._reject("socket")
+            return False
+        self._observed_host = observed_host
+        # identity binding (module docstring: trust model).  The
+        # resolver runs ON the loop thread: the claimed-host fast
+        # path is equality, misses hit a bounded refresh-throttled
+        # cache (TcpNetwork._host_matches), so the blocking lookup
+        # is rare and localhost-fast in every deployment this
+        # transport serves; a DNS-heavy fabric should front-load the
+        # cache or disable verify_inbound_host
+        if remote_id in endpoint.reject_inbound_ids or (
+                network.verify_inbound_host
+                and not network._host_matches(claimed_host,
+                                              observed_host)):
+            log.warning("rejecting inbound connection claiming %r "
+                        "from %s", remote_id, observed_host)
+            self._reject("identity")
+            return False
+        if network.psk is None:
+            self._admit(None)
+            return False
+        self._a_nonce = os.urandom(NONCE_LEN)
+        self._out += _LEN.pack(len(self._a_nonce)) + self._a_nonce
+        self._stage = self._SEND_NONCE
+        self._flush_out()
+        return False
+
+    def _flush_out(self) -> None:
+        while self._out:
+            try:
+                n = self.sock.send(memoryview(self._out))  # loop-ok: non-blocking handshake send
+            except _FaultHold as hold:
+                self._pause(hold.retry_ms)
+                return
+            except ssl.SSLWantWriteError:
+                self._set_interest(selectors.EVENT_WRITE)
+                return
+            except ssl.SSLWantReadError:
+                self._set_interest(selectors.EVENT_READ)
+                return
+            except BlockingIOError:
+                self._set_interest(selectors.EVENT_WRITE)
+                return
+            except OSError:
+                self._reject("socket")
+                return
+            del self._out[:n]
+        self._stage = self._C_NONCE
+        self._set_interest(selectors.EVENT_READ)
+        self._read_step()
+
+    # -- outcomes ------------------------------------------------------
+
+    def _on_deadline(self) -> None:
+        if self._done:
+            return
+        self._reject(self._stage_reason())
+
+    def _reject(self, reason: str) -> None:
+        if self._done:
+            return
+        self._done = True
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+        self._set_interest(0)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.endpoint._count("handshake_rejects", reason=reason)
+        self.endpoint._handshake_done(self)
+
+    def _admit(self, keys) -> None:
+        if self._done:
+            return
+        self._done = True
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+        self._set_interest(0)  # the connection takes over the fd
+        sock = self.sock
+        if isinstance(sock, FaultSocket):
+            sock.arm_frames()  # send-fault indices count frames only
+        conn = self.endpoint._make_connection(self._remote_id, sock)
+        if keys is not None:
+            # acceptor sends on the a2c key, verifies on c2a
+            conn.recv_key, conn.send_key = keys
+        # bytes read past the final handshake record belong to the
+        # frame stream — hand them over (select won't re-report them)
+        conn._rbuf = bytearray(self._rbuf)
+        self.endpoint._handshake_done(self)
+        self.endpoint._admit_inbound(conn)
+
+    def abort(self) -> None:  # loop thread (endpoint close)
+        if self._done:
+            return
+        self._done = True
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+        self._set_interest(0)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.endpoint._handshake_done(self)
+
+
+class _LoopEndpoint(TcpEndpoint):
+    """TcpEndpoint on the selector core: the listener, every inbound
+    handshake, and every connection's I/O multiplex on the network's
+    ONE NetLoop thread — no accept thread, no per-connection
+    writer/reader pair, no per-handshake thread.  Counter semantics,
+    admission/eviction policy, healing, and the wire protocol are the
+    base class's; only the I/O discipline differs.  The blocking
+    inherited paths (``_handshake_inbound``/``_reader_loop``) remain
+    functional for direct callers (tests drive them synchronously)."""
+
+    def __init__(self, network: "TcpNetwork", host: str):
+        #: in-flight staged handshakes (guarded by _conn_lock) so
+        #: close() can abort them — a handshake is not yet a
+        #: connection, and close()'s conn sweep would miss it
+        self._handshakes: set = set()
+        super().__init__(network, host)
+
+    def _make_connection(self, remote_id: str,
+                         sock=None) -> _LoopConnection:
+        return _LoopConnection(self, remote_id, sock)
+
+    def _begin_accept(self) -> None:
+        self._listener.setblocking(False)
+
+        def attach() -> None:
+            if not self.closed:
+                self.loop.register(self._listener,
+                                   selectors.EVENT_READ,
+                                   self._on_acceptable)
+
+        self.loop.run_soon(attach)
+
+    def _on_acceptable(self, mask: int) -> None:  # loop thread
+        while True:
+            try:
+                sock, _addr = self._listener.accept()  # loop-ok: non-blocking accept on the loop
+            except OSError:  # includes BlockingIOError: drained
+                return
+            with self._conn_lock:
+                # same flood gate as the threaded accept loop: past
+                # the cap, accepted sockets close immediately
+                admit = (not self.closed and self._pending_handshakes
+                         < self.MAX_PENDING_HANDSHAKES)
+                if admit:
+                    self._pending_handshakes += 1
+            if not admit:
+                if not self.closed:
+                    self._count("handshake_rejects", reason="flood")
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            handshake = _LoopHandshake(self, sock)
+            with self._conn_lock:
+                self._handshakes.add(handshake)
+            handshake.start()
+
+    def _handshake_done(self, handshake: _LoopHandshake) -> None:
+        with self._conn_lock:
+            self._pending_handshakes -= 1
+            self._handshakes.discard(handshake)
+
+    def _close_listener(self) -> None:
+        listener = self._listener
+
+        def tear() -> None:
+            self.loop.unregister(listener)
+            try:
+                listener.close()
+            except OSError:
+                pass
+
+        if not self.loop.run_soon(tear):
+            try:
+                listener.close()  # loop stopped: close directly
+            except OSError:
+                pass
+
+    #: graceful-close drain bound: close() gives the shared loop this
+    #: long to flush frames already committed to live/healing links
+    #: before dropping them — the threaded transport's parallel
+    #: writers usually won this race for free; one serialized loop
+    #: needs the explicit grace or a prompt close() drops frames the
+    #: caller reasonably considers sent
+    CLOSE_DRAIN_S = 0.25
+
+    def close(self) -> None:
+        was_closed = self.closed
+        if not was_closed and not self.loop.on_loop_thread():
+            deadline = time.monotonic() + self.CLOSE_DRAIN_S  # clock-ok: drain bound
+            while time.monotonic() < deadline:  # clock-ok: drain bound
+                with self._conn_lock:
+                    if self.closed:
+                        break
+                    conns = (list(self._conns.values())
+                             + list(self._extra_conns))
+                if not any(conn._flush_pending() for conn in conns
+                           if isinstance(conn, _LoopConnection)):
+                    break
+                time.sleep(0.005)  # clock-ok: close-drain poll
+        super().close()
+        if was_closed:
+            return
+        with self._conn_lock:
+            handshakes = list(self._handshakes)
+        if handshakes:
+            def abort_all() -> None:
+                for handshake in handshakes:
+                    handshake.abort()
+
+            self.loop.run_soon(abort_all)
+        # fence: the conn/handshake teardowns above are POSTED to the
+        # loop; close() returning with their fds still open would
+        # fail every zero-leak gate.  On the loop thread run_soon was
+        # synchronous and there is nothing to wait for (and waiting
+        # would deadlock the loop against itself).
+        if not self.loop.on_loop_thread():
+            fence = threading.Event()
+            if self.loop.post(fence.set):
+                fence.wait(2.0)
 
 
 class TcpNetwork:
@@ -1677,7 +3255,27 @@ class TcpNetwork:
                  ssl_server_context=None,
                  ssl_client_context=None,
                  registry: Optional[MetricsRegistry] = None,
-                 heal=None, fault_plan=None, trace=None):
+                 heal=None, fault_plan=None, trace=None,
+                 transport: str = "loop",
+                 max_connections: Optional[int] = None,
+                 max_pending_handshakes: Optional[int] = None,
+                 listen_backlog: Optional[int] = None):
+        if transport not in ("loop", "threads"):
+            raise ValueError(
+                f"transport must be 'loop' or 'threads', got {transport!r}")
+        #: I/O discipline for endpoints this network mints:
+        #: ``"loop"`` (default since 0.19) multiplexes every socket
+        #: on the network's one NetLoop thread via per-connection
+        #: state machines; ``"threads"`` keeps the pre-0.19
+        #: thread-per-connection transport (same wire protocol — the
+        #: two interoperate freely across hosts/processes).
+        self.transport = transport
+        #: per-endpoint sizing knobs for C10K deployments (a tracker
+        #: endpoint fronting 4 packs needs >256 admitted conns).
+        #: ``None`` keeps the TcpEndpoint class defaults.
+        self.max_connections = max_connections
+        self.max_pending_handshakes = max_pending_handshakes
+        self.listen_backlog = listen_backlog
         self.host = host
         self._owns_loop = loop is None
         self.loop = loop or NetLoop()
@@ -1735,6 +3333,9 @@ class TcpNetwork:
         self._resolve_window_count = 0
         self._endpoints: list = []
         self._endpoints_lock = threading.Lock()
+        # net.loop.* observability rides the network's registry
+        # (first attach wins when several networks share one loop)
+        self.loop.attach_registry(self.registry)
 
     def _host_matches(self, claimed_host: str, observed_host: str) -> bool:
         """Does the claimed listener host resolve to the observed
@@ -1794,7 +3395,8 @@ class TcpNetwork:
     def register(self, peer_id: Optional[str] = None,
                  uplink_bps: Optional[float] = None) -> TcpEndpoint:
         # uplink shaping is the OS/network's job on a real fabric
-        endpoint = TcpEndpoint(self, self.host)
+        cls = _LoopEndpoint if self.transport == "loop" else TcpEndpoint
+        endpoint = cls(self, self.host)
         with self._endpoints_lock:
             self._endpoints.append(endpoint)
         return endpoint

@@ -315,6 +315,19 @@ class NetFaultPlan:
                 if self._spec_key(spec) not in fired]
 
 
+class _FaultHold(BlockingIOError):
+    """Non-blocking shim verdict: the operation is held by an active
+    fault (injected stall / blackhole window).  The event-loop
+    transport cannot sleep the way the blocking shim does, so instead
+    of blocking it receives this exception, drops the relevant
+    selector interest, and re-arms a timer for :attr:`retry_ms` —
+    the non-blocking spelling of the blocking shim's poll tick."""
+
+    def __init__(self, msg: str, retry_ms: float):
+        super().__init__(msg)
+        self.retry_ms = retry_ms
+
+
 class FaultSocket:
     """The TCP shim: wraps a connected socket (or a ``_SafeTls``) and
     consults the plan on every operation the transport performs.
@@ -326,7 +339,17 @@ class FaultSocket:
     Frame-send faults (``rst``/``partial``/``corrupt``) apply only
     once :meth:`arm_frames` is called (post-handshake), so a plan's
     send indices count protocol frames, not handshake records.
-    """
+
+    Two I/O disciplines share this shim.  The blocking surface
+    (``recv``/``sendall``) is what the thread-per-connection transport
+    uses and is pinned byte-for-byte by tests.  The non-blocking
+    surface (``setblocking``/``send``/``stage_frame`` plus ``recv``
+    when the socket was set non-blocking) serves the event-loop
+    transport: holds become :class:`_FaultHold` (a ``BlockingIOError``
+    with a retry hint) instead of sleeps, and per-frame send faults
+    are *staged* — the loop consults :meth:`stage_frame` once per
+    framed record at flush start and enacts the verdict itself, since
+    a partial-write wedge cannot block a shared loop thread."""
 
     #: tick used by injected stalls/holds so a torn-down socket frees
     #: the blocked thread promptly
@@ -343,12 +366,21 @@ class FaultSocket:
         self._frames_armed = False
         self._timeout: Optional[float] = None
         self._closed = False
+        self._nonblocking = False
+        # one counted blackhole injection per hold EPISODE on the
+        # non-blocking path (the loop re-polls recv every retry tick;
+        # counting per call would make the counter wall-clock shaped)
+        self._hole_counted = False
 
     # -- passthrough surface ---------------------------------------------
 
     def settimeout(self, value) -> None:
         self._timeout = value
         self._sock.settimeout(value)
+
+    def setblocking(self, flag: bool) -> None:
+        self._nonblocking = not flag
+        self._sock.setblocking(flag)
 
     def getpeername(self):
         return self._sock.getpeername()
@@ -406,6 +438,18 @@ class FaultSocket:
     # -- faulted I/O -------------------------------------------------------
 
     def recv(self, n: int) -> bytes:
+        if self._nonblocking:
+            if self._stalled:
+                raise _FaultHold("injected handshake stall",
+                                 self.TICK_S * 1000.0)
+            if self._plan.in_window(BLACKHOLE, fire=False):
+                if not self._hole_counted:
+                    self._hole_counted = True
+                    self._plan.in_window(BLACKHOLE)  # count the injection
+                raise _FaultHold("blackhole window",
+                                 self.TICK_S * 1000.0)
+            self._hole_counted = False
+            return self._sock.recv(n)
         if self._stalled:
             self._stall_out()
         self._maybe_delay()
@@ -447,3 +491,54 @@ class FaultSocket:
         # (the half-open shape the idle-probe deadline exists for)
         self._tick_until(time.monotonic() + self.UNBOUNDED_STALL_S)
         raise OSError("injected partial-write stall released")
+
+    # -- non-blocking (event-loop) surface --------------------------------
+
+    def send(self, data):
+        """Non-blocking raw send for the loop transport's handshake
+        and staged-frame bytes.  Frame faults are decided up front by
+        :meth:`stage_frame`; here only the handshake-dial stall
+        applies (latency/blackhole hold the READ side instead, which
+        is what makes the handshake deadline bind)."""
+        if self._stalled:
+            raise _FaultHold("injected handshake stall",
+                             self.TICK_S * 1000.0)
+        return self._sock.send(data)
+
+    def stage_frame(self, wire, *, delayed: bool = False):
+        """Decide the fate of ONE framed record at flush start —
+        the non-blocking mirror of :meth:`sendall`'s fault order.
+        Returns a ``(verdict, arg)`` pair:
+
+        - ``("delay", ms)``: hold the frame ``ms`` then re-stage with
+          ``delayed=True`` (skips the latency check, like the blocking
+          path which sleeps first and then consults the next fault).
+        - ``("swallow", None)``: the wire never sees the record; the
+          caller accounts it as sent (MAC sequence desync downstream
+          is the point, exactly as the blocking swallow behaves).
+        - ``("send", bytes)``: flush these bytes (possibly corrupted).
+        - ``("rst", half)``: flush ``half`` then treat the link as
+          reset by peer.
+        - ``("partial", half)``: flush ``half`` then wedge the writer
+          (keep the frame queued, keep the in-flight-send stamp so the
+          idle probe is what tears the link down).
+        """
+        if not delayed:
+            extra = self._plan.extra_latency_ms()
+            if extra > 0.0:
+                return ("delay", extra)
+        if self._plan.in_window(BLACKHOLE):
+            return ("swallow", None)
+        kind = self._plan.on_send() if self._frames_armed else None
+        if kind is None:
+            return ("send", wire)
+        wire = bytes(wire)
+        if kind == CORRUPT:
+            mutated = bytearray(wire)
+            if len(mutated) > 4:
+                mutated[self._plan.corrupt_index(4, len(mutated))] ^= 0x01
+            return ("send", bytes(mutated))
+        half = wire[:max(1, len(wire) // 2)]
+        if kind == RST:
+            return ("rst", half)
+        return ("partial", half)

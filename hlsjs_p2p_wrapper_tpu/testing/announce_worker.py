@@ -1,0 +1,132 @@
+"""Standalone announce-storm worker process (bench/c10k riders).
+
+Run: ``python -m hlsjs_p2p_wrapper_tpu.testing.announce_worker
+<tracker_host:port> <announcers> <ops_each> <swarms>``
+
+Joins the fabric over real TCP and drives ``announcers`` closed-loop
+ANNOUNCE → PEERS round trips against the parent process's tracker —
+the multi-process arm of ``detail.announce_storm`` (ISSUE 19): each
+worker owns a whole CPython interpreter, so N workers escape the one
+GIL that capped the 16-thread in-process storm at 0.96× in BENCH_r13.
+
+Line protocol on the stdout pipe (parent in bench.py), routed through
+a message-only logging handler so the package stays print-free:
+
+- ``READY`` once the worker's endpoints exist (all workers rendezvous
+  before any load starts — throughput must measure concurrent load,
+  not staggered process spawns);
+- one ``RESULT {json}`` line after the storm: announce count, wall
+  seconds, and sampled RTT percentiles.
+
+The parent releases the barrier by writing one ``GO`` line to stdin.
+
+On an authenticated fabric, pass the swarm secret via the
+``P2P_SWARM_PSK`` environment variable (env, not argv: secrets must
+not appear in process lists).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+
+def _bind_protocol_handler() -> None:
+    """Route this module's log records, message-only and flushed, to
+    the stdout pipe the parent reads (seed_process.py idiom)."""
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(logging.Formatter("%(message)s"))
+    log.addHandler(handler)
+    log.setLevel(logging.INFO)
+    log.propagate = False
+
+
+def run_storm(network, tracker_id: str, announcers: int,
+              ops_each: int, swarms: int) -> dict:
+    """Drive the closed-loop storm on an existing network; shared by
+    the worker ``main`` and in-process callers (tests)."""
+    from ..engine.protocol import Announce, encode
+
+    endpoints = [network.register() for _ in range(announcers)]
+    events = []
+    for ep in endpoints:
+        ep.deliver_inline = True  # no-op on the loop transport
+        event = threading.Event()
+        ep.on_receive = lambda src, f, event=event: event.set()
+        events.append(event)
+    latencies: list = [[] for _ in range(announcers)]
+    errors: list = []
+    barrier = threading.Barrier(announcers + 1)
+
+    def announcer(i: int) -> None:
+        ep, event = endpoints[i], events[i]
+        frame = encode(Announce(f"storm-{i % swarms}", ep.peer_id))
+        try:
+            barrier.wait()
+            for _ in range(ops_each):
+                event.clear()
+                t0 = time.perf_counter()
+                if not ep.send(tracker_id, frame):
+                    raise RuntimeError("announce send refused")
+                if not event.wait(30.0):
+                    raise RuntimeError("PEERS reply timed out")
+                latencies[i].append(time.perf_counter() - t0)
+        except Exception as exc:  # fault-ok: re-raised below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=announcer, args=(i,))
+               for i in range(announcers)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    start = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - start
+    if errors:
+        raise errors[0]
+    merged = sorted(s for lane in latencies for s in lane)
+    return {
+        "announces": announcers * ops_each,
+        "wall_s": round(wall, 3),
+        "rtt_p50_us": round(merged[len(merged) // 2] * 1e6, 1),
+        "rtt_p99_us": round(merged[int(len(merged) * 0.99)] * 1e6, 1),
+    }
+
+
+def main() -> int:
+    _bind_protocol_handler()
+    tracker_id = sys.argv[1]
+    announcers, ops_each, swarms = (int(a) for a in sys.argv[2:5])
+
+    from ..engine.net import TcpNetwork
+
+    psk = os.environ.get("P2P_SWARM_PSK")
+    if psk == "":
+        log.error("RESULT %s", json.dumps(
+            {"error": "P2P_SWARM_PSK is set but empty"}))
+        return 1
+    network = TcpNetwork(psk=psk.encode() if psk else None)
+    try:
+        log.info("READY")
+        if not sys.stdin.readline().startswith("GO"):
+            return 1  # parent died before the rendezvous
+        result = run_storm(network, tracker_id, announcers,
+                           ops_each, swarms)
+        log.info("RESULT %s", json.dumps(result))
+    except Exception as exc:  # fault-ok: reported over the pipe
+        log.error("RESULT %s", json.dumps({"error": repr(exc)}))
+        return 1
+    finally:
+        network.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,304 @@
+"""Selector-loop transport coverage (ISSUE 19): the C10K-facing
+invariants layered on top of test_net.py's behavioral suite —
+resource hygiene at hundreds of sockets, partial-write resumption,
+bounded slow-reader backpressure, handshake-timeout selector hygiene,
+legacy-transport interop, the ``net.loop.*`` instrument families, and
+the lint rule that keeps thread-per-connection from creeping back."""
+
+import os
+import socket
+import sys
+import threading
+
+import pytest
+
+from hlsjs_p2p_wrapper_tpu.engine import net as net_mod
+from hlsjs_p2p_wrapper_tpu.engine.net import ReconnectPolicy, TcpNetwork
+from hlsjs_p2p_wrapper_tpu.engine.netfaults import NetFaultPlan
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+from hlsjs_p2p_wrapper_tpu.testing.fixtures import wait_for
+
+
+def count_fds():
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None  # non-procfs platform: fd assertions are skipped
+
+
+def reason_counts(registry, name, key):
+    return {labels.get(key): value for labels, value
+            in registry.series(name) if value}
+
+
+# -- resource hygiene at scale ------------------------------------------
+
+def test_loop_close_releases_200_plus_sockets_fds_and_threads():
+    """One loop multiplexing hundreds of sockets releases every fd
+    and thread at close — the C10K hygiene bound, asserted at 200+
+    registered selector keys (the thread-per-connection model would
+    need ~200 threads for the same traffic; the loop needs one)."""
+    pairs = 104
+    baseline_threads = threading.active_count()
+    baseline_fds = count_fds()
+    a, b = TcpNetwork(), TcpNetwork()
+    try:
+        senders = [a.register() for _ in range(pairs)]
+        receivers = [b.register() for _ in range(pairs)]
+        got = set()
+        lock = threading.Lock()
+        for i, ep in enumerate(receivers):
+            def on_receive(src, frame, i=i):
+                with lock:
+                    got.add(i)
+            ep.on_receive = on_receive
+        for i, (src, dst) in enumerate(zip(senders, receivers)):
+            assert src.send(dst.peer_id, b"ping-%d" % i)
+        assert wait_for(lambda: len(got) == pairs, 60.0), \
+            f"only {len(got)}/{pairs} delivered"
+        # listeners + live connections, all on ONE selector per side
+        assert a.loop.selector_size() >= 2 * pairs
+        assert b.loop.selector_size() >= 2 * pairs
+        assert threading.active_count() <= baseline_threads + 2
+    finally:
+        a.close()
+        b.close()
+    assert wait_for(lambda: threading.active_count()
+                    <= baseline_threads, 20.0)
+    if baseline_fds is not None:
+        import gc
+        assert wait_for(lambda: (gc.collect() or count_fds())
+                        <= baseline_fds + 2, 10.0), \
+            f"fds leaked: {count_fds()} vs baseline {baseline_fds}"
+
+
+# -- partial-write resumption -------------------------------------------
+
+def test_partial_write_resumes_across_flushes():
+    """A frame far larger than any socket buffer cannot leave in one
+    ``send`` — the connection must park the residue, wait for
+    EVENT_WRITE, and resume from the recorded offset until the frame
+    drains.  Integrity of the delivered bytes proves the offset
+    arithmetic; the backpressure high-water proves the queue was
+    genuinely parked."""
+    registry = MetricsRegistry()
+    a, b = TcpNetwork(registry=registry), TcpNetwork()
+    try:
+        src, dst = a.register(), b.register()
+        payload = os.urandom(8 * 1024 * 1024)
+        got = {}
+        done = threading.Event()
+        dst.on_receive = lambda s, f: (got.setdefault("frame", f),
+                                       done.set())
+        assert src.send(dst.peer_id, payload)
+        assert done.wait(30.0)
+        assert got["frame"] == payload
+        high = {labels.get("loop"): value for labels, value
+                in registry.series(
+                    "net.loop.backpressure_high_water_bytes")}
+        assert max(high.values()) >= len(payload)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_partial_write_wedge_heals_and_frames_survive(monkeypatch):
+    """A ``FaultSocket`` ``partial@`` wedge (half a frame leaves the
+    building, the socket goes silent) must not strand the queue: the
+    idle probe tears the half-open link, the redial rebuilds the
+    stream from the frame boundary, and every queued frame still
+    arrives exactly once."""
+    registry = MetricsRegistry()
+    plan = NetFaultPlan.parse("partial@0", seed=3, registry=registry)
+    heal = ReconnectPolicy(max_retries=6, backoff_base_s=0.02,
+                           backoff_cap_s=0.1, seed=3,
+                           idle_probe_s=0.3)
+    a = TcpNetwork(registry=registry, fault_plan=plan, heal=heal)
+    b = TcpNetwork(heal=ReconnectPolicy(seed=4))
+    try:
+        src, dst = a.register(), b.register()
+        got = []
+        lock = threading.Lock()
+
+        def on_receive(s, frame):
+            with lock:
+                got.append(bytes(frame))
+        dst.on_receive = on_receive
+        plan.arm()
+        frames = [b"wedged-frame-" + bytes(2_000), b"follow-up"]
+        for frame in frames:
+            assert src.send(dst.peer_id, frame)
+        assert wait_for(lambda: sorted(got) == sorted(frames), 30.0), \
+            f"delivered {len(got)}/2 after the wedge heal"
+        rec = reason_counts(registry, "net.reconnects", "reason")
+        assert rec.get("probe", 0) >= 1, rec
+        assert not plan.remaining()
+    finally:
+        a.close()
+        b.close()
+
+
+# -- slow-reader backpressure -------------------------------------------
+
+def test_slow_reader_backpressure_bounds_queue(monkeypatch):
+    """A peer that never completes its side of the conversation must
+    not grow an unbounded write queue: past ``MAX_QUEUED_FRAMES`` the
+    sender counts ``net.send_drops{reason=queue_full}`` and refuses,
+    and the queue's byte high-water stays bounded."""
+    monkeypatch.setattr(net_mod._Connection, "MAX_QUEUED_FRAMES", 64)
+    registry = MetricsRegistry()
+    # stall@0: the first handshake hangs, so every frame parks on the
+    # pending connection — the deterministic slow reader
+    plan = NetFaultPlan.parse("stall@0", seed=5, registry=registry)
+    heal = ReconnectPolicy(max_retries=1, backoff_base_s=0.05,
+                           backoff_cap_s=0.1, seed=5)
+    a = TcpNetwork(registry=registry, fault_plan=plan, heal=heal)
+    b = TcpNetwork()
+    try:
+        src, dst = a.register(), b.register()
+        plan.arm()
+        frame = b"x" * 512
+        accepted = sum(1 for _ in range(300)
+                       if src.send(dst.peer_id, frame))
+        drops = reason_counts(registry, "net.send_drops", "reason")
+        assert drops.get("queue_full", 0) >= 300 - 64 - 5, drops
+        assert accepted <= 64 + 5
+        conn = src._conns[dst.peer_id]
+        assert conn._queued_bytes <= 64 * len(frame)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- handshake timeout hygiene ------------------------------------------
+
+def test_handshake_timeout_mid_stage_leaves_no_selector_key(
+        monkeypatch):
+    """An inbound socket that goes silent mid-handshake must be fully
+    reaped at the deadline: the reject is counted, the pending-
+    handshake slot is returned, and — the loop-specific invariant —
+    no selector key survives (a stale key on a recycled fd would
+    mis-route a future connection's events)."""
+    monkeypatch.setattr(net_mod, "HANDSHAKE_TIMEOUT_S", 0.4)
+    registry = MetricsRegistry()
+    network = TcpNetwork(registry=registry)
+    raw = None
+    try:
+        ep = network.register()
+        # the listener key lands on the loop thread, not in register()
+        assert wait_for(lambda: network.loop.selector_size() == 1,
+                        5.0)
+        host, port = ep.peer_id.rsplit(":", 1)
+        raw = socket.create_connection((host, int(port)), timeout=5.0)
+        # the handshake is registered...
+        assert wait_for(lambda: network.loop.selector_size() == 2,
+                        5.0)
+        # ...and the deadline reaps it completely
+        assert wait_for(lambda: reason_counts(
+            registry, "net.handshake_rejects", "reason")
+            .get("preamble", 0) >= 1, 5.0)
+        assert wait_for(lambda: network.loop.selector_size() == 1,
+                        5.0)
+        assert wait_for(lambda: not ep._handshakes, 5.0)
+        assert ep._pending_handshakes == 0
+    finally:
+        if raw is not None:
+            raw.close()
+        network.close()
+
+
+# -- transport interop --------------------------------------------------
+
+def test_threads_and_loop_transports_interoperate():
+    """``transport="threads"`` (the legacy thread-per-connection
+    core) and the default loop core speak the same wire protocol in
+    both directions — the migration story for embedders who pin the
+    old model."""
+    a = TcpNetwork(transport="threads", psk=b"interop")
+    b = TcpNetwork(psk=b"interop")
+    assert b.transport == "loop"
+    try:
+        ea, eb = a.register(), b.register()
+        got = {}
+        ev_a, ev_b = threading.Event(), threading.Event()
+        ea.on_receive = lambda s, f: (got.setdefault("a", f),
+                                      ev_a.set())
+        eb.on_receive = lambda s, f: (got.setdefault("b", f),
+                                      ev_b.set())
+        assert ea.send(eb.peer_id, b"threads->loop")
+        assert ev_b.wait(15.0)
+        assert eb.send(ea.peer_id, b"loop->threads")
+        assert ev_a.wait(15.0)
+        assert got == {"b": b"threads->loop",
+                       "a": b"loop->threads"}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_unknown_transport_rejected():
+    with pytest.raises(ValueError):
+        TcpNetwork(transport="fibers")
+
+
+# -- net.loop.* instrument families -------------------------------------
+
+def test_net_loop_metric_families_emitted():
+    registry = MetricsRegistry()
+    a, b = TcpNetwork(registry=registry), TcpNetwork()
+    try:
+        src, dst = a.register(), b.register()
+        done = threading.Event()
+        dst.on_receive = lambda s, f: done.set()
+        assert src.send(dst.peer_id, b"traffic")
+        assert done.wait(15.0)
+        families = {name.split("{")[0]
+                    for name, _value in registry.snapshot().items()}
+        for family in ("net.loop.sockets", "net.loop.iteration_ms",
+                       "net.loop.stalls",
+                       "net.loop.backpressure_high_water_bytes"):
+            assert family in families, sorted(families)
+        sockets = {labels.get("loop"): value for labels, value
+                   in registry.series("net.loop.sockets")}
+        assert max(sockets.values()) >= 2  # listener + live conn
+    finally:
+        a.close()
+        b.close()
+
+
+# -- lint: the event-loop discipline ------------------------------------
+
+def test_net_loop_lint_rule(tmp_path):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import lint as lint_tool
+
+    bad = tmp_path / "bad_net.py"
+    bad.write_text(
+        "import threading\n"
+        "from threading import Thread\n"
+        "def serve(sock):\n"
+        "    conn, _ = sock.accept()\n"
+        "    data = conn.recv(4096)\n"
+        "    conn.sendall(data)\n"
+        "    threading.Thread(target=serve).start()\n"
+        "    Thread(target=serve).start()\n")
+    findings = lint_tool.check_net_loop_discipline(str(bad))
+    assert len(findings) == 5
+    assert all("loop-ok" in f for f in findings)
+
+    good = tmp_path / "good_net.py"
+    good.write_text(
+        '"""Docstring mentioning .recv( and .accept( is not code."""\n'
+        "import threading\n"
+        "def on_readable(sock):\n"
+        "    data = sock.recv(65536)  # loop-ok: non-blocking on the loop\n"
+        "    return data\n"
+        "def legacy(sock):\n"
+        "    sock.sendall(b'x')  # loop-ok: legacy threads transport\n"
+        "    threading.Thread(target=legacy).start()  # loop-ok: legacy\n"
+        "def unrelated(queue):\n"
+        "    queue.accept_all()\n"  # not a socket .accept( call
+        "    return queue.received\n")
+    assert lint_tool.check_net_loop_discipline(str(good)) == []

@@ -1,0 +1,364 @@
+"""C10K gate: ≥1,000 REAL peers on one host against one tracker —
+selector-loop transport core + multi-process agent packs (ISSUE 19).
+
+The thread-per-connection transport capped the real plane at tens of
+peers: BENCH_r13 ``detail.announce_storm`` measured 0.96× for 16
+threads vs a serialized loop — the GIL, not the tracker, was the
+ceiling.  This gate proves the two-part answer end to end:
+
+1. **loop core** — the parent's tracker endpoint multiplexes every
+   pack's sockets on one selector loop (``max_connections=4096``);
+2. **agent packs** — ≥4 worker processes (``tools/c10k_pack.py``),
+   each running 256 full agents, coordinated through the PR 6 fabric
+   (:class:`~hlsjs_p2p_wrapper_tpu.engine.fabric.WorkLedger` manifest
+   + leases + first-done-wins finalize), each writing one PR 16
+   binary flight-recorder shard.
+
+Asserted:
+
+- every fabric unit finalized, by ≥``C10K_PACKS`` distinct packs;
+- ≥1,000 distinct live peers (real listening sockets — the pack
+  reports its agents' host:port ids, all distinct across packs), and
+  the tracker's own announce counter corroborates from the other side
+  of the wire;
+- every foreground fetch completed under the injected chaos window
+  (CDN failover is a success path), zero failures;
+- zero fd / thread / PeerState leaks in every pack AND in the parent;
+- same-seed determinism: each unit's fired fault schedule equals the
+  parent's re-derivation from ``unit_seed`` alone;
+- pack shards ingest through the binary codec
+  (:func:`~hlsjs_p2p_wrapper_tpu.engine.tracer.read_shard`);
+- the multi-process announce storm beats the serialized loop ≥3×
+  when the host has ≥4 cores (on smaller hosts the measured ratio is
+  printed with a waiver — the GIL-escape speedup is core-bound).
+
+Run: ``python tools/c10k_gate.py`` (exit 1 on any violation);
+``make c10k-gate`` wires it into ``make check``.  Scale knobs:
+``C10K_PACKS`` / ``C10K_PEERS_PER_PACK`` / ``C10K_GROUPS``.
+"""
+
+import gc
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from c10k_pack import SCHEDULE_DEFAULT, unit_seed  # noqa: E402
+
+from hlsjs_p2p_wrapper_tpu.engine.net import TcpNetwork  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.engine.netfaults import NetFaultPlan  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.engine.tracer import (read_shard,  # noqa: E402
+                                                 shard_paths)
+from hlsjs_p2p_wrapper_tpu.engine.tracker import (Tracker,  # noqa: E402
+                                                  TrackerEndpoint)
+from hlsjs_p2p_wrapper_tpu.testing.announce_worker import run_storm  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.testing.fixtures import wait_for  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKS = int(os.environ.get("C10K_PACKS", "4"))
+PEERS_PER_PACK = int(os.environ.get("C10K_PEERS_PER_PACK", "256"))
+GROUPS = int(os.environ.get("C10K_GROUPS", "8"))
+SEED = int(os.environ.get("C10K_SEED", "7"))
+SCHEDULE = os.environ.get("C10K_SCHEDULE", SCHEDULE_DEFAULT)
+PSK = b"c10k-gate"
+PACK_TIMEOUT_S = float(os.environ.get("C10K_PACK_TIMEOUT_S", "900"))
+#: the ISSUE 19 payoff number — and the waiver floor: a ≥3× GIL
+#: escape needs ≥4 cores to exist, so smaller hosts print the
+#: measured ratio instead of failing on physics
+STORM_SPEEDUP_FLOOR = 3.0
+STORM_OPS = int(os.environ.get("C10K_STORM_OPS", "400"))
+STORM_PROCS = int(os.environ.get("C10K_STORM_PROCS", "4"))
+STORM_ANNOUNCERS = int(os.environ.get("C10K_STORM_ANNOUNCERS", "4"))
+
+CHECKS = []
+
+
+def check(ok, what):
+    CHECKS.append((bool(ok), what))
+    print(f"  [{'ok ' if ok else 'FAIL'}] {what}")
+
+
+def count_fds():
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+def spawn_pack(i, fabric_dir, tracker_id):
+    env = dict(os.environ,
+               PYTHONPATH=REPO,
+               P2P_SWARM_PSK=PSK.decode(),
+               C10K_TRACKER=tracker_id,
+               C10K_PACK_ID=f"pack{i}",
+               C10K_SEED=str(SEED),
+               C10K_UNITS=str(PACKS),
+               C10K_PEERS_PER_UNIT=str(PEERS_PER_PACK),
+               C10K_GROUPS=str(GROUPS),
+               C10K_SCHEDULE=SCHEDULE)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "c10k_pack.py"),
+         fabric_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env)
+    lines = []
+
+    def drain():  # pipe-full deadlock guard: drain continuously
+        for line in proc.stdout:
+            lines.append(line.rstrip("\n"))
+
+    thread = threading.Thread(target=drain, daemon=True)
+    thread.start()
+    return proc, thread, lines
+
+
+def pack_result(lines):
+    for line in reversed(lines):
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    return None
+
+
+def announce_storm(tracker_ep, tracker):
+    """Compact multi-process vs serialized A/B on the live tracker —
+    the gate-local version of bench.py ``detail.announce_storm``."""
+    base = tracker.announce_count
+    # serialized loop: ONE closed-loop announcer, no concurrency
+    network = TcpNetwork(psk=PSK)
+    try:
+        serial = run_storm(network, tracker_ep.peer_id, 1,
+                           STORM_OPS, 8)
+    finally:
+        network.close()
+    serial_rate = serial["announces"] / serial["wall_s"]
+
+    procs = []
+    env = dict(os.environ, PYTHONPATH=REPO,
+               P2P_SWARM_PSK=PSK.decode())
+    for _ in range(STORM_PROCS):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m",
+             "hlsjs_p2p_wrapper_tpu.testing.announce_worker",
+             tracker_ep.peer_id, str(STORM_ANNOUNCERS),
+             str(STORM_OPS // STORM_ANNOUNCERS), "8"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, env=env))
+    try:
+        for proc in procs:
+            ready = proc.stdout.readline()
+            assert ready.startswith("READY"), ready
+        for proc in procs:
+            proc.stdin.write("GO\n")
+            proc.stdin.flush()
+        results = []
+        for proc in procs:
+            line = proc.stdout.readline()
+            assert line.startswith("RESULT "), line
+            payload = json.loads(line[len("RESULT "):])
+            assert "error" not in payload, payload
+            results.append(payload)
+    finally:
+        for proc in procs:
+            try:
+                proc.stdin.close()
+            except OSError:
+                pass
+            proc.wait(timeout=15.0)
+            proc.stdout.close()
+    multi_total = sum(r["announces"] for r in results)
+    multi_rate = multi_total / max(r["wall_s"] for r in results)
+    return {
+        "serialized_per_s": round(serial_rate, 1),
+        "multiproc_per_s": round(multi_rate, 1),
+        "multiproc_procs": STORM_PROCS,
+        "speedup": round(multi_rate / serial_rate, 2),
+        "host_cores": os.cpu_count() or 1,
+        "tracker_announces": tracker.announce_count - base,
+    }
+
+
+def main() -> int:
+    gc.collect()
+    baseline_threads = threading.active_count()
+    baseline_fds = count_fds()
+    total_peers = PACKS * PEERS_PER_PACK
+    print(f"c10k-gate: {PACKS} packs x {PEERS_PER_PACK} peers "
+          f"(seed {SEED}, schedule {SCHEDULE!r})")
+
+    registry = MetricsRegistry()
+    network = TcpNetwork(psk=PSK, registry=registry,
+                         max_connections=4_096,
+                         max_pending_handshakes=512,
+                         listen_backlog=1_024)
+    tracker = Tracker(network.loop, registry=registry)
+    # deployment-tunable quotas: every peer in this gate shares host
+    # 127.0.0.1, so the per-source (per-HOST) defaults sized for one
+    # NAT'd audience must admit the whole fleet
+    tracker.MAX_MEMBERS_PER_SOURCE = 4 * total_peers
+    tracker.MAX_SWARM_CREATES_PER_SOURCE = 4 * PACKS * GROUPS
+    tracker_ep = network.register()
+    TrackerEndpoint(tracker, tracker_ep, concurrent=True)
+    fabric_dir = tempfile.mkdtemp(prefix="c10k-fabric-")
+    os.makedirs(os.path.join(fabric_dir, "trace"), exist_ok=True)
+
+    results = []
+    try:
+        t0 = time.monotonic()
+        packs = [spawn_pack(i, fabric_dir, tracker_ep.peer_id)
+                 for i in range(PACKS)]
+        deadline = time.monotonic() + PACK_TIMEOUT_S
+        for proc, thread, lines in packs:
+            try:
+                proc.wait(timeout=max(1.0,
+                                      deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            thread.join(timeout=5.0)
+            proc.stdout.close()
+            results.append(pack_result(lines))
+            if results[-1] is None:
+                tail = "\n".join(lines[-15:])
+                print(f"-- pack with no RESULT; tail:\n{tail}",
+                      file=sys.stderr)
+        wall = time.monotonic() - t0
+        print(f"  packs done in {wall:.1f}s")
+
+        check(all(r is not None for r in results)
+              and not any(r.get("error") for r in results if r),
+              "every pack exited with a clean RESULT "
+              + str([r.get("error") for r in results if r
+                     and r.get("error")]))
+        results = [r for r in results if r]
+
+        # ---- fabric: every unit finalized, work actually spread ----
+        finalized = {u for r in results for u in r["finalized"]}
+        finalizing_packs = {r["pack"] for r in results
+                            if r["finalized"]}
+        check(finalized == set(range(PACKS)),
+              f"all {PACKS} fabric units finalized ({sorted(finalized)})")
+        check(len(finalizing_packs) >= PACKS,
+              f"{len(finalizing_packs)} distinct packs finalized work "
+              f"(need {PACKS})")
+
+        # ---- the C10K claim: distinct real peers -------------------
+        all_ids = [pid for r in results for u in r["units"]
+                   for pid in u["peer_ids"]]
+        distinct = set(all_ids)
+        # the floor follows the scale knobs so smoke runs stay
+        # meaningful; at the default 4×256 it is the ISSUE 19 1,000
+        floor = min(1_000, total_peers)
+        check(len(distinct) >= floor,
+              f"{len(distinct)} distinct real peers (floor {floor})")
+        check(len(distinct) == len(all_ids),
+              "every peer id unique across packs (real listeners)")
+        check(tracker.announce_count >= len(distinct),
+              f"tracker corroborates from the wire side: "
+              f"{tracker.announce_count} announces >= {len(distinct)}")
+
+        # ---- playback under chaos ----------------------------------
+        fetches = sum(u["fetches"] for r in results
+                      for u in r["units"])
+        fails = sum(u["fails"] for r in results for u in r["units"])
+        check(fetches == total_peers and fails == 0,
+              f"all fetches completed under chaos "
+              f"({fetches}/{total_peers}, {fails} failures)")
+        p2p = sum(u["p2p"] for r in results for u in r["units"])
+        cdn = sum(u["cdn"] for r in results for u in r["units"])
+        check(p2p > 0, f"swarms genuinely exchanged p2p "
+                       f"(p2p={p2p} cdn={cdn})")
+
+        # ---- chaos determinism: the fired schedule equals the plan
+        # the parent re-derives from the unit seed alone (a fresh
+        # plan's remaining() IS its full spec set; schedule() lists
+        # what fired)
+        for r in results:
+            for u in r["units"]:
+                expect = sorted(NetFaultPlan.parse(
+                    SCHEDULE, seed=unit_seed(SEED, u["unit"]))
+                    .remaining())
+                check(not u["never_fired"] and u["fired"] == expect,
+                      f"unit {u['unit']} fault schedule fired fully & "
+                      f"deterministically ({u['fired']})")
+
+        # ---- leaks: every pack AND the parent ----------------------
+        check(all(r["threads_clean"] and r["fds_clean"]
+                  for r in results),
+              "every pack returned to fd/thread baselines "
+              + str([(r["pack"], r.get("threads"), r.get("fds"))
+                     for r in results]))
+        check(all(u["peer_states_clean"] and u["ghosts"] == 0
+                  for r in results for u in r["units"]),
+              "zero PeerState leaks / ghosts in every pack")
+
+        # ---- shard ingest through the PR 16 binary codec -----------
+        shards = shard_paths(os.path.join(fabric_dir, "trace"))
+        events = 0
+        t0 = time.perf_counter()
+        for path in shards:
+            _meta, shard_events = read_shard(path)
+            events += len(shard_events)
+        ingest_s = time.perf_counter() - t0
+        rate = events / ingest_s if ingest_s > 0 else float("inf")
+        check(len(shards) == PACKS and events > 0,
+              f"{len(shards)} pack shards ingested: {events} events "
+              f"at {rate:,.0f}/s")
+
+        # ---- the tracker endpoint drains once packs exit -----------
+        check(wait_for(lambda: not tracker_ep._conns, 20.0),
+              "tracker endpoint connections drained after packs exit")
+
+        # ---- multi-process announce storm vs serialized loop -------
+        storm = announce_storm(tracker_ep, tracker)
+        print(f"  announce_storm: {storm}")
+        if storm["host_cores"] >= 4:
+            check(storm["speedup"] >= STORM_SPEEDUP_FLOOR,
+                  f"multi-process storm {storm['speedup']}x serialized "
+                  f"(floor {STORM_SPEEDUP_FLOOR}x, "
+                  f"{storm['host_cores']} cores)")
+        else:
+            check(True,
+                  f"storm speedup {storm['speedup']}x measured; "
+                  f"{STORM_SPEEDUP_FLOOR}x floor waived on a "
+                  f"{storm['host_cores']}-core host (GIL escape is "
+                  f"core-bound)")
+        check(storm["tracker_announces"]
+              >= STORM_OPS + STORM_PROCS * STORM_ANNOUNCERS
+              * (STORM_OPS // STORM_ANNOUNCERS),
+              "tracker counted every storm announce")
+    finally:
+        network.close()
+        shutil.rmtree(fabric_dir, ignore_errors=True)
+
+    check(wait_for(lambda: threading.active_count()
+                   <= baseline_threads + 1, 20.0),
+          f"parent threads back to baseline "
+          f"({threading.active_count()} vs {baseline_threads})")
+    gc.collect()
+    if baseline_fds is not None:
+        ok = wait_for(lambda: (gc.collect() or count_fds())
+                      <= baseline_fds + 2, 10.0)
+        check(ok, f"parent fds back to baseline ({count_fds()} vs "
+                  f"{baseline_fds})")
+
+    failed = [what for ok, what in CHECKS if not ok]
+    print(f"c10k-gate: {len(CHECKS) - len(failed)}/{len(CHECKS)} "
+          f"checks passed")
+    if failed:
+        for what in failed:
+            print(f"c10k-gate FAILED: {what}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

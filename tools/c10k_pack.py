@@ -1,0 +1,253 @@
+"""C10K agent pack — one worker process of ``make c10k-gate``.
+
+A pack is a whole CPython interpreter running hundreds of REAL peers
+on the selector-loop transport (ISSUE 19): each peer is a full
+:class:`~hlsjs_p2p_wrapper_tpu.engine.p2p_agent.P2PAgent` with its
+own listening socket, PSK handshake, announce loop, and mesh — not a
+mock.  N packs escape the one GIL that capped the thread-per-
+connection transport at 0.96× (BENCH_r13 ``detail.announce_storm``),
+which is the entire point of the multi-process plane.
+
+Coordination is the PR 6 fabric, not argv assignments: the parent
+gate publishes a unit manifest ("run 256 peers against this tracker")
+into a shared fabric directory and every pack claims work through
+:class:`~hlsjs_p2p_wrapper_tpu.engine.fabric.WorkLedger` — leases,
+heartbeats, first-done-wins finalize — exactly like a real fleet
+host.  Each pack writes one binary flight-recorder shard (PR 16
+codec) that the parent ingests at fleet rate.
+
+A claimed unit runs ``C10K_PEERS_PER_UNIT`` agents split into
+``C10K_GROUPS`` swarms (1 seeder + followers each, distinct
+``content_id`` per group), under a per-unit-seeded
+:class:`~hlsjs_p2p_wrapper_tpu.engine.netfaults.NetFaultPlan` chaos
+window.  Every foreground fetch must complete (CDN failover is a
+success path); the fired fault schedule is reported so the parent can
+re-derive it from the seed and assert determinism.
+
+Protocol: one ``RESULT {json}`` line on stdout at exit.  The swarm
+secret arrives via ``P2P_SWARM_PSK`` (env, not argv: secrets must not
+appear in process lists).
+
+Run only via ``tools/c10k_gate.py``; standalone:
+``python tools/c10k_pack.py <fabric_dir>`` with the ``C10K_*`` env.
+"""
+
+import gc
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from hlsjs_p2p_wrapper_tpu.core.segment_view import SegmentView  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.core.track_view import TrackView  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.engine.fabric import WAIT, WorkLedger  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.engine.net import (ReconnectPolicy,  # noqa: E402
+                                              TcpNetwork)
+from hlsjs_p2p_wrapper_tpu.engine.netfaults import NetFaultPlan  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.engine.p2p_agent import P2PAgent  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.engine.tracer import FlightRecorder  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.testing.fixtures import wait_for  # noqa: E402
+from hlsjs_p2p_wrapper_tpu.testing.seed_process import (  # noqa: E402
+    InstantCdn, NullBridge, NullMediaMap)
+
+#: per-unit chaos schedule — op-indexed faults land on live announce
+#: and fetch traffic (hundreds of ops/s per pack), the latency window
+#: on the early fetch rounds.  Shared with the parent gate, which
+#: re-derives the fired schedule from the seed for the determinism
+#: assertion.
+SCHEDULE_DEFAULT = "rst@40,corrupt@120,latency@2-5"
+SEGMENT_BYTES = 20_000
+FETCH_DEADLINE_S = 30.0
+#: bounded discovery wait before a follower's fetch — a miss is NOT a
+#: failure (the fetch falls back to the instant CDN, a success path)
+HOLDERS_WAIT_S = 6.0
+
+
+def unit_seed(seed: int, unit: int) -> int:
+    """The per-unit fault seed — one formula, imported by the parent
+    gate so determinism is asserted against the same derivation."""
+    return seed * 1_000 + unit + 1
+
+
+def sv(sn):
+    return SegmentView(sn=sn, track_view=TrackView(level=0, url_id=0),
+                       time=sn * 10.0)
+
+
+def count_fds():
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+def make_agent(network, tracker_peer_id, registry, content_id):
+    return P2PAgent(
+        NullBridge(), "http://cdn.example/master.m3u8", NullMediaMap(),
+        {"network": network, "clock": network.loop,
+         "cdn_transport": InstantCdn(SEGMENT_BYTES),
+         "tracker_peer_id": tracker_peer_id,
+         "content_id": content_id,
+         "announce_interval_ms": 8_000.0,
+         "request_timeout_ms": 2_000.0,
+         "p2p_budget_cap_ms": 4_000.0,
+         "metrics_registry": registry},
+        SegmentView, "hls", "v2")
+
+
+def fetch(agent, sn):
+    done = threading.Event()
+    result = {}
+    agent.get_segment(
+        {"url": f"http://cdn.example/seg{sn}.ts", "headers": {}},
+        {"on_success": lambda d: (result.setdefault("data", d),
+                                  done.set()),
+         "on_error": lambda e: (result.setdefault("err", e),
+                                done.set()),
+         "on_progress": lambda e: None}, sv(sn))
+    return done.wait(FETCH_DEADLINE_S) and "data" in result
+
+
+def run_unit(ledger, unit, recorder, tracker_id, psk, seed, peers,
+             groups, schedule):
+    """One claimed unit: ``peers`` live agents in ``groups`` swarms,
+    every swarm fetching through the chaos window."""
+    registry = MetricsRegistry()
+    recorder.attach(registry)
+    useed = unit_seed(seed, unit.unit)
+    plan = NetFaultPlan.parse(schedule, seed=useed, registry=registry,
+                              latency_ms=250.0)
+    heal = ReconnectPolicy(max_retries=6, backoff_base_s=0.02,
+                           backoff_cap_s=0.25, seed=useed,
+                           idle_probe_s=2.0, circuit_threshold=8,
+                           circuit_cooldown_s=2.0)
+    network = TcpNetwork(psk=psk, registry=registry, fault_plan=plan,
+                         heal=heal)
+    group_size = peers // groups
+    agents = []
+    fetches = fails = 0
+    recorder.mark("unit_start", unit=unit.unit, peers=peers,
+                  groups=groups)
+    try:
+        swarms = []
+        for g in range(groups):
+            content = f"c10k-u{unit.unit}-g{g}"
+            members = [make_agent(network, tracker_id, registry,
+                                  content) for _ in range(group_size)]
+            agents.extend(members)
+            swarms.append(members)
+        peer_ids = [a.peer_id for a in agents]
+        plan.arm()
+        for g, members in enumerate(swarms):
+            seeder, followers = members[0], members[1:]
+            ok = fetch(seeder, g)  # primes the swarm (instant CDN)
+            fetches += 1
+            fails += 0 if ok else 1
+            key = sv(g).to_bytes()
+            for i, follower in enumerate(followers):
+                wait_for(lambda f=follower: f.mesh.holders_of(key),
+                         HOLDERS_WAIT_S)
+                ok = fetch(follower, g)
+                fetches += 1
+                fails += 0 if ok else 1
+                if i % 8 == 7:  # lease must outlive a slow group
+                    ledger.heartbeat(unit)
+            ledger.heartbeat(unit)
+            print(f"PROGRESS unit={unit.unit} group={g} "
+                  f"fetches={fetches} fails={fails}", flush=True)
+        # every planned fault must have fired on live traffic — the
+        # op-indexed ones landed during the fetch rounds; idle out
+        # the window tail if the rounds beat the horizon
+        wait_for(lambda: not plan.remaining(),
+                 plan.window_horizon_s() + 20.0)
+        p2p = sum(a.stats["p2p"] for a in agents)
+        cdn = sum(a.stats["cdn"] for a in agents)
+        ghosts = sum(1 for a in agents for pid in a.mesh.peers
+                     if pid not in set(peer_ids))
+    finally:
+        for agent in agents:
+            agent.dispose()
+        network.close()
+    peer_states_clean = all(a.mesh.peers == {} for a in agents)
+    recorder.mark("unit_done", unit=unit.unit, fetches=fetches,
+                  fails=fails)
+    recorder.flush()
+    return {
+        "unit": unit.unit,
+        "peers": len(peer_ids),
+        "peer_ids": peer_ids,
+        "fetches": fetches,
+        "fails": fails,
+        "p2p": p2p,
+        "cdn": cdn,
+        "ghosts": ghosts,
+        "peer_states_clean": peer_states_clean,
+        "fired": sorted(plan.schedule()),
+        "never_fired": sorted(plan.remaining()),
+    }
+
+
+def main() -> int:
+    fabric_dir = sys.argv[1]
+    pack_id = os.environ["C10K_PACK_ID"]
+    tracker_id = os.environ["C10K_TRACKER"]
+    seed = int(os.environ.get("C10K_SEED", "7"))
+    units = int(os.environ.get("C10K_UNITS", "4"))
+    peers = int(os.environ.get("C10K_PEERS_PER_UNIT", "256"))
+    groups = int(os.environ.get("C10K_GROUPS", "8"))
+    schedule = os.environ.get("C10K_SCHEDULE", SCHEDULE_DEFAULT)
+    psk_env = os.environ.get("P2P_SWARM_PSK")
+    psk = psk_env.encode() if psk_env else None
+
+    gc.collect()
+    baseline_threads = threading.active_count()
+    baseline_fds = count_fds()
+
+    result = {"pack": pack_id, "units": [], "finalized": []}
+    ledger = WorkLedger(fabric_dir, {"kind": "c10k", "seed": seed},
+                        pack_id, lease_s=600.0)
+    ledger.ensure_manifest([units], [1])
+    recorder = FlightRecorder(
+        os.path.join(fabric_dir, "trace"), pack_id, binary=True,
+        counter_filter=lambda name: name.startswith(
+            ("net.", "mesh.", "tracker")))
+    try:
+        while True:
+            unit = ledger.next_unit()
+            if unit is WAIT:
+                time.sleep(0.2)
+                continue
+            if unit is None:
+                break
+            unit_result = run_unit(ledger, unit, recorder, tracker_id,
+                                   psk, seed, peers, groups, schedule)
+            if ledger.finalize(unit, unit_result["fetches"]):
+                result["finalized"].append(unit.unit)
+            result["units"].append(unit_result)
+    except Exception as exc:  # fault-ok: reported over the pipe
+        result["error"] = repr(exc)
+    finally:
+        recorder.close()
+
+    gc.collect()
+    result["threads_clean"] = wait_for(
+        lambda: threading.active_count() <= baseline_threads + 1, 20.0)
+    result["threads"] = [threading.active_count(), baseline_threads]
+    if baseline_fds is None:
+        result["fds_clean"] = True
+    else:
+        result["fds_clean"] = wait_for(
+            lambda: (gc.collect() or count_fds()) <= baseline_fds + 2,
+            10.0)
+        result["fds"] = [count_fds(), baseline_fds]
+    print("RESULT " + json.dumps(result), flush=True)
+    return 1 if result.get("error") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,11 @@ refresh:
   metric's max relative error between the sim and real planes with
   the worst window's index and clock (engine/twinframe.py
   ``frame_errors``): where the digital twin diverges, at a glance;
+- **net panel** (``--net``) — per host, the real-plane transport's
+  self-heal counters (``net.reconnects``/``net.send_drops`` by
+  reason, MAC drops, circuit-breaker transitions, handshake rejects)
+  and selector-loop stalls from the ``--trace`` event stream: the
+  post-mortem view of a ``tools/c10k_gate.py`` agent-pack run;
 - **SLO panel** (``--slo``, from the trace stream's
   ``slo_window``/``slo_alert`` marks, engine/slo.py) — per
   objective: current fast/slow burn rates, error budget remaining,
@@ -325,8 +330,70 @@ def slo_panel(events) -> list:
     return lines
 
 
+def net_panel(events) -> list:
+    """Real-plane transport panel from a merged event stream: per
+    host, the ``net.*`` self-heal counters (reconnects and send drops
+    by reason, MAC drops, circuit-breaker transitions, handshake
+    rejects) plus the selector-loop health counters
+    (``net.loop.stalls`` — a callback hogging the loop).  Agent packs
+    (tools/c10k_pack.py) attach their registries to the flight
+    recorder, so this is the post-mortem / live view of a C10K run.
+    Degrades to one explanatory line on artifacts from runs without a
+    real transport — the ``--control`` pattern."""
+    hosts = {}
+    for event in events:
+        if event.get("kind") != "counter":
+            continue
+        name = str(event.get("name", ""))
+        if not name.startswith("net."):
+            continue
+        host = hosts.setdefault(event.get("host", "?"), {})
+        labels = parse_labels(event.get("labels", ""))
+        n = int(event.get("n", 1))
+        if name == "net.reconnects":
+            key = ("reconnects", labels.get("reason", "?"))
+        elif name == "net.send_drops":
+            key = ("drops", labels.get("reason", "?"))
+        elif name == "net.circuit":
+            key = ("circuit", labels.get("state", "?"))
+        elif name == "net.mac_drops":
+            key = ("mac_drops", None)
+        elif name == "net.handshake_rejects":
+            key = ("rejects", labels.get("reason", "?"))
+        elif name == "net.loop.stalls":
+            key = ("loop_stalls", None)
+        else:
+            key = (name[len("net."):], None)
+        host[key] = host.get(key, 0) + n
+    if not hosts:
+        return ["net: no net.* events in trace (run without a real "
+                "transport — nothing to show)"]
+    lines = ["net plane:"]
+
+    def fold(host, family):
+        pairs = sorted((reason, v) for (fam, reason), v
+                       in host.items() if fam == family)
+        if not pairs:
+            return "0"
+        if pairs == [(None, pairs[0][1])]:
+            return str(pairs[0][1])
+        return ",".join(f"{reason}={v}" for reason, v in pairs)
+
+    for name in sorted(hosts):
+        host = hosts[name]
+        lines.append(
+            f"  {name}: reconnects {fold(host, 'reconnects')}; "
+            f"drops {fold(host, 'drops')}; "
+            f"mac {fold(host, 'mac_drops')}; "
+            f"circuit {fold(host, 'circuit')}; "
+            f"rejects {fold(host, 'rejects')}; "
+            f"loop stalls {fold(host, 'loop_stalls')}")
+    return lines
+
+
 def render_frame(fabric_dir=None, trace_dir=None, now=None,
-                 twin_path=None, control=False, slo=False) -> str:
+                 twin_path=None, control=False, slo=False,
+                 net=False) -> str:
     """One console frame as text (the testable surface)."""
     now = time.time() if now is None else now
     lines = []
@@ -401,6 +468,8 @@ def render_frame(fabric_dir=None, trace_dir=None, now=None,
         lines.extend(control_panel(trace_events))
     if slo:
         lines.extend(slo_panel(trace_events))
+    if net:
+        lines.extend(net_panel(trace_events))
     if not lines:
         lines.append("nothing to watch (pass --fabric, --trace "
                      "and/or --twin)")
@@ -429,6 +498,12 @@ def main(argv=None) -> int:
                          "worst shard/cohort of the last alert) "
                          "from the --trace event stream's "
                          "slo_window/slo_alert marks")
+    ap.add_argument("--net", action="store_true",
+                    help="add the real-plane transport panel (per "
+                         "host: net.* reconnect/drop/MAC/circuit "
+                         "counters and selector-loop stalls) from "
+                         "the --trace event stream — the C10K agent-"
+                         "pack post-mortem view")
     ap.add_argument("--follow", action="store_true",
                     help="refresh continuously (default: one "
                          "post-mortem frame)")
@@ -446,7 +521,8 @@ def main(argv=None) -> int:
     while True:
         print(render_frame(args.fabric, args.trace,
                            twin_path=args.twin,
-                           control=args.control, slo=args.slo))
+                           control=args.control, slo=args.slo,
+                           net=args.net))
         frames += 1
         if not args.follow or (args.max_frames
                                and frames >= args.max_frames):

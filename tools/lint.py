@@ -414,6 +414,65 @@ def check_traffic_discipline(path):
     return findings
 
 
+#: the selector-loop transport (the C10K round): engine/net.py's hot
+#: path is ONE event loop multiplexing hundreds of non-blocking
+#: sockets — a blocking ``.recv(``/``.sendall(``/``.accept(`` or a
+#: naked per-connection ``threading.Thread(`` is exactly how the
+#: thread-per-connection model (GIL-capped at 0.96× in BENCH_r13)
+#: would silently creep back.  Every such call needs an inline
+#: ``# loop-ok: <why>`` (non-blocking calls ON the loop, the legacy
+#: ``transport="threads"`` compatibility path, and THE loop thread
+#: itself are the legitimate sites).
+NET_LOOP_FILE = (
+    os.path.join("hlsjs_p2p_wrapper_tpu", "engine", "net.py"),)
+
+_BLOCKING_SOCKET_ATTRS = ("recv", "sendall", "accept")
+
+
+def check_net_loop_discipline(path):
+    """Event-loop discipline for the real transport: blocking socket
+    primitives and per-connection threads in engine/net.py require an
+    inline ``# loop-ok: <why>`` justification.  AST-matched (no
+    docstring false positives): any ``x.recv(...)`` /
+    ``x.sendall(...)`` / ``x.accept(...)`` call, plus any
+    ``threading.Thread(...)`` / bare ``Thread(...)`` construction."""
+    findings = []
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []  # check_file already reports the syntax error
+    lines = source.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        what = None
+        if (isinstance(func, ast.Attribute)
+                and func.attr in _BLOCKING_SOCKET_ATTRS):
+            what = f".{func.attr}("
+        elif (isinstance(func, ast.Attribute)
+                and func.attr == "Thread"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "threading") \
+                or (isinstance(func, ast.Name)
+                    and func.id == "Thread"):
+            what = "threading.Thread("
+        if what is None:
+            continue
+        if "# loop-ok:" in lines[node.lineno - 1]:
+            continue
+        findings.append(
+            f"{path}:{node.lineno}: {what} in the selector-loop "
+            f"transport without justification — blocking socket "
+            f"calls and per-connection threads are how the "
+            f"GIL-capped thread-per-connection model creeps back; "
+            f"run it on the loop (non-blocking) or annotate "
+            f"'# loop-ok: <why>'")
+    return findings
+
+
 #: the flight-recorder hot path (the binary-codec round): event
 #: emission in these files goes through the recordio encoder
 #: registry (engine/recordio.py ``ShardEncoder``) — a naked
@@ -763,6 +822,8 @@ def main(argv=None):
                                                        strict=True))
         if path.endswith(TRAFFIC_FILE):
             all_findings.extend(check_traffic_discipline(path))
+        if path.endswith(NET_LOOP_FILE):
+            all_findings.extend(check_net_loop_discipline(path))
         if path.endswith(RNG_FILES):
             all_findings.extend(check_rng_discipline(path))
         if path.endswith(RECORDER_FILES):
