@@ -6,7 +6,8 @@ PY ?= python
 .PHONY: test lint bench sweep sweep-live examples dryrun check all \
 	coverage soak scaling-artifact warmstart-gate chaos-gate \
 	fleet-gate trace-gate tracker-gate net-chaos-gate optimize-gate \
-	twin-gate control-gate population-gate slo-gate c10k-gate
+	twin-gate control-gate population-gate slo-gate c10k-gate \
+	fleet-control-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -234,6 +235,26 @@ slo-gate:
 c10k-gate:
 	$(PY) tools/c10k_gate.py
 
+# HA production control fleet (ISSUE 20): a leader-fenced controller
+# PAIR over a genuinely multi-process observation plane — N sampler
+# host processes on loosely synchronized clocks (one SIGKILLed
+# mid-run: dead shard declared, excluded-and-counted) feed binary
+# shards over a shared directory; the tracker arbitrates the
+# controller lease (CTRL_LEASE/CTRL_LEASE_ACK, TTL + generation) and
+# FENCES every SET_KNOBS by generation; the leader is SIGKILLed
+# between actuation and checkpoint and the hot standby (tail-following
+# the same shards, re-deriving the same decision prefix) must take
+# over within the lease TTL and actuate the next epoch EXACTLY once
+# fleet-wide (proven from the tracker's knob-epoch history AND the
+# merged flight-recorder intent stream); a resurrected zombie leader's
+# stale-generation publishes must be refused-and-counted with its
+# decision derivation untouched; and the SLO-burn trigger must drive
+# exactly one cohort-attributed actuation under the injected regional
+# loss with zero clean-run false actuations.  FLEET_GATE_SEED /
+# FLEET_GATE_PEERS / FLEET_GATE_WAVE resize it.
+fleet-control-gate:
+	$(PY) tools/fleet_control_gate.py
+
 examples:
 	$(PY) examples/bundle_demo.py
 	$(PY) examples/wrapper_demo.py
@@ -244,6 +265,7 @@ examples:
 
 check: lint test dryrun warmstart-gate chaos-gate fleet-gate \
 	trace-gate tracker-gate net-chaos-gate optimize-gate twin-gate \
-	control-gate population-gate slo-gate c10k-gate
+	control-gate population-gate slo-gate c10k-gate \
+	fleet-control-gate
 
 all: check bench

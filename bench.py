@@ -1467,6 +1467,8 @@ def control_tick_benchmark():
 
         cold_loop, cold_probe, cold_wall = run_pass("cold")
         warm_loop, warm_probe, warm_wall = run_pass("warm")
+        failover = failover_benchmark(config, observed.shard_path,
+                                      cache)
 
     assert warm_probe.compiles == 0, \
         "warm control tick compiled XLA programs — layer-1 reuse " \
@@ -1526,7 +1528,101 @@ def control_tick_benchmark():
             "atol_before": 5625000.0,
             "atol_after": chaos_cdn_atol,
         },
+        "failover": failover,
     }
+
+
+def failover_benchmark(config, shard_path, cache_dir):
+    """``detail.control_tick.failover`` (the HA round): leader-kill
+    to first standby actuation, measured in-process over the real
+    TCP tracker.  The leader claims the controller lease and then
+    stops renewing — the kill, as the tracker sees it; the standby,
+    polling at the fleet gate's cadence against the SAME warm row
+    cache, must wait out the TTL (the detection bound), steal the
+    lease at the next generation, and have its replayed decision
+    published-and-tracker-applied.  The wall decomposes into
+    detect-and-steal (kill to first granted poll) and the
+    replay-to-applied tail — the same end-to-end definition
+    tools/fleet_control_gate.py proves at process level with a real
+    SIGKILL."""
+    from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import WarmStart
+    from hlsjs_p2p_wrapper_tpu.engine.controller import (
+        ControlLoop, HAActuator, LeaseClient, TransportActuator)
+    from hlsjs_p2p_wrapper_tpu.engine.net import TcpNetwork
+    from hlsjs_p2p_wrapper_tpu.engine.tracker import (Tracker,
+                                                      TrackerEndpoint)
+
+    ttl_ms = 1500.0
+    warm = WarmStart(cache_dir=cache_dir)
+    registry = warm.registry
+    network = TcpNetwork(psk=b"bench-failover", registry=registry)
+    try:
+        tracker_ep = network.register()
+        tracker = Tracker(network.loop, registry=registry)
+        TrackerEndpoint(tracker, tracker_ep, concurrent=True)
+        swarm = "bench-failover"
+
+        def lease_for(name):
+            return LeaseClient(network.register(), swarm, name,
+                               tracker_peer_id=tracker_ep.peer_id,
+                               ttl_ms=ttl_ms, registry=registry)
+
+        # the leader claims the lease, then never renews again —
+        # the in-process stand-in for the gate's SIGKILL
+        leader = lease_for("bench-a")
+        leader.request()
+        deadline = time.monotonic() + 10.0  # clock-ok: real sockets
+        while not leader.is_leader \
+                and time.monotonic() < deadline:  # clock-ok: ditto
+            time.sleep(0.01)  # clock-ok: lease-ack poll
+        assert leader.is_leader, "bench leader never got the lease"
+        t_kill = time.monotonic()  # clock-ok: the measured wall
+
+        # actuator first, lease client second: LeaseClient CHAINS the
+        # endpoint's on_receive, TransportActuator replaces it
+        standby_ep = network.register()
+        inner = TransportActuator(standby_ep, swarm,
+                                  tracker_peer_id=tracker_ep.peer_id,
+                                  registry=registry)
+        standby = LeaseClient(standby_ep, swarm, "bench-b",
+                              tracker_peer_id=tracker_ep.peer_id,
+                              ttl_ms=ttl_ms, registry=registry)
+        actuator = HAActuator(inner, standby, registry=registry)
+        loop = ControlLoop(config, shard_path, actuator,
+                           warm_start=warm, registry=registry,
+                           tick_gate=lambda _w: standby.is_leader
+                           or loop.epoch < standby.knob_epoch)
+        t_granted = None
+        deadline = time.monotonic() + 30.0  # clock-ok: real sockets
+        while time.monotonic() < deadline:  # clock-ok: ditto
+            standby.request()
+            if standby.is_leader and t_granted is None:
+                t_granted = time.monotonic()  # clock-ok: measured
+            loop.run_available()
+            if (tracker.knobs_for(swarm) or (0,))[0] >= 1:
+                break
+            time.sleep(0.05)  # clock-ok: fleet-gate poll cadence
+        t_applied = time.monotonic()  # clock-ok: the measured wall
+        epoch, _knobs = tracker.knobs_for(swarm) or (0, None)
+        assert epoch >= 1 and t_granted is not None, \
+            "standby takeover never actuated a tracker-applied epoch"
+        assert standby.generation == leader.generation + 1, \
+            "the steal did not advance the lease generation"
+        return {
+            "what": "leader-kill -> first standby actuation, "
+                    "in-process: real-TCP tracker lease (TTL "
+                    "detection), steal at the next generation, "
+                    "warm standby replay, tracker-applied publish",
+            "lease_ttl_ms": ttl_ms,
+            "detect_and_steal_ms": round(
+                (t_granted - t_kill) * 1e3, 1),
+            "replay_publish_ms": round(
+                (t_applied - t_granted) * 1e3, 1),
+            "failover_ms": round((t_applied - t_kill) * 1e3, 1),
+            "stolen_generation": standby.generation,
+        }
+    finally:
+        network.close()
 
 
 def fabric_benchmark():

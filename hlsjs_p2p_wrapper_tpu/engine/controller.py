@@ -66,7 +66,8 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .artifact_cache import _digest, atomic_write_json
-from .protocol import KnobUpdate, SetKnobs, decode, encode
+from .protocol import (CtrlLease, CtrlLeaseAck, KnobUpdate, SetKnobs,
+                       decode, encode)
 from .search import Constraint, rank_key
 from .telemetry import MetricsRegistry
 # ShardFollower moved to engine/twinframe.py in the fleet
@@ -77,6 +78,7 @@ from .twinframe import (FRAME_COLUMNS, ShardFollower,
 
 __all__ = ["ShardFollower", "ObservationIngest", "ControlConfig",
            "ControlLoop", "TransportActuator", "LogActuator",
+           "LeaseClient", "HAActuator",
            "band_halfwidth", "decide_tick", "control_checkpoint_path",
            "TICK_PHASES"]
 
@@ -105,12 +107,13 @@ class ObservationIngest:
 
     def __init__(self, shard_paths, source: str = "real", *,
                  dead_after_polls: Optional[int] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 per_shard: bool = False):
         paths = ([shard_paths] if isinstance(shard_paths, str)
                  else list(shard_paths))
         self.mux = ShardMuxFollower(
             paths, source=source, dead_after_polls=dead_after_polls,
-            registry=registry)
+            registry=registry, per_shard=per_shard)
 
     @property
     def rows(self) -> List[Tuple[float, ...]]:
@@ -123,6 +126,18 @@ class ObservationIngest:
     @property
     def exclusions(self) -> List[Tuple[str, ...]]:
         return self.mux.exclusions
+
+    @property
+    def shard_rows(self):
+        return self.mux.shard_rows
+
+    @property
+    def peer_stall(self) -> List[Dict[str, float]]:
+        return self.mux.peer_stall
+
+    @property
+    def peer_p2p(self) -> List[Dict[str, float]]:
+        return self.mux.peer_p2p
 
     def poll(self) -> List[Tuple[float, ...]]:
         return self.mux.poll()
@@ -152,6 +167,21 @@ class ControlConfig:
     warmup_windows: int = 2
     hysteresis_ticks: int = 2
     forecast_chunk: int = 8
+    #: SLO-burn trigger (engine/slo.py): SLOSpec dicts evaluated
+    #: INSIDE the tick — a burn-rate alert forces candidate
+    #: evaluation even when the forecast holds in-band, and the
+    #: decision names the trigger that fired.  None keeps the
+    #: pre-0.20 forecast-band-only controller.
+    slo_specs: Optional[List[dict]] = None
+    #: peer id -> cohort name, the alert-attribution map (peers
+    #: absent from it fall into the ``all`` cohort)
+    cohorts: Optional[Dict[str, str]] = None
+    #: SLO judgment's own warmup (None → ``warmup_windows``): the
+    #: join/fill phase legitimately misses delivery objectives, and
+    #: it outlasts the controller's shorter forecast warmup — a
+    #: startup-window alert would be the clean-run false actuation
+    #: the fleet gate forbids
+    slo_warmup_windows: Optional[int] = None
 
     def lattice(self) -> List[Dict[str, float]]:
         """The candidate-knob lattice: the cartesian product of the
@@ -174,7 +204,7 @@ class ControlConfig:
                                "cdn_bps", "uplink_bps", "watch_s",
                                "window_s", "cdn_latency_ms")}
         spec_dict["level_bitrates"] = list(spec.level_bitrates)
-        return {
+        out = {
             "kind": "control-loop", "spec": spec_dict,
             "knob_grid": {k: list(v)
                           for k, v in sorted(self.knob_grid.items())},
@@ -187,6 +217,18 @@ class ControlConfig:
             "warmup_windows": self.warmup_windows,
             "hysteresis_ticks": self.hysteresis_ticks,
         }
+        # only an SLO-armed controller digests its SLO identity —
+        # pre-0.20 identity dicts (and so their checkpoint digests)
+        # stay byte-identical
+        if self.slo_specs:
+            out["slo_specs"] = [dict(sorted(spec.items()))
+                                for spec in self.slo_specs]
+            out["cohorts"] = dict(sorted(
+                (self.cohorts or {}).items()))
+            out["slo_warmup_windows"] = (
+                self.warmup_windows if self.slo_warmup_windows is None
+                else self.slo_warmup_windows)
+        return out
 
 
 def band_halfwidth(bands: Dict[str, dict], metric: str,
@@ -203,12 +245,13 @@ def band_halfwidth(bands: Dict[str, dict], metric: str,
 
 def decide_tick(trials: List[dict], current_knobs: Dict[str, float],
                 constraint: Constraint, bands: Dict[str, dict],
-                band_set: str) -> dict:
+                band_set: str,
+                burn_alert: Optional[dict] = None) -> dict:
     """The pure decision function: one tick's forecast trials →
-    ``{action, knobs, band, ...}``.  ``trials`` carry ``knobs`` +
-    the metric fields (the Evaluator contract); exactly one trial's
-    knobs must equal ``current_knobs`` (the lattice always contains
-    the current config).
+    ``{action, knobs, band, trigger, ...}``.  ``trials`` carry
+    ``knobs`` + the metric fields (the Evaluator contract); exactly
+    one trial's knobs must equal ``current_knobs`` (the lattice
+    always contains the current config).
 
     The do-no-harm rule: the best-ranked candidate is actuated ONLY
     when its improvement over the current config — on the deciding
@@ -216,9 +259,24 @@ def decide_tick(trials: List[dict], current_knobs: Dict[str, float],
     twin band (:func:`band_halfwidth`).  A candidate that would
     trade the current config's feasibility away is refused outright.
     The returned decision always names the band it cleared or held
-    inside."""
+    inside, and the TRIGGER that fired it: ``forecast_band`` when
+    the band cleared, ``slo_burn`` when a burn-rate alert
+    (``burn_alert``, an :class:`~.slo.SLOEvaluator` alert dict)
+    forced the best candidate through a hold — the fleet is
+    measurably burning its error budget, so a difference the twin
+    cannot distinguish is still worth acting on.  Burn never forces
+    an infeasible candidate, and never invents one: with the best
+    candidate equal to the current config there is nothing to
+    actuate and the burn is recorded on a hold."""
     current = next(t for t in trials
                    if t["knobs"] == current_knobs)
+    alert_note = None if burn_alert is None else {
+        "slo": burn_alert.get("slo"),
+        "burn_fast": burn_alert.get("burn_fast"),
+        "burn_slow": burn_alert.get("burn_slow"),
+        "worst_shard": burn_alert.get("worst_shard"),
+        "worst_cohort": burn_alert.get("worst_cohort"),
+    }
     if current.get("failed"):
         # the current config's OWN forecast failed: there is no
         # baseline to measure a banded improvement against, and
@@ -229,7 +287,8 @@ def decide_tick(trials: List[dict], current_knobs: Dict[str, float],
             "knobs": dict(current_knobs),
             "band": {"set": band_set, "metric": None,
                      "halfwidth": None, "delta": None},
-            "headroom": None,
+            "headroom": None, "trigger": None,
+            "slo_alert": alert_note,
         }
     ranked = sorted(
         (t for t in trials if not t.get("failed")),
@@ -258,6 +317,18 @@ def decide_tick(trials: List[dict], current_knobs: Dict[str, float],
                                best.get(metric) or 0.0,
                                current.get(metric) or 0.0)
     cleared = delta > halfwidth and best["knobs"] != current_knobs
+    trigger = "forecast_band" if cleared else None
+    if (not cleared and burn_alert is not None
+            and not infeasible_best
+            and best["knobs"] != current_knobs):
+        # SLO-burn override of the band hold: the fleet is burning
+        # its error budget faster than the alert threshold on BOTH
+        # burn windows, so the best-ranked candidate is actuated
+        # even though the forecast difference sits inside the twin
+        # band (do-no-harm guards against acting on NOISE; a
+        # measured burn is signal from the real fleet, not noise)
+        cleared = True
+        trigger = "slo_burn"
     headroom = constraint.bound - ((best if cleared else current)
                                    .get(constraint.metric) or 0.0)
     return {
@@ -265,6 +336,7 @@ def decide_tick(trials: List[dict], current_knobs: Dict[str, float],
         "reason": None if cleared else (
             "best_is_current" if best["knobs"] == current_knobs
             else ("infeasible_best" if infeasible_best else "band")),
+        "trigger": trigger, "slo_alert": alert_note,
         "knobs": dict(best["knobs"]) if cleared
         else dict(current_knobs),
         "band": {"set": band_set, "metric": metric,
@@ -332,13 +404,199 @@ class TransportActuator:
                 self.registry.counter(
                     "control.publish_refusals").inc()
 
-    def actuate(self, epoch: int, knobs: Dict[str, float]) -> bool:
+    def actuate(self, epoch: int, knobs: Dict[str, float],
+                generation: int = 0) -> bool:
         wire = tuple(sorted((name, float(value))
                             for name, value in knobs.items()))
         self.published_epoch = max(self.published_epoch, epoch)
         return bool(self.endpoint.send(
             self.tracker_peer_id,
-            encode(SetKnobs(self.swarm_id, epoch, wire))))
+            encode(SetKnobs(self.swarm_id, epoch, wire,
+                            generation))))
+
+
+class LeaseClient:
+    """One controller's handle on the tracker-arbitrated controller
+    lease (``CTRL_LEASE`` / ``CTRL_LEASE_ACK`` on the announce
+    channel — the fabric WorkLedger's claim / renew / steal
+    semantics ported to the control plane).  :meth:`request` sends
+    one claim-or-renewal; acks arrive through the shared endpoint's
+    receive hook (this client CHAINS the hook the actuator already
+    installed, so one endpoint serves both planes) and update:
+
+    - :attr:`is_leader` / :attr:`generation` — whether the tracker
+      currently grants US the lease, and at which generation (what
+      the leader stamps into every SET_KNOBS it publishes — the
+      tracker's fencing floor);
+    - :attr:`leader_id` / :attr:`leader_generation` /
+      :attr:`remaining_ttl_ms` — the tracker's view of the holder
+      (the console's leader-identity panel);
+    - :attr:`knob_epoch` — the swarm's current knob epoch, piggy-
+      backed on every ack: the STANDBY's fleet watermark, gating its
+      shadow ticks so it never runs ahead of what the leader
+      actually landed.
+
+    All lease judgement is the TRACKER's (its injectable clock, its
+    generation counter) — two controllers never compare wall clocks
+    with each other, which is the whole point of the arbitration.
+    Counted ``control.lease.acks{result=granted|renewed|refused}``
+    and ``control.lease.transitions{to=leader|standby}``; every ack
+    lands as an eagerly-flushed ``lease`` flight-recorder event when
+    a recorder is armed."""
+
+    def __init__(self, endpoint, swarm_id: str, controller_id: str,
+                 *, tracker_peer_id: str = "tracker",
+                 ttl_ms: float = 2_000.0,
+                 registry: Optional[MetricsRegistry] = None,
+                 recorder=None):
+        self.endpoint = endpoint
+        self.swarm_id = swarm_id
+        self.controller_id = controller_id
+        self.tracker_peer_id = tracker_peer_id
+        self.ttl_ms = float(ttl_ms)
+        self.registry = registry
+        self.recorder = recorder
+        self.is_leader = False
+        self.generation = 0
+        self.leader_id: Optional[str] = None
+        self.leader_generation = 0
+        self.remaining_ttl_ms = 0.0
+        self.knob_epoch = 0
+        self._chain = getattr(endpoint, "on_receive", None)
+        endpoint.on_receive = self._on_frame
+
+    def request(self) -> bool:
+        """Send one lease claim/renewal (generation 0 until first
+        granted — the fresh-claim form; afterwards the granted
+        generation, the renewal form).  True means handed to the
+        transport; the ack arrives asynchronously."""
+        return bool(self.endpoint.send(
+            self.tracker_peer_id,
+            encode(CtrlLease(self.swarm_id, self.controller_id,
+                             self.generation, int(self.ttl_ms)))))
+
+    def assume(self, generation: int) -> None:
+        """CHAOS HOOK: believe we hold the lease at ``generation``
+        without asking the tracker — the resurrected-zombie-leader
+        harness (tools/fleet_control_gate.py) uses it to prove the
+        tracker's generation fencing refuses exactly this client-side
+        delusion.  Never called by the service path."""
+        self.is_leader = True
+        self.generation = int(generation)
+
+    def _on_frame(self, src_id: str, frame: bytes) -> None:
+        if src_id == self.tracker_peer_id:
+            try:
+                msg = decode(frame)
+            except Exception:  # fault-ok: counted, chain decides
+                if self.registry is not None:
+                    self.registry.counter(
+                        "control.lease.decode_rejects").inc()
+                msg = None
+            if isinstance(msg, CtrlLeaseAck) \
+                    and msg.swarm_id == self.swarm_id:
+                self._on_ack(msg)
+                return
+        if self._chain is not None:
+            self._chain(src_id, frame)
+
+    def _on_ack(self, msg: CtrlLeaseAck) -> None:
+        self.leader_id = msg.leader_id
+        self.leader_generation = msg.generation
+        self.remaining_ttl_ms = float(msg.ttl_ms)
+        if msg.knob_epoch > self.knob_epoch:
+            self.knob_epoch = msg.knob_epoch
+        leading = bool(msg.granted
+                       and msg.leader_id == self.controller_id)
+        if leading:
+            result = ("renewed" if self.is_leader
+                      and msg.generation == self.generation
+                      else "granted")
+            self.generation = msg.generation
+        else:
+            result = "refused"
+        if self.registry is not None:
+            self.registry.counter("control.lease.acks",
+                                  result=result).inc()
+            if leading != self.is_leader:
+                self.registry.counter(
+                    "control.lease.transitions",
+                    to="leader" if leading else "standby").inc()
+            self.registry.gauge("control.lease.generation").set(
+                msg.generation)
+        if self.recorder is not None:
+            self.recorder.lease(
+                result, unit=0, gen=msg.generation,
+                scope="ctrl", swarm=self.swarm_id,
+                leader=msg.leader_id,
+                ttl_ms=int(msg.ttl_ms), knob_epoch=msg.knob_epoch)
+        self.is_leader = leading
+
+
+class HAActuator:
+    """Leader-fenced actuation for a hot controller pair.  The
+    LEADER publishes through the inner :class:`TransportActuator`
+    with its lease generation stamped into the frame (the tracker
+    refuses any generation below the lease's — a deposed leader's
+    publishes are refused-and-counted server-side, whatever this
+    client believes).  A STANDBY never publishes: it SHADOW-applies
+    an epoch the fleet watermark (:attr:`LeaseClient.knob_epoch`)
+    proves the leader already landed — returning True so its derived
+    decision prefix stays bit-identical to the leader's recorded one
+    (counted ``control.shadow_applies``) — and refuses an epoch
+    BEYOND the watermark (counted ``control.publish_fenced``; the
+    standby's tick gate pauses the loop before this can happen, so
+    the refusal is the belt to that suspender).
+
+    :attr:`acked_epoch` folds the lease watermark into the inner
+    actuator's ack view: an epoch the tracker reports on the lease
+    channel IS landed, so neither role issues a convergence
+    republish for it."""
+
+    def __init__(self, inner: TransportActuator, lease: LeaseClient,
+                 registry: Optional[MetricsRegistry] = None):
+        self.inner = inner
+        self.lease = lease
+        self.registry = registry
+
+    @property
+    def acked_epoch(self) -> int:
+        return max(self.inner.acked_epoch, self.lease.knob_epoch)
+
+    @property
+    def role(self) -> str:
+        """Stamped into the durable ``actuation`` mark: the fleet
+        gate's exactly-once proof counts PUBLISHES (leader-role
+        marks), not the standby's shadow re-derivations of the same
+        epochs."""
+        return "leader" if self.lease.is_leader else "standby"
+
+    def publishes(self, epoch: int) -> bool:
+        """Would :meth:`actuate` reach the wire for ``epoch``?  The
+        control loop consults this before emitting the durable
+        ``actuation`` intent mark, so the merged fleet stream holds
+        EXACTLY one intent per published epoch (a shadow-applied or
+        replayed epoch re-derives the decision without re-marking —
+        the marks are the gate's per-epoch publish witnesses)."""
+        return self.lease.is_leader and epoch > self.acked_epoch
+
+    def actuate(self, epoch: int, knobs: Dict[str, float]) -> bool:
+        if epoch <= self.acked_epoch:
+            # the fleet watermark proves this epoch already landed:
+            # BOTH roles re-derive it silently.  This is the takeover
+            # replay path — the new leader re-deriving the dead
+            # leader's prefix must never republish it (the duplicate
+            # this layer exists to prevent), only the NEXT epoch.
+            if self.registry is not None:
+                self.registry.counter("control.shadow_applies").inc()
+            return True
+        if self.lease.is_leader:
+            return self.inner.actuate(
+                epoch, knobs, generation=self.lease.generation)
+        if self.registry is not None:
+            self.registry.counter("control.publish_fenced",
+                                  role="standby").inc()
+        return False
 
 
 class LogActuator:
@@ -364,32 +622,56 @@ class LogActuator:
                     except (ValueError, KeyError):
                         continue
 
-    def actuate(self, epoch: int, knobs: Dict[str, float]) -> bool:
+    def publishes(self, epoch: int) -> bool:
+        """Intent-mark gate (:meth:`HAActuator.publishes`): a resume
+        replaying an epoch the log already holds re-derives it
+        without re-marking."""
+        return epoch not in self._seen
+
+    @property
+    def acked_epoch(self) -> int:
+        """The log is fsync'd on append, so published IS acked —
+        lets the log ride as :class:`HAActuator`'s inner leg."""
+        return max(self._seen, default=0)
+
+    def actuate(self, epoch: int, knobs: Dict[str, float],
+                generation: int = 0) -> bool:
         if epoch in self._seen:
             return True  # already durably actuated: idempotent
+        record = {"epoch": epoch,
+                  "knobs": dict(sorted(knobs.items()))}
+        if generation:
+            record["generation"] = generation  # the publishing lease
         with open(self.path, "a", encoding="utf-8") as fh:
-            fh.write(json.dumps({"epoch": epoch,
-                                 "knobs": dict(sorted(knobs.items()))})
-                     + "\n")
+            fh.write(json.dumps(record) + "\n")
             fh.flush()
             os.fsync(fh.fileno())
         self._seen.add(epoch)
         return True
 
     def epochs(self) -> List[int]:
+        if not os.path.exists(self.path):
+            return []  # nothing ever published
         with open(self.path, encoding="utf-8") as fh:
             return [int(json.loads(line)["epoch"])
                     for line in fh if line.strip()]
 
 
 def control_checkpoint_path(cache_dir: str,
-                            config: "ControlConfig") -> str:
+                            config: "ControlConfig",
+                            instance: str = "") -> str:
     """Checkpoint location for one controller identity: co-located
     with the search checkpoints under the warm-start root,
     content-addressed by the controller identity — two different
-    controllers can never clobber each other's state."""
+    controllers can never clobber each other's state.  ``instance``
+    disambiguates an HA PAIR running the SAME identity (leader and
+    standby re-derive identical decisions by design, but their
+    checkpoints must never clobber each other through a shared
+    cache): it suffixes the digest, so the empty default keeps every
+    pre-HA path byte-identical."""
     digest = _digest(config.identity())
-    return os.path.join(cache_dir, "controllers", digest + ".json")
+    name = digest + (f"-{instance}" if instance else "") + ".json"
+    return os.path.join(cache_dir, "controllers", name)
 
 
 class ControlLoop:
@@ -405,7 +687,8 @@ class ControlLoop:
                  registry: Optional[MetricsRegistry] = None,
                  recorder=None, checkpoint_path: Optional[str] = None,
                  dead_after_polls: Optional[int] = None,
-                 wall: Callable[[], float] = time.perf_counter):
+                 wall: Callable[[], float] = time.perf_counter,
+                 tick_gate: Optional[Callable[[int], bool]] = None):
         self.config = config
         self.actuator = actuator
         self.warm_start = warm_start
@@ -413,10 +696,36 @@ class ControlLoop:
             else MetricsRegistry()
         #: ``shard_path`` may be one path or a list of them (the
         #: fleet ingest; ObservationIngest muxes on the window clock
-        #: and the decisions are layout-independent by construction)
+        #: and the decisions are layout-independent by construction).
+        #: An SLO-armed controller muxes per_shard for worst-shard
+        #: alert attribution.
         self.ingest = ObservationIngest(
             shard_path, dead_after_polls=dead_after_polls,
-            registry=self.registry)
+            registry=self.registry,
+            per_shard=bool(config.slo_specs))
+        #: the SLO-burn trigger: evaluated INSIDE the tick so the
+        #: decide leg sees the alert the same window it fires
+        self.slo = None
+        self._burn: Optional[dict] = None
+        if config.slo_specs:
+            from .slo import SLOEvaluator, SLOSpec
+            cohorts = dict(config.cohorts or {})
+            self.slo = SLOEvaluator(
+                [SLOSpec.from_dict(d) for d in config.slo_specs],
+                registry=self.registry, recorder=recorder,
+                cohort_of=lambda peer: cohorts.get(peer, "all"),
+                warmup_windows=(
+                    config.warmup_windows
+                    if config.slo_warmup_windows is None
+                    else config.slo_warmup_windows))
+        #: ``tick_gate(window) -> bool``: called before each tick;
+        #: False BUFFERS the window (re-checked on the next
+        #: run_available) instead of ticking it — how a hot STANDBY
+        #: pauses at the fleet watermark so it never derives a
+        #: decision the leader has not already landed, and resumes
+        #: through the backlog the moment it takes over
+        self._tick_gate = tick_gate
+        self._pending: List[Tuple[int, Tuple[float, ...]]] = []
         self.recorder = recorder
         self.checkpoint_path = checkpoint_path
         self.digest = _digest(config.identity())
@@ -483,19 +792,67 @@ class ControlLoop:
         returns the decisions made (resumed-prefix windows replay
         the recorded decision without re-forecasting — their
         decisions are already derived state, and their epochs are
-        already actuated)."""
+        already actuated — but still feed the SLO evaluator, whose
+        burn history is derived state too).  A ``tick_gate`` that
+        answers False leaves the window (and everything after it)
+        BUFFERED for a later call — ingest keeps draining the
+        shards, so a paused standby stays hot, not behind."""
         t0 = self._wall()
         new_rows = self.ingest.poll()
         ingest_s = self._wall() - t0
-        made = []
         base = len(self.ingest.rows) - len(new_rows)
-        for i, row in enumerate(new_rows):
-            window = base + i
+        self._pending.extend(
+            (base + i, row) for i, row in enumerate(new_rows))
+        made = []
+        while self._pending:
+            window, row = self._pending[0]
             if window < len(self.decisions):
-                continue  # resumed prefix: decision already derived
+                # resumed prefix: decision already derived; the SLO
+                # history still replays (bit-identical by the same
+                # argument as the decisions themselves)
+                self._observe_slo(window, row)
+                self._pending.pop(0)
+                continue
+            if self._tick_gate is not None \
+                    and not self._tick_gate(window):
+                break
+            self._pending.pop(0)
             made.append(self._tick(window, row, ingest_s))
             ingest_s = 0.0  # charged to the first tick of the batch
         return made
+
+    @property
+    def pending_windows(self) -> int:
+        """Closed-but-unticked windows (gate-paused backlog) — the
+        console's standby-lag surface."""
+        return len(self._pending)
+
+    def _observe_slo(self, window: int,
+                     row: Tuple[float, ...]) -> None:
+        """Feed one closed window to the SLO evaluator and maintain
+        the pending burn trigger: a rising-edge alert arms it, the
+        alert's SLO dropping out of firing disarms it (an actuation
+        consumes it — see :meth:`_tick`).  Pending-while-vetoed is
+        deliberate: hysteresis may refuse the burn's first tick, and
+        a budget still burning deserves the next one."""
+        if self.slo is None:
+            return
+        shard_rows = None
+        if self.ingest.shard_rows:
+            shard_rows = {shard: rows[window]
+                          for shard, rows
+                          in self.ingest.shard_rows.items()}
+        fired = self.slo.observe_window(
+            row, shard_rows=shard_rows,
+            peer_stall=self.ingest.peer_stall[window],
+            peer_p2p=self.ingest.peer_p2p[window],
+            excluded=self.ingest.exclusions[window])
+        if fired:
+            self._burn = fired[0]
+        elif self._burn is not None:
+            state = self.slo.state.get(self._burn.get("slo"), {})
+            if not state.get("firing"):
+                self._burn = None
 
     def _tick(self, window: int, row: Tuple[float, ...],
               ingest_s: float) -> dict:
@@ -503,6 +860,7 @@ class ControlLoop:
         self._m_ticks.inc()
         self._m_windows.inc()
         t_s = row[FRAME_COLUMNS.index("t_s")]
+        self._observe_slo(window, row)
 
         if window < self.config.warmup_windows:
             phases.update(reconstruct=0.0, forecast=0.0, decide=0.0)
@@ -511,7 +869,8 @@ class ControlLoop:
                 "knobs": dict(self.current_knobs),
                 "band": {"set": self.config.band_set, "metric": None,
                          "halfwidth": None, "delta": None},
-                "headroom": None,
+                "headroom": None, "trigger": None,
+                "slo_alert": None,
             }
         else:
             t0 = self._wall()
@@ -532,7 +891,8 @@ class ControlLoop:
             decision = decide_tick(trials, self.current_knobs,
                                    self.config.constraint,
                                    self.config.bands,
-                                   self.config.band_set)
+                                   self.config.band_set,
+                                   burn_alert=self._burn)
             if decision["action"] == "actuate" and \
                     window - self.last_actuation_tick \
                     < self.config.hysteresis_ticks:
@@ -550,11 +910,28 @@ class ControlLoop:
         t0 = self._wall()
         if decision["action"] == "actuate":
             epoch = self.epoch + 1
+            will_publish = getattr(self.actuator, "publishes", None)
+            if self.recorder is not None and (
+                    will_publish is None or will_publish(epoch)):
+                # durable INTENT before the publish: a SIGKILL
+                # between the knob publish and the checkpoint write
+                # leaves this flushed event as the proof the epoch
+                # was actuated — replay recovers it, so the window
+                # the checkpoint misses can never double-actuate
+                # fleet-wide (the fleet gate's exactly-once proof
+                # reads these)
+                self.recorder.mark(
+                    "actuation", tick=window, epoch=epoch,
+                    knobs=dict(sorted(decision["knobs"].items())),
+                    trigger=decision.get("trigger"),
+                    role=getattr(self.actuator, "role", "sole"))
+                self.recorder.flush(fsync=False)
             if self.actuator.actuate(epoch, decision["knobs"]):
                 self.epoch = epoch
                 self.current_knobs = dict(decision["knobs"])
                 self.last_actuation_tick = window
                 self._m_actuations.inc()
+                self._burn = None  # the burn trigger is consumed
             else:
                 decision["action"] = "veto"
                 decision["reason"] = "actuator_refused"
@@ -594,6 +971,7 @@ class ControlLoop:
                 "control_tick", tick=window,
                 action=decision["action"], epoch=self.epoch,
                 headroom=decision.get("headroom"),
+                trigger=decision.get("trigger"),
                 t_s=decision["t_s"])
             self.recorder.flush(fsync=False)
         self.tick_stats.append({"tick": window,

@@ -52,6 +52,8 @@ class MsgType:
     LEAVE = 0x12      # tracker: orderly departure
     SET_KNOBS = 0x13  # controller → tracker: publish a knob epoch
     KNOB_UPDATE = 0x14  # tracker → peer: current knob epoch
+    CTRL_LEASE = 0x15  # controller → tracker: claim/renew the lease
+    CTRL_LEASE_ACK = 0x16  # tracker → controller: lease verdict
 
 
 class DenyReason:
@@ -148,11 +150,20 @@ class SetKnobs:
     agent does not recognize are skipped there (forward compat).
     Epochs are STRICTLY monotone per swarm: the tracker refuses
     ``epoch <= current`` (a resumed controller can never re-actuate
-    a stale decision) and clients apply idempotently by epoch."""
+    a stale decision) and clients apply idempotently by epoch.
+
+    ``generation`` is the publisher's controller-lease generation
+    (round 18): when the swarm's control channel is lease-arbitrated
+    the tracker additionally refuses any publish whose generation is
+    below the lease's — a deposed leader is FENCED on the tracker's
+    own state, with no wall-clock trust between controllers.  0 is
+    the pre-HA publisher (no lease claimed); it is fenced too once a
+    lease exists."""
 
     swarm_id: str
     epoch: int
     knobs: Tuple[Tuple[str, float], ...]
+    generation: int = 0
 
 
 @dataclass(frozen=True)
@@ -163,11 +174,49 @@ class KnobUpdate:
     published knobs is followed by one of these, so periodic
     re-announce (and the reconnect-listener's immediate re-announce
     on a healed link) IS the knob-convergence path; no new timer, no
-    new channel."""
+    new channel.  ``generation`` echoes the lease generation that
+    last wrote the state (0 when no lease-fenced controller ever
+    published)."""
 
     swarm_id: str
     epoch: int
     knobs: Tuple[Tuple[str, float], ...]
+    generation: int = 0
+
+
+@dataclass(frozen=True)
+class CtrlLease:
+    """Controller → tracker: claim or renew THE controller lease for
+    one swarm's control channel (round 18 HA pair).  ``generation``
+    is the generation the sender believes it holds — 0 for a fresh
+    claim; a renewal presents its granted generation so a deposed
+    holder can never extend a lease that was stolen from it.
+    ``ttl_ms`` is the requested time-to-live, judged entirely on the
+    TRACKER's clock (the WorkLedger claim/steal discipline ported to
+    the control channel: no wall-clock agreement between controllers
+    is assumed, ever)."""
+
+    swarm_id: str
+    controller_id: str
+    generation: int
+    ttl_ms: int
+
+
+@dataclass(frozen=True)
+class CtrlLeaseAck:
+    """Tracker → controller: the lease verdict.  Always carries the
+    CURRENT holder (``leader_id`` / ``generation`` / remaining
+    ``ttl_ms``) so a refused claimant doubles as a leader-identity
+    subscription, and ``knob_epoch`` — the swarm's current knob
+    epoch — so the hot standby's replay watermark rides the lease
+    channel (no extra probe traffic)."""
+
+    swarm_id: str
+    leader_id: str
+    generation: int
+    ttl_ms: int
+    granted: bool
+    knob_epoch: int
 
 
 class ProtocolError(ValueError):
@@ -267,27 +316,52 @@ def encode(msg) -> bytes:
         return _frame(MsgType.SET_KNOBS, _pack_knob_body(msg))
     if t is KnobUpdate:
         return _frame(MsgType.KNOB_UPDATE, _pack_knob_body(msg))
+    if t is CtrlLease:
+        return _frame(
+            MsgType.CTRL_LEASE,
+            _pack_str(msg.swarm_id) + _pack_str(msg.controller_id)
+            + struct.pack("<II", _check_u32(msg.generation,
+                                            "lease generation"),
+                          _check_u32(msg.ttl_ms, "lease ttl_ms")))
+    if t is CtrlLeaseAck:
+        return _frame(
+            MsgType.CTRL_LEASE_ACK,
+            _pack_str(msg.swarm_id) + _pack_str(msg.leader_id)
+            + struct.pack("<IIBI",
+                          _check_u32(msg.generation,
+                                     "lease generation"),
+                          _check_u32(msg.ttl_ms, "lease ttl_ms"),
+                          1 if msg.granted else 0,
+                          _check_u32(msg.knob_epoch, "knob epoch")))
     raise ProtocolError(f"cannot encode {t.__name__}")
 
 
+def _check_u32(value: int, what: str) -> int:
+    if not 0 <= value <= 0xFFFFFFFF:
+        raise ProtocolError(f"{what} {value} outside u32")
+    return value
+
+
 def _pack_knob_body(msg) -> bytes:
-    """Shared SET_KNOBS / KNOB_UPDATE body: swarm id, u32 epoch, u16
-    knob count, then ``(name, f64 value)`` pairs."""
-    if not 0 <= msg.epoch <= 0xFFFFFFFF:
-        raise ProtocolError(f"knob epoch {msg.epoch} outside u32")
+    """Shared SET_KNOBS / KNOB_UPDATE body: swarm id, u32 epoch, u32
+    lease generation, u16 knob count, then ``(name, f64 value)``
+    pairs."""
+    _check_u32(msg.epoch, "knob epoch")
+    _check_u32(msg.generation, "lease generation")
     if len(msg.knobs) > 0xFFFF:
         raise ProtocolError("too many knobs for wire format")
     body = _pack_str(msg.swarm_id)
-    body += struct.pack("<IH", msg.epoch, len(msg.knobs))
+    body += struct.pack("<IIH", msg.epoch, msg.generation,
+                        len(msg.knobs))
     for name, value in msg.knobs:
         body += _pack_str(name) + struct.pack("<d", float(value))
     return body
 
 
-def _unpack_knob_body(body: memoryview) -> Tuple[str, int, tuple]:
+def _unpack_knob_body(body: memoryview) -> Tuple[str, int, tuple, int]:
     swarm_id, off = _unpack_str(body, 0)
-    epoch, count = struct.unpack_from("<IH", body, off)
-    off += 6
+    epoch, generation, count = struct.unpack_from("<IIH", body, off)
+    off += 10
     knobs = []
     for _ in range(count):
         name, off = _unpack_str(body, off)
@@ -297,7 +371,7 @@ def _unpack_knob_body(body: memoryview) -> Tuple[str, int, tuple]:
         off += 8
         knobs.append((name, value))
     _consumed(off, body)
-    return swarm_id, epoch, tuple(knobs)
+    return swarm_id, epoch, tuple(knobs), generation
 
 
 def _frame(msg_type: int, body: bytes) -> bytes:
@@ -394,6 +468,23 @@ def _decode_body(msg_type: int, body: memoryview):
         return SetKnobs(*_unpack_knob_body(body))
     if msg_type == MsgType.KNOB_UPDATE:
         return KnobUpdate(*_unpack_knob_body(body))
+    if msg_type == MsgType.CTRL_LEASE:
+        swarm_id, off = _unpack_str(body, 0)
+        controller_id, off = _unpack_str(body, off)
+        generation, ttl_ms = struct.unpack_from("<II", body, off)
+        _consumed(off + 8, body)
+        return CtrlLease(swarm_id, controller_id, generation, ttl_ms)
+    if msg_type == MsgType.CTRL_LEASE_ACK:
+        swarm_id, off = _unpack_str(body, 0)
+        leader_id, off = _unpack_str(body, off)
+        generation, ttl_ms, granted, knob_epoch = \
+            struct.unpack_from("<IIBI", body, off)
+        _consumed(off + 13, body)
+        if granted not in (0, 1):
+            # canonical encoding: exactly one byte string per message
+            raise ProtocolError(f"non-boolean granted byte {granted}")
+        return CtrlLeaseAck(swarm_id, leader_id, generation, ttl_ms,
+                            bool(granted), knob_epoch)
     raise ProtocolError(f"unknown message type 0x{msg_type:02x}")
 
 

@@ -155,7 +155,8 @@ class FlightRecorder:
     def __init__(self, trace_dir: str, host_id: str = "host00", *,
                  run_id: Optional[str] = None, clock=time.time,
                  registry: Optional[MetricsRegistry] = None,
-                 counter_filter=None, binary: bool = True):
+                 counter_filter=None, bump_filter=None,
+                 binary: bool = True):
         #: optional predicate on the counter FAMILY name: when set,
         #: only matching bumps become events (explicit emits — spans,
         #: marks, rows, leases — are never filtered).  For recorders
@@ -165,6 +166,16 @@ class FlightRecorder:
         #: default None keeps the complete-ground-truth contract the
         #: trace gate replays (counter events == registries exactly).
         self._counter_filter = counter_filter
+        #: optional LABEL-AWARE predicate ``(name, labels_str) ->
+        #: bool`` on counter bumps, applied after ``counter_filter``.
+        #: The fleet-ingest need: N sampler-host processes observing
+        #: the SAME swarm each record only THEIR assigned peers'
+        #: ``twin.*`` bumps, so the merged shards carry each event
+        #: exactly once.  Unlike the name filter it cannot bind into
+        #: the registry (labels are per-bump), so it costs one
+        #: predicate call per recorded bump — scope it with a
+        #: ``counter_filter`` so unrelated families never reach it.
+        self._bump_filter = bump_filter
         os.makedirs(trace_dir, exist_ok=True)
         self.trace_dir = trace_dir
         self.host_id = host_id
@@ -346,6 +357,9 @@ class FlightRecorder:
             # filtered instruments from calling here, but a listener
             # invoked directly (tests, foreign registries) must still
             # honor the filter
+            return
+        if self._bump_filter is not None \
+                and not self._bump_filter(name, _labels_str(labels)):
             return
         encoder = self._encoder
         if encoder is not None \

@@ -70,8 +70,9 @@ from typing import Callable, Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from ..core.clock import Clock
-from .protocol import (Announce, KnobUpdate, Leave, Peers, ProtocolError,
-                       SetKnobs, decode, encode)
+from .protocol import (Announce, CtrlLease, CtrlLeaseAck, KnobUpdate,
+                       Leave, Peers, ProtocolError, SetKnobs, decode,
+                       encode)
 from .telemetry import MetricsRegistry
 from .transport import Endpoint
 
@@ -325,7 +326,25 @@ class Tracker:
         self._m_knob_sets = {
             result: self.metrics.counter("tracker.knob_sets",
                                          result=result)
-            for result in ("accepted", "stale", "cap")}
+            for result in ("accepted", "stale", "cap", "fenced")}
+        # HA controller pair (round 18): tracker-arbitrated control
+        # lease per swarm.  The WorkLedger claim/steal discipline
+        # ported to the control channel — TTL judged entirely on THIS
+        # clock (controllers never compare wall clocks), generation
+        # strictly advancing on every ownership change so a deposed
+        # leader's generation is a permanent fencing floor, and the
+        # accepted-knob-epoch history the fleet gate audits for
+        # exactly-once actuation.
+        self._ctrl_lock = threading.Lock()
+        # swarm -> [leader_id, generation, expires_at_ms]
+        self._ctrl_leases: Dict[str, list] = {}
+        self._knob_gen: Dict[str, int] = {}
+        self._knob_history: Dict[str, list] = {}
+        self._m_ctrl_leases = {
+            result: self.metrics.counter("tracker.ctrl_leases",
+                                         result=result)
+            for result in ("granted", "renewed", "stolen", "refused",
+                           "cap")}
 
     # -- policy knobs (live control plane) -----------------------------
 
@@ -333,18 +352,106 @@ class Tracker:
     #: bodies are as unauthenticated as ANNOUNCE's, so the table must
     #: not be mintable without bound
     MAX_KNOB_SWARMS = 1_024
+    #: same mintability bound for the controller-lease table
+    MAX_CTRL_LEASES = 1_024
+    #: accepted-epoch history kept per swarm (the HA gate's
+    #: exactly-once audit trail) — bounded like every other table
+    KNOB_HISTORY_CAP = 4_096
+    #: requested lease TTLs are clamped into this window: a zero TTL
+    #: would make every grant instantly stealable and a huge one
+    #: would wedge the channel on a dead leader forever
+    CTRL_LEASE_TTL_MS = (100.0, 3_600_000.0)
 
-    def set_knobs(self, swarm_id: str, epoch: int,
-                  knobs: tuple) -> Tuple[bool, int, tuple]:
+    def ctrl_lease(self, swarm_id: str, controller_id: str,
+                   generation: int, ttl_ms: float
+                   ) -> Tuple[bool, str, int, float]:
+        """Claim or renew the controller lease for one swarm's
+        control channel.  Returns ``(granted, leader_id, generation,
+        ttl_ms)`` — on refusal the CURRENT holder and its remaining
+        TTL, so a standby's refused claim doubles as its
+        leader-identity subscription.
+
+        Semantics (the fabric WorkLedger's claim/steal discipline):
+
+        - no lease, or the held lease EXPIRED on this tracker's
+          clock → granted, with a generation STRICTLY above every
+          generation ever granted for the swarm (the fencing floor
+          :meth:`set_knobs` enforces);
+        - held unexpired by the same controller presenting its
+          granted generation → renewed (TTL extended);
+        - anything else — another live holder, or the same id with a
+          stale generation (a resurrected deposed leader) → refused.
+        """
+        lo, hi = self.CTRL_LEASE_TTL_MS
+        ttl = min(max(float(ttl_ms), lo), hi)
+        now = self.clock.now()
+        with self._ctrl_lock:
+            entry = self._ctrl_leases.get(swarm_id)
+            if entry is None:
+                if len(self._ctrl_leases) >= self.MAX_CTRL_LEASES:
+                    self._m_ctrl_leases["cap"].inc()
+                    return False, "", 0, 0.0
+                self._ctrl_leases[swarm_id] = \
+                    [controller_id, 1, now + ttl]
+                self._m_ctrl_leases["granted"].inc()
+                return True, controller_id, 1, ttl
+            leader, gen, expires = entry
+            if leader == controller_id and generation == gen \
+                    and expires > now:
+                entry[2] = now + ttl
+                self._m_ctrl_leases["renewed"].inc()
+                return True, controller_id, gen, ttl
+            if expires <= now:
+                # steal: the dead (or silent) leader's generation is
+                # permanently superseded — its in-flight publishes
+                # will be fenced, never applied
+                entry[0] = controller_id
+                entry[1] = gen + 1
+                entry[2] = now + ttl
+                self._m_ctrl_leases["stolen"].inc()
+                return True, controller_id, gen + 1, ttl
+            self._m_ctrl_leases["refused"].inc()
+            return False, leader, gen, max(expires - now, 0.0)
+
+    def ctrl_lease_state(self, swarm_id: str
+                         ) -> Optional[Tuple[str, int, float]]:
+        """The swarm's current ``(leader_id, generation,
+        remaining_ttl_ms)`` — remaining TTL may be <= 0 (expired but
+        not yet stolen; the generation floor still fences) — or None
+        when no controller ever claimed."""
+        entry = None
+        with self._ctrl_lock:
+            if swarm_id in self._ctrl_leases:
+                entry = list(self._ctrl_leases[swarm_id])
+        if entry is None:
+            return None
+        return entry[0], entry[1], entry[2] - self.clock.now()
+
+    def set_knobs(self, swarm_id: str, epoch: int, knobs: tuple,
+                  generation: int = 0) -> Tuple[bool, int, tuple]:
         """Publish a knob epoch for one swarm.  Accepted only when
         ``epoch`` is STRICTLY greater than the current one — the
         monotonicity that makes controller resume safe (a re-sent
-        stale decision is counted and refused, never re-applied).
-        Returns ``(accepted, current_epoch, current_knobs)`` — the
-        current state either way, which is what the adapter answers
-        as the :class:`~.protocol.KnobUpdate` ack."""
+        stale decision is counted and refused, never re-applied) —
+        AND, once the swarm's control channel is lease-arbitrated,
+        only when ``generation`` is at least the lease's: a deposed
+        leader (stale generation — including the pre-HA 0) is FENCED
+        (counted ``tracker.knob_sets{result=fenced}``) on this
+        tracker's own state, with no wall-clock trust between
+        controllers.  Returns ``(accepted, current_epoch,
+        current_knobs)`` — the current state either way, which is
+        what the adapter answers as the :class:`~.protocol
+        .KnobUpdate` ack."""
+        with self._ctrl_lock:
+            entry = self._ctrl_leases.get(swarm_id)
+            lease_gen = entry[1] if entry is not None else None
         with self._knob_lock:
             current = self._knobs.get(swarm_id)
+            if lease_gen is not None and generation < lease_gen:
+                self._m_knob_sets["fenced"].inc()
+                if current is None:
+                    return False, 0, ()
+                return False, current[0], current[1]
             if current is None and \
                     len(self._knobs) >= self.MAX_KNOB_SWARMS:
                 self._m_knob_sets["cap"].inc()
@@ -353,6 +460,10 @@ class Tracker:
                 self._m_knob_sets["stale"].inc()
                 return False, current[0], current[1]
             self._knobs[swarm_id] = (epoch, tuple(knobs))
+            self._knob_gen[swarm_id] = generation
+            history = self._knob_history.setdefault(swarm_id, [])
+            history.append((epoch, generation, self.clock.now()))
+            del history[:-self.KNOB_HISTORY_CAP]
             self._m_knob_sets["accepted"].inc()
             return True, epoch, tuple(knobs)
 
@@ -361,6 +472,21 @@ class Tracker:
         controller ever published any."""
         with self._knob_lock:
             return self._knobs.get(swarm_id)
+
+    def knob_generation(self, swarm_id: str) -> int:
+        """The lease generation that last wrote the swarm's knobs
+        (0 when never written, or written by a pre-HA publisher)."""
+        with self._knob_lock:
+            return self._knob_gen.get(swarm_id, 0)
+
+    def knob_history(self, swarm_id: str) -> list:
+        """Every ACCEPTED knob publish for the swarm, oldest first,
+        as ``(epoch, generation, t_ms)`` — the HA fleet gate's
+        exactly-once audit trail (epochs are strictly monotone by
+        construction; the history proves nothing was applied
+        twice)."""
+        with self._knob_lock:
+            return list(self._knob_history.get(swarm_id, ()))
 
     # -- sharding ------------------------------------------------------
 
@@ -1098,17 +1224,31 @@ class TrackerEndpoint:
             current = self.tracker.knobs_for(msg.swarm_id)
             if current is not None:
                 self.endpoint.send(src_id, encode(
-                    KnobUpdate(msg.swarm_id, current[0], current[1])))
+                    KnobUpdate(msg.swarm_id, current[0], current[1],
+                               self.tracker.knob_generation(
+                                   msg.swarm_id))))
         elif isinstance(msg, Leave):
             self.tracker.leave(msg.swarm_id, msg.peer_id, source=src_id)
         elif isinstance(msg, SetKnobs):
             _accepted, epoch, knobs = self.tracker.set_knobs(
-                msg.swarm_id, msg.epoch, msg.knobs)
+                msg.swarm_id, msg.epoch, msg.knobs,
+                generation=msg.generation)
             # ack with the CURRENT state either way — a refused stale
-            # publish tells the (possibly resumed) controller where
-            # the epoch actually stands
+            # (or fenced) publish tells the possibly-resumed,
+            # possibly-deposed controller where the epoch actually
+            # stands and which generation owns it
             self.endpoint.send(src_id, encode(
-                KnobUpdate(msg.swarm_id, epoch, knobs)))
+                KnobUpdate(msg.swarm_id, epoch, knobs,
+                           self.tracker.knob_generation(
+                               msg.swarm_id))))
+        elif isinstance(msg, CtrlLease):
+            granted, leader, gen, ttl = self.tracker.ctrl_lease(
+                msg.swarm_id, msg.controller_id, msg.generation,
+                msg.ttl_ms)
+            current = self.tracker.knobs_for(msg.swarm_id)
+            self.endpoint.send(src_id, encode(CtrlLeaseAck(
+                msg.swarm_id, leader, gen, int(ttl), granted,
+                current[0] if current is not None else 0)))
 
 
 class TrackerClient:

@@ -603,7 +603,7 @@ class ShardMuxFollower:
         #: layer's cohort-attribution inputs (engine/slo.py)
         self.peer_stall: List[Dict[str, float]] = []
         self.peer_p2p: List[Dict[str, float]] = []
-        self._last_t: Optional[float] = None
+        self._last_key: Optional[float] = None
         self._shard_builders: Optional[Dict[str, FrameBuilder]] = None
         self.shard_rows: Dict[str, List[Optional[Tuple[float, ...]]]] \
             = {}
@@ -618,6 +618,25 @@ class ShardMuxFollower:
     def shard_ids(self) -> List[str]:
         return [lane.shard_id for lane in self._lanes]
 
+    @staticmethod
+    def _mark_key(mark: dict) -> float:
+        """The merge watermark of one ``twin_window`` mark: the
+        sampler's WINDOW INDEX when the mark carries one (every
+        sampler since round 12 stamps it), else the mark's clock.
+        Index-keyed merging is what lets a fleet of sampler hosts on
+        LOOSELY SYNCHRONIZED clocks merge exactly — hosts agree on
+        the window schedule (the logical watermark) even when their
+        clock stamps disagree by a skew; clock-keyed merging would
+        exclude every host but the earliest from every window.  On
+        an aligned fleet (and on every pre-HA shard layout, where
+        marks are replicated byte-identical) the two keys order
+        identically, so the merge is unchanged there."""
+        window = mark.get("window")
+        if isinstance(window, (int, float)) \
+                and not isinstance(window, bool):
+            return float(window)
+        return mark.get("t", 0.0)
+
     def _drop_stale(self) -> None:
         """Discard buffered segments whose window already closed —
         a late-appearing or revived shard must not smear old BYTE
@@ -628,11 +647,12 @@ class ShardMuxFollower:
         a shard that appears mid-run would leave its peers
         permanently invisible to presence, watched-time, and the
         per-peer attribution surfaces of every later window."""
-        if self._last_t is None:
+        if self._last_key is None:
             return
         for lane in self._lanes:
             while lane.segments and \
-                    lane.segments[0][0].get("t", 0.0) <= self._last_t:
+                    self._mark_key(lane.segments[0][0]) \
+                    <= self._last_key:
                 _mark, events = lane.segments.popleft()
                 shard_builder = (self._shard_builders or {}).get(
                     lane.shard_id)
@@ -652,21 +672,29 @@ class ShardMuxFollower:
 
     def _close(self, live: List[_MuxLane]) -> Tuple[float, ...]:
         """Close one merged window at the EARLIEST buffered mark
-        clock among the live lanes (lanes already sorted by shard
-        id — the deterministic feed order).  A lane whose next mark
-        sits BEYOND that clock is ahead of this window — a
+        watermark among the live lanes (lanes already sorted by
+        shard id — the deterministic feed order; see
+        :meth:`_mark_key` for why the watermark is the window INDEX
+        on an index-stamping fleet).  A lane whose next mark sits
+        BEYOND that watermark is ahead of this window — a
         late-started host missing the earlier marks, or a shard
         whose mark line was lost to corruption — and skips it
         (recorded in the window's exclusions) instead of having a
         LATER window's segment consumed positionally, which would
-        desynchronize every subsequent merge.  On an aligned fleet
-        every live lane's mark carries the same boundary clock and
-        everyone contributes."""
-        t = min(lane.segments[0][0].get("t", 0.0) for lane in live)
+        desynchronize every subsequent merge.  The merged row's
+        clock is the EARLIEST contributing mark clock, so a fleet
+        containing one unskewed host closes every window at that
+        host's boundary clock — bit-identical to a single-host
+        ingest of the same traffic, whatever the other hosts'
+        skews."""
+        key = min(self._mark_key(lane.segments[0][0])
+                  for lane in live)
+        t = min(lane.segments[0][0].get("t", 0.0) for lane in live
+                if self._mark_key(lane.segments[0][0]) <= key)
         window_ms = None
         contributed = set()
         for lane in live:
-            if lane.segments[0][0].get("t", 0.0) > t:
+            if self._mark_key(lane.segments[0][0]) > key:
                 continue  # ahead of this window: contributes later
             mark, events = lane.segments.popleft()
             if window_ms is None:
@@ -698,7 +726,7 @@ class ShardMuxFollower:
                                    shard=shard_id).inc()
         self._registry.counter("mux.windows").inc()
         self.windows += 1
-        self._last_t = t
+        self._last_key = key
         self.rows.append(row)
         self.memberships.append(self.builder.membership())
         self.peer_stall.append(dict(self.builder.last_peer_stall_ms))

@@ -85,6 +85,37 @@ def _is_twin_family(name: str) -> bool:
     return name.startswith("twin.")
 
 
+def peer_host(peer: str, n_hosts: int) -> int:
+    """The fleet's peer → sampler-host assignment (``crc32 % n``) —
+    ONE formula shared by :func:`split_shard`'s default placement
+    and the live multi-process sampler hosts
+    (tools/sampler_host.py), so a re-shard of single-host traffic
+    and a genuine per-host recording of the same swarm place every
+    peer identically (and so produce mux-identical shard sets)."""
+    import zlib
+    return zlib.crc32(peer.encode()) % n_hosts
+
+
+def host_bump_filter(host_index: int, n_hosts: int):
+    """Label-aware recorder predicate
+    (:class:`~..engine.tracer.FlightRecorder` ``bump_filter``) for
+    ONE sampler host of an ``n_hosts`` fleet: keep a ``twin.*`` bump
+    iff :func:`peer_host` assigns its peer here (peer-less twin
+    bumps follow the meta onto host 0 — :func:`split_shard`'s rule).
+    Every fleet-wide bump lands on exactly one host's shard — the
+    invariant the mux merge (and its exactness proof) relies on."""
+    def keep(_name: str, labels_str: str) -> bool:
+        peer = None
+        for part in labels_str.split(","):
+            if part.startswith("peer="):
+                peer = part[len("peer="):]
+                break
+        if not peer:
+            return host_index == 0
+        return peer_host(peer, n_hosts) == host_index
+    return keep
+
+
 @dataclass(frozen=True)
 class TwinScenario:
     """One seeded scenario, expressible in both planes."""
@@ -202,12 +233,17 @@ class TwinSampler:
 
     def __init__(self, harness: SwarmHarness, window_ms: float,
                  recorder=None, source: str = "real",
-                 flush_every: int = 1):
+                 flush_every: int = 1, on_window=None):
         self.harness = harness
         self.window_ms = float(window_ms)
         self.recorder = recorder
         self.builder = FrameBuilder(source, window_ms / 1000.0)
         self.windows = 0
+        #: ``on_window(index)`` fires after each window closed (and
+        #: its mark flushed) — the fleet gate's sampler-death hook
+        #: (a host SIGKILLing itself after window K dies with K+1
+        #: durable windows, deterministically)
+        self.on_window = on_window
         #: flush the recorder every Nth window instead of every one —
         #: the batch-extraction setting (run_real_plane), where nobody
         #: tails the shard live and per-window flush syscalls were a
@@ -246,6 +282,8 @@ class TwinSampler:
             if (self.windows + 1) % self.flush_every == 0:
                 self.recorder.flush(fsync=False)
         self.windows += 1
+        if self.on_window is not None:
+            self.on_window(self.windows - 1)
         self._arm()
 
     def frame(self) -> ObservationFrame:
@@ -468,7 +506,6 @@ def split_shard(shard_path: str, out_dir: str, n_shards: int,
     manipulate directly."""
     import json
     import os
-    import zlib
 
     from ..engine.recordio import ShardEncoder
     from ..engine.tracer import read_shard
@@ -508,7 +545,7 @@ def split_shard(shard_path: str, out_dir: str, n_shards: int,
             elif assign is not None:
                 shard = int(assign(peer)) % n_shards
             else:
-                shard = zlib.crc32(peer.encode()) % n_shards
+                shard = peer_host(peer, n_shards)
             write(shard, event)
     finally:
         for fh in handles:

@@ -290,3 +290,299 @@ def test_log_actuator_appends_and_reports_epochs(tmp_path):
     with open(log.path, encoding="utf-8") as fh:
         rows = [json.loads(line) for line in fh]
     assert [r["knobs"] for r in rows] == [{"k": 1.0}, {"k": 2.0}]
+
+
+# -- HAActuator fencing/shadow semantics (round 18) -------------------
+# The process-level HA proof is tools/fleet_control_gate.py (`make
+# fleet-control-gate`); this tier pins the actuator's role/watermark
+# branch structure with a stub lease — HAActuator reads only
+# .is_leader / .generation / .knob_epoch, so the stub IS the full
+# contract surface.
+
+
+class StubLease:
+    def __init__(self, is_leader=False, generation=0, knob_epoch=0):
+        self.is_leader = is_leader
+        self.generation = generation
+        self.knob_epoch = knob_epoch
+
+
+class RecordingInner:
+    """Inner TransportActuator stand-in: records (epoch, generation)
+    publishes and acks them immediately."""
+
+    def __init__(self):
+        self.calls = []
+        self.acked_epoch = 0
+
+    def actuate(self, epoch, knobs, generation=0):
+        self.calls.append((epoch, generation))
+        self.acked_epoch = max(self.acked_epoch, epoch)
+        return True
+
+
+def ha_counters(registry, family):
+    return sum(v for _labels, v in registry.series(family))
+
+
+def test_ha_leader_publishes_with_its_lease_generation():
+    from hlsjs_p2p_wrapper_tpu.engine.controller import HAActuator
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+
+    inner = RecordingInner()
+    registry = MetricsRegistry()
+    ha = HAActuator(inner, StubLease(is_leader=True, generation=3),
+                    registry=registry)
+    assert ha.role == "leader"
+    assert ha.publishes(1) is True
+    assert ha.actuate(1, {"k": 1.0}) is True
+    assert inner.calls == [(1, 3)]  # generation stamped on the wire
+    assert ha.publishes(1) is False  # acked now: replay won't re-mark
+
+
+def test_ha_shadow_applies_watermarked_epochs_for_both_roles():
+    """``epoch <= acked_epoch`` is the takeover-replay path: BOTH
+    roles re-derive it silently (True, inner untouched, counted) —
+    a new leader replaying the dead leader's prefix must never
+    republish it, only the next epoch."""
+    from hlsjs_p2p_wrapper_tpu.engine.controller import HAActuator
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+
+    for leading in (True, False):
+        inner = RecordingInner()
+        registry = MetricsRegistry()
+        lease = StubLease(is_leader=leading, generation=2,
+                          knob_epoch=2)
+        ha = HAActuator(inner, lease, registry=registry)
+        assert ha.acked_epoch == 2  # the lease watermark folds in
+        assert ha.publishes(2) is False
+        assert ha.actuate(2, {"k": 1.0}) is True
+        assert inner.calls == []
+        assert ha_counters(registry, "control.shadow_applies") == 1
+        assert ha_counters(registry, "control.publish_fenced") == 0
+
+
+def test_ha_standby_is_fenced_beyond_the_watermark():
+    from hlsjs_p2p_wrapper_tpu.engine.controller import HAActuator
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+
+    inner = RecordingInner()
+    registry = MetricsRegistry()
+    ha = HAActuator(inner, StubLease(is_leader=False, knob_epoch=1),
+                    registry=registry)
+    assert ha.role == "standby"
+    assert ha.publishes(2) is False
+    assert ha.actuate(2, {"k": 1.0}) is False  # refused, counted
+    assert inner.calls == []
+    assert ha_counters(registry, "control.publish_fenced") == 1
+
+
+def test_ha_acked_epoch_is_max_of_inner_ack_and_lease_watermark():
+    from hlsjs_p2p_wrapper_tpu.engine.controller import HAActuator
+
+    inner = RecordingInner()
+    inner.acked_epoch = 1
+    ha = HAActuator(inner, StubLease(knob_epoch=3))
+    assert ha.acked_epoch == 3
+    inner.acked_epoch = 5
+    assert ha.acked_epoch == 5
+
+
+# -- standby takeover determinism (round 18) ---------------------------
+# A real-plane observation shard (clean AND chaos) replayed twice:
+# once by a sole controller (the oracle), once by a standby that
+# tail-follows gated at the dead leader's watermark, then steals the
+# lease and takes over.  The takeover's decision sequence must be
+# bit-identical (float.hex) to the oracle's, with the dead leader's
+# prefix shadow-applied (never republished) and exactly the epochs
+# beyond the watermark published.
+
+
+def ha_scenario(chaos):
+    fields = dict(seed=0, n_peers=8, wave_peers=4, watch_s=96.0,
+                  uplink_bps=900_000.0, cdn_bps=1_200_000.0)
+    if chaos:
+        fields.update(fault_specs="loss@24-56",
+                      fault_kwargs={"loss_rate": 0.4})
+    return TwinScenario(**fields)
+
+
+def ha_config(spec):
+    # uncalibrated bands (halfwidth 0) so the scarce-supply forecast
+    # actuates several epochs — the takeover needs a prefix AND a tail
+    return ControlConfig(
+        spec=spec,
+        knob_grid={"p2p_budget_cap_ms": [500.0, 6000.0]},
+        initial_knobs={"p2p_budget_cap_ms": 6000.0},
+        constraint=Constraint.parse("rebuffer<=0.25"),
+        bands={}, warmup_windows=1)
+
+
+def decision_sig(decisions):
+    """Bit-exactness surface: float knob values by float.hex."""
+    return [(d["tick"], d["action"], d.get("trigger"),
+             tuple(sorted((k, float(v).hex())
+                          for k, v in d["knobs"].items())))
+            for d in decisions]
+
+
+@pytest.fixture(scope="module", params=["clean", "chaos"])
+def ha_plane(request, tmp_path_factory):
+    from hlsjs_p2p_wrapper_tpu.testing.twin import run_real_plane
+
+    root = tmp_path_factory.mktemp(f"ha-{request.param}")
+    spec = ha_scenario(request.param == "chaos")
+    observed = run_real_plane(spec, trace_dir=str(root / "trace"),
+                              extract_events=False)
+    return spec, observed.shard_path
+
+
+def test_standby_takeover_replays_bit_identical_prefix(
+        ha_plane, tmp_path):
+    from hlsjs_p2p_wrapper_tpu.engine.controller import HAActuator
+
+    spec, shard = ha_plane
+    config = ha_config(spec)
+    oracle = ControlLoop(
+        config, shard, LogActuator(str(tmp_path / "oracle.jsonl")))
+    oracle.run_available()
+    acted = [d["epoch"] for d in oracle.decisions
+             if d["action"] == "actuate"]
+    assert len(acted) >= 2  # a prefix to replay AND a tail to publish
+
+    # the dead leader published exactly its first epoch; the standby
+    # learned that watermark from the lease ack channel
+    inner = LogActuator(str(tmp_path / "standby.jsonl"))
+    lease = StubLease(is_leader=False, generation=0,
+                      knob_epoch=acted[0])
+    loop = ControlLoop(
+        config, shard, HAActuator(inner, lease),
+        tick_gate=lambda _w: lease.is_leader
+        or loop.epoch < lease.knob_epoch)
+    loop.run_available()  # hot standby: gated at the watermark
+    assert loop.epoch == lease.knob_epoch
+    assert inner.epochs() == []  # prefix shadow-applied, nothing sent
+    assert 0 < len(loop.decisions) < len(oracle.decisions)
+    assert loop.pending_windows > 0  # the standby-lag surface
+
+    # the tracker steals the lease to this standby: takeover
+    lease.is_leader, lease.generation = True, 2
+    loop.run_available()
+    assert loop.pending_windows == 0
+    assert decision_sig(loop.decisions) == decision_sig(
+        oracle.decisions)
+    # published exactly the epochs beyond the dead leader's watermark
+    assert inner.epochs() == acted[1:]
+
+
+_KILL_CONTROLLER = r"""
+import os, signal, sys
+sys.path.insert(0, sys.argv[1])
+from hlsjs_p2p_wrapper_tpu.engine.controller import (
+    ControlConfig, ControlLoop, LogActuator)
+from hlsjs_p2p_wrapper_tpu.engine.search import Constraint
+from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+from hlsjs_p2p_wrapper_tpu.engine.tracer import FlightRecorder
+from hlsjs_p2p_wrapper_tpu.testing.twin import TwinScenario
+
+
+class KilledAfterPublish(LogActuator):
+    # SIGKILL in the ISSUE's window: after the knob publish reached
+    # its externally visible effect, before the loop checkpoints
+    def actuate(self, epoch, knobs):
+        ok = super().actuate(epoch, knobs)
+        os.kill(os.getpid(), signal.SIGKILL)
+        return ok
+
+
+shard, actuate_log, trace_dir, checkpoint = sys.argv[2:6]
+# MUST mirror ha_scenario(False) + ha_config: the parent's
+# resume-replay re-derives this run's decisions from the same pair
+spec = TwinScenario(seed=0, n_peers=8, wave_peers=4, watch_s=96.0,
+                    uplink_bps=900_000.0, cdn_bps=1_200_000.0)
+config = ControlConfig(
+    spec=spec, knob_grid={"p2p_budget_cap_ms": [500.0, 6000.0]},
+    initial_knobs={"p2p_budget_cap_ms": 6000.0},
+    constraint=Constraint.parse("rebuffer<=0.25"),
+    bands={}, warmup_windows=1)
+recorder = FlightRecorder(trace_dir, "ctrl-kill",
+                          registry=MetricsRegistry())
+loop = ControlLoop(config, shard, KilledAfterPublish(actuate_log),
+                   recorder=recorder, checkpoint_path=checkpoint)
+loop.run_available()
+"""
+
+
+def test_sigkill_between_publish_and_checkpoint_leaves_durable_mark(
+        ha_plane, tmp_path):
+    """The checkpoint-after-actuation window, directed: a controller
+    SIGKILLed the instant its first publish lands (checkpoint never
+    written) must leave the flushed ``actuation`` intent mark in its
+    flight-recorder shard — the durable witness the fleet gate's
+    exactly-once proof counts — and a resumed replay re-derives the
+    published epoch WITHOUT re-marking or re-publishing it."""
+    import signal
+    import subprocess
+    import sys as _sys
+
+    from hlsjs_p2p_wrapper_tpu.engine.controller import (
+        control_checkpoint_path)
+    from hlsjs_p2p_wrapper_tpu.engine.tracer import merge_trace
+
+    spec, shard = ha_plane
+    if spec.fault_specs:
+        pytest.skip("one variant suffices for the kill window")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    actuate_log = str(tmp_path / "actuate.jsonl")
+    trace_dir = str(tmp_path / "ctrl-trace")
+    checkpoint = control_checkpoint_path(str(tmp_path / "cache"),
+                                         ha_config(spec))
+    proc = subprocess.run(
+        [_sys.executable, "-c", _KILL_CONTROLLER,
+         repo, shard, actuate_log, trace_dir, checkpoint],
+        capture_output=True, text=True, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    # the last checkpoint written predates the publish (the warmup
+    # hold's): the kill landed squarely in the window where durable
+    # loop state does NOT know the epoch that just reached the world
+    with open(checkpoint, encoding="utf-8") as fh:
+        assert json.load(fh)["epoch"] == 0
+
+    # the durable intent mark survived the kill, epoch + role named
+    marks = [e for e in merge_trace(trace_dir)
+             if e.get("kind") == "mark"
+             and e.get("name") == "actuation"]
+    assert [m["epoch"] for m in marks] == [1]
+    assert marks[0]["role"] == "sole"
+    with open(actuate_log, encoding="utf-8") as fh:
+        published = [json.loads(line)["epoch"] for line in fh]
+    assert published == [1]  # the publish the checkpoint missed
+
+    # resume-replay: the log's epoch gates both the republish AND the
+    # intent mark, so the crash window can never double-actuate
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+    from hlsjs_p2p_wrapper_tpu.engine.tracer import FlightRecorder
+
+    config = ha_config(spec)
+    recorder = FlightRecorder(trace_dir, "ctrl-resume",
+                              registry=MetricsRegistry())
+    loop = ControlLoop(config, shard, LogActuator(actuate_log),
+                       recorder=recorder,
+                       checkpoint_path=checkpoint)
+    assert loop.resume() is True  # the stale pre-publish checkpoint
+    assert loop.epoch == 0  # ...which never saw the published epoch
+    loop.run_available()
+    recorder.close()
+    acted = [d["epoch"] for d in loop.decisions
+             if d["action"] == "actuate"]
+    assert acted and acted[0] == 1
+    with open(actuate_log, encoding="utf-8") as fh:
+        published = [json.loads(line)["epoch"] for line in fh]
+    assert published == acted  # each epoch exactly once, in order
+    marks = {}
+    for event in merge_trace(trace_dir):
+        if event.get("kind") == "mark" \
+                and event.get("name") == "actuation":
+            marks[event["epoch"]] = marks.get(event["epoch"], 0) + 1
+    assert marks == {e: 1 for e in acted}  # one witness per epoch

@@ -590,3 +590,94 @@ def test_frame_quantile_columns_are_canonical():
         assert name in FRAME_COLUMNS
     assert FRAME_COLUMNS.index("rebuffer_ms_p50") \
         < FRAME_COLUMNS.index("rebuffer_ms_p99")
+
+
+# -- multi-host sampler ingest (round 18) ------------------------------
+# tools/sampler_host.py run in-process: the fleet gate proves the
+# same properties across real process boundaries; this tier pins the
+# scoping/merge arithmetic where a debugger can reach it.
+
+
+def load_sampler_host():
+    import importlib.util
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "sampler_host", os.path.join(root, "tools",
+                                     "sampler_host.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+FLEET_SPEC = None  # TwinScenario, built lazily (imports testing.twin)
+
+
+def fleet_spec():
+    global FLEET_SPEC
+    if FLEET_SPEC is None:
+        from hlsjs_p2p_wrapper_tpu.testing.twin import TwinScenario
+        # 6 peers: crc32 scoping sends p0..p3 to host 1, p4..p5 to
+        # host 0 — both slices non-empty at n_hosts=2
+        FLEET_SPEC = TwinScenario(seed=0, n_peers=6, wave_peers=0,
+                                  watch_s=64.0)
+    return FLEET_SPEC
+
+
+def test_host_scoped_shards_merge_bit_identical_to_single_capture(
+        tmp_path):
+    """The replicated-world contract: N hosts each recording only
+    their crc32-assigned peer slice merge to EXACTLY the frames one
+    host recording everything produces — not approximately, not
+    modulo ordering; ``==`` on the whole frame set.  This is the
+    property that lets the fleet gate treat the mux output as THE
+    swarm observation rather than N partial views."""
+    sh = load_sampler_host()
+    spec = fleet_spec()
+    single = sh.run_host(spec, str(tmp_path / "one"), 0, 1)
+    r0 = sh.run_host(spec, str(tmp_path / "two"), 0, 2)
+    r1 = sh.run_host(spec, str(tmp_path / "two"), 1, 2)
+    merged = frames_from_shards([r0["shard"], r1["shard"]])
+    assert merged == frames_from_shards([single["shard"]])
+    assert merged.n_windows == single["windows"]
+    # the slices are genuinely disjoint, not two full copies: every
+    # peer-scoped counter bump landed on exactly one host
+    from hlsjs_p2p_wrapper_tpu.engine.tracer import read_shard
+
+    def counter_events(path):
+        _meta, events = read_shard(path)
+        return sum(1 for e in events if e.get("kind") == "counter")
+
+    full = counter_events(single["shard"])
+    ca, cb = counter_events(r0["shard"]), counter_events(r1["shard"])
+    assert 0 < ca < full and 0 < cb < full
+    assert ca + cb == full
+
+
+def test_skewed_host_clock_merges_on_window_index(tmp_path):
+    """A host whose recorder clock runs 750 ms ahead (loose fleet
+    NTP) must not shift its contribution into neighbouring windows:
+    the merge keys on the window INDEX carried by every sampler
+    mark, so window count, timeline, byte rates, and membership stay
+    bit-identical to the unskewed merge.  Only the wall-clock-derived
+    ``rebuffer`` ratio column is allowed to move (stall time is
+    measured against the host's own clock), and the skewed merge
+    itself must stay deterministic run to run."""
+    sh = load_sampler_host()
+    spec = fleet_spec()
+    r0 = sh.run_host(spec, str(tmp_path / "flat"), 0, 2)
+    r1 = sh.run_host(spec, str(tmp_path / "flat"), 1, 2)
+    flat = frames_from_shards([r0["shard"], r1["shard"]])
+    s0 = sh.run_host(spec, str(tmp_path / "skew-a"), 0, 2)
+    s1 = sh.run_host(spec, str(tmp_path / "skew-b"), 1, 2,
+                     skew_ms=750.0)
+    skewed = frames_from_shards([s0["shard"], s1["shard"]])
+    assert skewed.n_windows == flat.n_windows
+    moved = [c for c in FRAME_COLUMNS
+             if skewed.column(c) != flat.column(c)]
+    assert moved in ([], ["rebuffer"])
+    s0b = sh.run_host(spec, str(tmp_path / "skew2-a"), 0, 2)
+    s1b = sh.run_host(spec, str(tmp_path / "skew2-b"), 1, 2,
+                      skew_ms=750.0)
+    again = frames_from_shards([s0b["shard"], s1b["shard"]])
+    assert again == skewed

@@ -46,6 +46,8 @@ VALID = [
     P.Leave("swarm-abc", "peer-1"),
     P.SetKnobs("swarm-abc", 3, (("urgent_margin_s", 6.5),)),
     P.KnobUpdate("swarm-abc", 3, (("p2p_budget_cap_ms", 500.0),)),
+    P.CtrlLease("swarm-abc", "ctrl-a", 2, 1500),
+    P.CtrlLeaseAck("swarm-abc", "ctrl-a", 2, 1500, True, 7),
 ]
 
 
@@ -71,7 +73,7 @@ def test_random_bytes_with_valid_header_prefix():
     # force past the magic/version gate so the per-type parsers (the
     # interesting code) see the hostile bytes
     rng = random.Random(0xBEEF)
-    types = list(range(0x00, 0x14)) + [0x7F, 0xFF]
+    types = list(range(0x00, 0x17)) + [0x7F, 0xFF]
     for _ in range(6000):
         t = rng.choice(types)
         n = rng.randrange(0, 120)
@@ -530,3 +532,181 @@ def test_agent_counts_knob_decode_rejects_and_applies_by_epoch():
         assert applies == 1  # one epoch, one apply — replays gated
     finally:
         agent.dispose()
+
+
+# -- controller-lease messages (round 18) -------------------------------
+# CTRL_LEASE / CTRL_LEASE_ACK arbitrate WHICH controller may publish
+# at all — a decode escape or a forged generation here is not a lost
+# frame, it is a fenced/deposed-leader confusion — so the HA pair's
+# two messages get the directed exhaustive treatment of rounds
+# 9/10/13: round-trip over edge shapes (u32 generation/TTL edges
+# included), every-prefix truncation rejection, forged
+# granted/generation bytes, refusal of unrepresentable fields at
+# encode, and COUNTED reject paths on both dispatchers.
+
+LEASE_MSGS = [
+    P.CtrlLease("swarm-abc", "ctrl-a", 0, 1500),    # fresh claim
+    P.CtrlLease("swarm-abc", "ctrl-a", 3, 1500),    # renewal form
+    P.CtrlLease("", "", 0, 0),                      # empty ids, 0 TTL
+    P.CtrlLease("s" * 300, "ümlaut-☃",              # long + non-ASCII
+                0xFFFFFFFF, 0xFFFFFFFF),            # u32 edges
+    P.CtrlLeaseAck("swarm-abc", "ctrl-a", 1, 1500, True, 0),
+    P.CtrlLeaseAck("swarm-abc", "ctrl-b", 2, 750, False, 7),
+    P.CtrlLeaseAck("", "", 0, 0, False, 0),
+    P.CtrlLeaseAck("s" * 300, "péer-☃",
+                   0xFFFFFFFF, 0xFFFFFFFF, True, 0xFFFFFFFF),
+]
+
+
+def _lease_id(m):
+    return f"{type(m).__name__}-g{m.generation}-t{m.ttl_ms}"
+
+
+@pytest.mark.parametrize("msg", LEASE_MSGS, ids=_lease_id)
+def test_lease_messages_round_trip(msg):
+    """encode → decode is the identity for every lease-message
+    shape: fresh claims (generation 0), renewals, empty/long/unicode
+    ids, u32-edge generations and TTLs, both grant verdicts."""
+    frame = P.encode(msg)
+    assert P.decode(frame) == msg
+    assert P.encode(P.decode(frame)) == frame  # canonical both ways
+
+
+@pytest.mark.parametrize("msg", LEASE_MSGS, ids=_lease_id)
+def test_lease_messages_every_truncation_rejected(msg):
+    """EVERY proper prefix of every lease frame must raise
+    ProtocolError — never struct.error (the trailing u32 pair and
+    the ack's IIBI tail are translated at the decode boundary), and
+    never decode to a message."""
+    frame = P.encode(msg)
+    for cut in range(len(frame)):
+        with pytest.raises(P.ProtocolError):
+            P.decode(frame[:cut])
+
+
+@pytest.mark.parametrize("make", [
+    lambda: P.encode(P.CtrlLease("s", "a", 1, 2)) + b"\x00",
+    lambda: P.encode(
+        P.CtrlLeaseAck("s", "a", 1, 2, True, 3)) + b"\x00",
+    # the granted byte is canonical: exactly 0 or 1 — a decoder
+    # lax about truthiness would accept two byte strings for one
+    # message (protocol-confusion foothold)
+    lambda: P._frame(P.MsgType.CTRL_LEASE_ACK,
+                     P._pack_str("s") + P._pack_str("a")
+                     + struct.pack("<IIBI", 1, 2, 2, 3)),
+    lambda: P._frame(P.MsgType.CTRL_LEASE_ACK,
+                     P._pack_str("s") + P._pack_str("a")
+                     + struct.pack("<IIBI", 1, 2, 0xFF, 3)),
+    # hostile UTF-8 in each string field position
+    lambda: P._frame(P.MsgType.CTRL_LEASE,
+                     BAD + GOOD + struct.pack("<II", 1, 2)),
+    lambda: P._frame(P.MsgType.CTRL_LEASE,
+                     GOOD + BAD + struct.pack("<II", 1, 2)),
+], ids=["lease-trailing", "ack-trailing", "granted-2", "granted-ff",
+        "lease-bad-swarm", "lease-bad-ctrl"])
+def test_lease_forged_fields_rejected(make):
+    with pytest.raises(P.ProtocolError):
+        P.decode(make())
+
+
+def test_lease_fields_outside_u32_refused_at_encode():
+    """The wire carries generation and TTL as u32; the encoder
+    refuses anything it could not represent faithfully — a silently
+    wrapped generation would UNDO a fencing epoch."""
+    for gen in (-1, 0x1_0000_0000):
+        with pytest.raises(P.ProtocolError):
+            P.encode(P.CtrlLease("s", "a", gen, 1500))
+    with pytest.raises(P.ProtocolError):
+        P.encode(P.CtrlLease("s", "a", 1, -1))
+    with pytest.raises(P.ProtocolError):
+        P.encode(P.CtrlLeaseAck("s", "a", 0x1_0000_0000, 1, True, 0))
+    with pytest.raises(P.ProtocolError):
+        P.encode(P.CtrlLeaseAck("s", "a", 1, 1, True, -1))
+
+
+def test_tracker_endpoint_counts_lease_decode_rejects():
+    """A hostile/truncated CTRL_LEASE on the tracker dispatch is a
+    counted ``tracker.decode_rejects`` drop — and the lease store is
+    untouched, so a later well-formed claim is a clean generation-1
+    grant."""
+    from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+    from hlsjs_p2p_wrapper_tpu.engine.tracker import (Tracker,
+                                                      TrackerEndpoint)
+    from hlsjs_p2p_wrapper_tpu.engine.transport import LoopbackNetwork
+
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=1.0)
+    registry = MetricsRegistry()
+    tracker = Tracker(clock, registry=registry)
+    TrackerEndpoint(tracker, net.register("tracker"))
+    ctrl = net.register("ctrl")
+    acks = []
+    ctrl.on_receive = lambda src, frame: acks.append(P.decode(frame))
+    hostile = [
+        P.encode(P.CtrlLease("s", "ctrl", 0, 1500))[:-2],
+        P._frame(P.MsgType.CTRL_LEASE, b"\xff\xff"),
+        P._frame(P.MsgType.CTRL_LEASE,
+                 BAD + GOOD + struct.pack("<II", 0, 1500)),
+    ]
+    for frame in hostile:
+        ctrl.send("tracker", frame)
+    clock.advance(20.0)
+    assert registry.counter("tracker.decode_rejects").value \
+        == len(hostile)
+    assert tracker.ctrl_lease_state("s") is None  # store untouched
+    # the dispatch survived: a valid claim lands and is acked
+    ctrl.send("tracker", P.encode(P.CtrlLease("s", "ctrl", 0, 1500)))
+    clock.advance(20.0)
+    assert tracker.ctrl_lease_state("s")[:2] == ("ctrl", 1)
+    assert acks and acks[-1].granted \
+        and acks[-1].leader_id == "ctrl" and acks[-1].generation == 1
+
+
+def test_lease_client_counts_decode_rejects():
+    """The CLIENT dispatch path (engine/controller.py LeaseClient):
+    an undecodable frame claiming to come from the tracker is a
+    counted ``control.lease.decode_rejects`` drop that never kills
+    the receive path — the next well-formed ack still flips the
+    client to leader.  A FORGED ack naming another leader at a
+    higher generation is wire-valid, so it must deterministically
+    DEPOSE the client (refused + transition counted) rather than
+    confuse it: fencing trusts the tracker channel's content, and
+    the tracker's generation check refuses the deposed client's
+    publishes regardless of what it believed."""
+    from hlsjs_p2p_wrapper_tpu.core.clock import VirtualClock
+    from hlsjs_p2p_wrapper_tpu.engine.controller import LeaseClient
+    from hlsjs_p2p_wrapper_tpu.engine.telemetry import MetricsRegistry
+    from hlsjs_p2p_wrapper_tpu.engine.transport import LoopbackNetwork
+
+    clock = VirtualClock()
+    net = LoopbackNetwork(clock, default_latency_ms=1.0)
+    registry = MetricsRegistry()
+    tracker_ep = net.register("tracker")
+    lease = LeaseClient(net.register("ctrl-a"), "s", "ctrl-a",
+                        registry=registry)
+    hostile = [b"", b"\xff\xff\xff\xff",
+               P.encode(P.CtrlLeaseAck("s", "ctrl-a", 1, 2000,
+                                       True, 0))[:-1]]
+    for frame in hostile:
+        tracker_ep.send("ctrl-a", frame)
+    clock.advance(20.0)
+    assert registry.counter(
+        "control.lease.decode_rejects").value == len(hostile)
+    assert not lease.is_leader  # truncated grant moved nothing
+    # the dispatch survived: a valid grant flips it to leader
+    tracker_ep.send("ctrl-a", P.encode(
+        P.CtrlLeaseAck("s", "ctrl-a", 1, 2000, True, 0)))
+    clock.advance(20.0)
+    assert lease.is_leader and lease.generation == 1
+    # forged deposition: higher generation, another leader
+    tracker_ep.send("ctrl-a", P.encode(
+        P.CtrlLeaseAck("s", "ctrl-z", 9, 2000, False, 4)))
+    clock.advance(20.0)
+    assert not lease.is_leader
+    assert lease.leader_id == "ctrl-z" and lease.leader_generation == 9
+    assert lease.knob_epoch == 4  # watermark rides the ack channel
+    refused = sum(v for labels, v in
+                  registry.series("control.lease.acks")
+                  if labels.get("result") == "refused")
+    assert refused == 1

@@ -40,6 +40,7 @@ import json
 import os
 import signal
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -48,7 +49,8 @@ from hlsjs_p2p_wrapper_tpu.engine.artifact_cache import (  # noqa: E402
     WarmStart, atomic_write_json,
     enable_persistent_compilation_cache)
 from hlsjs_p2p_wrapper_tpu.engine.controller import (  # noqa: E402
-    ControlConfig, ControlLoop, LogActuator, control_checkpoint_path)
+    ControlConfig, ControlLoop, HAActuator, LeaseClient, LogActuator,
+    TransportActuator, control_checkpoint_path)
 from hlsjs_p2p_wrapper_tpu.engine.search import Constraint  # noqa: E402
 from hlsjs_p2p_wrapper_tpu.testing.twin import TwinScenario  # noqa: E402
 
@@ -76,7 +78,10 @@ def load_config(spec_path: str) -> ControlConfig:
         swarm_id=spec.get("swarm_id", ""),
         warmup_windows=int(spec.get("warmup_windows", 2)),
         hysteresis_ticks=int(spec.get("hysteresis_ticks", 2)),
-        forecast_chunk=int(spec.get("forecast_chunk", 8)))
+        forecast_chunk=int(spec.get("forecast_chunk", 8)),
+        slo_specs=spec.get("slo_specs"),
+        cohorts=spec.get("cohorts"),
+        slo_warmup_windows=spec.get("slo_warmup_windows"))
 
 
 class _KillingActuator:
@@ -98,6 +103,51 @@ class _KillingActuator:
             os.kill(os.getpid(), signal.SIGKILL)
         return ok
 
+    def publishes(self, epoch: int) -> bool:
+        return self.inner.publishes(epoch)
+
+
+class _KillingHAActuator:
+    """HA chaos hook: let the Nth PUBLISHED epoch land fleet-wide
+    (wait for the tracker's ack — the epoch must be visible so the
+    standby's takeover has a watermark to prove it against), then
+    SIGKILL — after the actuation became durable and fleet-visible,
+    BEFORE the tick checkpoints.  The nastiest leader death: the
+    successor must neither repeat nor skip the epoch the dead
+    leader's checkpoint never heard about."""
+
+    def __init__(self, inner: HAActuator, kill_at: int):
+        self.inner = inner
+        self.kill_at = kill_at
+        self.count = 0
+
+    @property
+    def acked_epoch(self) -> int:
+        return self.inner.acked_epoch
+
+    @property
+    def role(self) -> str:
+        return self.inner.role
+
+    def publishes(self, epoch: int) -> bool:
+        return self.inner.publishes(epoch)
+
+    def actuate(self, epoch: int, knobs) -> bool:
+        published = self.inner.publishes(epoch)
+        ok = self.inner.actuate(epoch, knobs)
+        if ok and published:
+            self.count += 1
+            if self.count >= self.kill_at:
+                deadline = time.monotonic() + 15.0  # clock-ok: real wire
+                while self.inner.inner.acked_epoch < epoch \
+                        and time.monotonic() < deadline:  # clock-ok
+                    self.inner.inner.actuate(
+                        epoch, knobs,
+                        generation=self.inner.lease.generation)
+                    time.sleep(0.05)  # clock-ok: real-socket pacing
+                os.kill(os.getpid(), signal.SIGKILL)
+        return ok
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
@@ -109,9 +159,11 @@ def main() -> int:
                          "window clock by the ShardMuxFollower — "
                          "decisions are bit-identical to the "
                          "single-shard ingest of the same traffic)")
-    ap.add_argument("--actuate-log", required=True,
+    ap.add_argument("--actuate-log", default=None,
                     help="append-only fsync'd actuation JSONL (the "
-                         "idempotent-by-epoch external effect)")
+                         "idempotent-by-epoch external effect; "
+                         "required unless --tracker-peer routes "
+                         "actuation onto the live wire)")
     ap.add_argument("--cache-dir", default=None,
                     help="warm-start cache root (forecast row cache "
                          "+ AOT executables + checkpoint)")
@@ -137,28 +189,130 @@ def main() -> int:
                          "settle).  0 (default) waits forever — a "
                          "truncated shard then truncates the "
                          "decision sequence too")
+    ha = ap.add_argument_group(
+        "HA fleet mode", "run as one member of a leader-fenced "
+        "controller pair: lease arbitration and SET_KNOBS both ride "
+        "a live TCP tracker (PSK from the P2P_SWARM_PSK env var, "
+        "never argv)")
+    ha.add_argument("--tracker-peer", default=None, metavar="HOST:PORT",
+                    help="the tracker endpoint's dialable peer id; "
+                         "presence selects HA mode")
+    ha.add_argument("--controller-id", default="ctrl-a",
+                    help="this member's identity (lease holder name, "
+                         "recorder host id, checkpoint instance)")
+    ha.add_argument("--lease-ttl-ms", type=float, default=1500.0)
+    ha.add_argument("--trace-dir", default=None,
+                    help="this member's flight-recorder shard root "
+                         "(durable actuation intents + lease events "
+                         "— the fleet gate's exactly-once stream)")
+    ha.add_argument("--assume-leader-generation", type=int, default=0,
+                    metavar="GEN",
+                    help="CHAOS: believe we hold the lease at GEN "
+                         "without asking the tracker (the "
+                         "resurrected-zombie harness; lease pumping "
+                         "is disabled so the delusion persists — "
+                         "the tracker's generation fence must "
+                         "refuse every resulting publish)")
+    ha.add_argument("--kill-after-published-epochs", type=int,
+                    default=0, metavar="N",
+                    help="HA chaos: SIGKILL self once the N-th "
+                         "published epoch is tracker-acked "
+                         "(fleet-visible), before its checkpoint")
+    ha.add_argument("--poll-interval-s", type=float, default=0.05)
+    ha.add_argument("--idle-exit-polls", type=int, default=40,
+                    help="exit once leading with no pending windows "
+                         "and this many consecutive idle polls")
+    ha.add_argument("--max-wall-s", type=float, default=300.0)
     args = ap.parse_args()
+    if args.tracker_peer is None and args.actuate_log is None:
+        ap.error("--actuate-log is required outside HA mode")
 
     config = load_config(args.spec)
     warm = WarmStart(cache_dir=args.cache_dir)
     enable_persistent_compilation_cache(warm.cache_dir)
-    actuator = LogActuator(args.actuate_log)
-    if args.sigkill_at_actuation > 0:
-        actuator = _KillingActuator(actuator,
-                                    args.sigkill_at_actuation)
     shards = (args.shard[0] if len(args.shard) == 1
               else list(args.shard))
+    recorder = None
+    lease = None
+    network = None
+    if args.tracker_peer:
+        from hlsjs_p2p_wrapper_tpu.engine.net import TcpNetwork
+        from hlsjs_p2p_wrapper_tpu.engine.tracer import FlightRecorder
+        psk = os.environ.get("P2P_SWARM_PSK")
+        network = TcpNetwork(psk=psk.encode() if psk else None,
+                             registry=warm.registry)
+        endpoint = network.register()
+        inner = TransportActuator(endpoint, config.swarm_id,
+                                  tracker_peer_id=args.tracker_peer,
+                                  registry=warm.registry)
+        if args.trace_dir:
+            recorder = FlightRecorder(
+                args.trace_dir, args.controller_id,
+                registry=warm.registry,
+                counter_filter=lambda name:
+                name.startswith("control."))
+        lease = LeaseClient(endpoint, config.swarm_id,
+                            args.controller_id,
+                            tracker_peer_id=args.tracker_peer,
+                            ttl_ms=args.lease_ttl_ms,
+                            registry=warm.registry,
+                            recorder=recorder)
+        if args.assume_leader_generation > 0:
+            lease.assume(args.assume_leader_generation)
+        actuator = HAActuator(inner, lease, registry=warm.registry)
+        if args.kill_after_published_epochs > 0:
+            actuator = _KillingHAActuator(
+                actuator, args.kill_after_published_epochs)
+    else:
+        actuator = LogActuator(args.actuate_log)
+        if args.sigkill_at_actuation > 0:
+            actuator = _KillingActuator(actuator,
+                                        args.sigkill_at_actuation)
+    holder = {}
+
+    def standby_gate(_window: int) -> bool:
+        # the HOT-STANDBY pause: tick only what we lead, or what the
+        # fleet watermark proves the leader already landed (so every
+        # derived actuate shadow-applies, never publishes ahead)
+        loop_, lease_ = holder["loop"], holder["lease"]
+        return lease_.is_leader or loop_.epoch < lease_.knob_epoch
+
     loop = ControlLoop(
         config, shards, actuator, warm_start=warm,
-        registry=warm.registry,
-        checkpoint_path=control_checkpoint_path(warm.cache_dir,
-                                                config),
-        dead_after_polls=(args.dead_after_polls or None))
+        registry=warm.registry, recorder=recorder,
+        checkpoint_path=control_checkpoint_path(
+            warm.cache_dir, config,
+            instance=(args.controller_id if args.tracker_peer
+                      else "")),
+        dead_after_polls=(args.dead_after_polls or None),
+        tick_gate=(standby_gate if lease is not None
+                   and args.assume_leader_generation <= 0 else None))
+    holder["loop"], holder["lease"] = loop, lease
     resumed = False
     if args.resume:
         resumed = loop.resume()
-    loop.run_available()
-    if args.dead_after_polls:
+    if args.tracker_peer:
+        # the HA drive loop: pump one lease claim/renewal per poll
+        # (the tracker arbitrates; acks arrive on the reader
+        # threads), tick what the gate allows, checkpoint-and-exit
+        # once leading with a drained backlog and a settled mux
+        deadline = time.monotonic() + args.max_wall_s  # clock-ok:
+        # real-wire service loop (the engine stays injectable)
+        idle = 0
+        while time.monotonic() < deadline:  # clock-ok: ditto
+            if args.assume_leader_generation <= 0:
+                lease.request()
+            if loop.run_available():
+                idle = 0
+            else:
+                idle += 1
+            if lease.is_leader and loop.pending_windows == 0 \
+                    and idle >= args.idle_exit_polls:
+                break
+            time.sleep(args.poll_interval_s)  # clock-ok: ditto
+    else:
+        loop.run_available()
+    if args.dead_after_polls and not args.tracker_peer:
         # offline replay against files that no longer grow: every
         # extra poll is pure stall evidence, so keep polling until
         # the dead-shard verdicts settle and no further merged
@@ -172,6 +326,10 @@ def main() -> int:
             else:
                 idle += 1
 
+    if recorder is not None:
+        recorder.close()
+    if network is not None:
+        network.close()
     doc = {
         "meta": {
             "spec": os.path.abspath(args.spec),
@@ -195,6 +353,17 @@ def main() -> int:
                              enumerate(loop.ingest.exclusions)
                              if shards],
     }
+    if lease is not None:
+        # the HA surface the console's --control panel renders
+        doc["lease"] = {
+            "controller_id": args.controller_id,
+            "is_leader": lease.is_leader,
+            "generation": lease.generation,
+            "leader_id": lease.leader_id,
+            "leader_generation": lease.leader_generation,
+            "knob_epoch": lease.knob_epoch,
+            "pending_windows": loop.pending_windows,
+        }
     if args.out:
         atomic_write_json(args.out, doc)
     actions = [d["action"] for d in loop.decisions]

@@ -272,6 +272,41 @@ def control_panel(events) -> list:
         f"  forecast rows: cache {rows.get('cache', 0)}, dispatch "
         f"{rows.get('dispatch', 0)}; ticks {total('ticks')}, "
         f"republishes {total('republishes')}")
+    # HA pair sub-panel (round 16): tracker-arbitrated controller
+    # lease plus fencing effects.  Pre-HA artifacts carry none of
+    # these events, so the panel above renders unchanged for them.
+    leases = [e for e in events if e.get("kind") == "lease"
+              and e.get("scope") == "ctrl"]
+    fenced = by_label("publish_fenced", "role")
+    shadows = total("shadow_applies")
+    if leases or fenced or shadows:
+        if leases:
+            last = leases[-1]
+            lines.append(
+                f"  lease: leader {last.get('leader')} at "
+                f"generation {last.get('gen')} "
+                f"(ttl {last.get('ttl_ms')} ms, acked knob epoch "
+                f"{last.get('knob_epoch')})")
+        # a hot standby re-derives the leader's decision prefix, so
+        # its last tick trailing the fleet's newest IS the takeover
+        # replay debt it would pay on a failover
+        newest_tick = max((t.get("tick", 0) for t in ticks),
+                          default=0)
+        last_by_host = {}
+        for t in ticks:
+            last_by_host[t.get("host", "?")] = t
+        if len(last_by_host) > 1:
+            lines.append("  pair: " + ", ".join(
+                f"{host} at tick {t.get('tick')} "
+                f"(lag {newest_tick - t.get('tick', 0)})"
+                for host, t in sorted(last_by_host.items())))
+        if fenced or shadows:
+            lines.append(
+                "  fencing: publishes fenced "
+                + (", ".join(f"{role}={n}"
+                             for role, n in sorted(fenced.items()))
+                   or "0")
+                + f", shadow applies {shadows}")
     return lines
 
 

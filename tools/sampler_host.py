@@ -1,0 +1,167 @@
+"""One sampler host of the fleet gate's multi-process observation
+plane.
+
+The fleet-control gate (tools/fleet_control_gate.py) launches N of
+these as SEPARATE PROCESSES.  Each runs the SAME seeded two-cohort
+swarm simulation deterministically — the replicated-world idiom: a
+real deployment's N hosts each observe their OWN peers of one shared
+swarm; here N processes each re-derive the shared swarm from the seed
+and record only their assigned slice — and writes one binary
+flight-recorder shard into the shared trace directory:
+
+- **peer scoping**: the recorder's label-aware ``bump_filter``
+  (testing/twin.host_bump_filter) keeps a ``twin.*`` bump iff
+  ``crc32(peer) % n_hosts == host_index`` — the SAME formula
+  ``split_shard`` uses, so the N live shards are mux-identical to a
+  re-shard of the single-host capture, which is what makes the merge
+  provable;
+- **loosely synchronized clocks**: ``--skew-ms`` offsets this host's
+  recorder clock, so merged ordering must come from the window INDEX
+  carried on every sampler mark, never from comparing host clocks;
+- **death mid-run**: ``--die-after-window K`` SIGKILLs the process
+  right after window K's mark is flushed (``flush_every=1`` — live
+  tail discipline), leaving a torn-tail-legal shard whose watermark
+  stalls: the mux must declare it dead and close later windows
+  without it, excluded-and-counted.
+
+Cohorts and chaos mirror tools/slo_gate.py: the back half of the
+audience is the "cellular" region (long P2P budgets); with
+``--regional-loss`` every link touching it drops all frames for the
+middle of the watch — the SLO-burn fuel for the controller pair
+downstream.
+
+Prints one ``RESULT {json}`` line (windows closed, events recorded)
+on clean exit; a host told to die mid-run obviously prints nothing.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from hlsjs_p2p_wrapper_tpu.engine.tracer import (  # noqa: E402
+    FlightRecorder)
+from hlsjs_p2p_wrapper_tpu.testing.swarm import (  # noqa: E402
+    SwarmHarness)
+from hlsjs_p2p_wrapper_tpu.testing.twin import (  # noqa: E402
+    TwinScenario, TwinSampler, _is_twin_family, host_bump_filter)
+
+#: the two delivery cohorts (tools/slo_gate.py's shapes): broadband
+#: fails over to the CDN fast, cellular rides long P2P budgets — the
+#: regional loss window hits every link touching the cellular region
+BROADBAND_CFG = {"p2p_budget_cap_ms": 400.0,
+                 "p2p_budget_fraction": 0.5}
+CELLULAR_CFG = {"p2p_budget_cap_ms": 6000.0,
+                "p2p_budget_fraction": 0.9}
+
+#: the regional loss window (seconds on the scenario clock)
+LOSS_START_S, LOSS_END_S = 64.0, 128.0
+
+
+def cellular_ids(spec: TwinScenario) -> frozenset:
+    total = spec.total_peers
+    return frozenset(f"p{i}" for i in range(total // 2, total))
+
+
+def run_host(spec: TwinScenario, trace_dir: str, host_index: int,
+             n_hosts: int, *, skew_ms: float = 0.0,
+             die_after_window: int = -1,
+             regional_loss: bool = False) -> dict:
+    """Run the replicated swarm and record this host's slice.
+    Returns a small result dict (the RESULT line's payload)."""
+    harness = SwarmHarness(
+        seg_duration=spec.seg_duration_s, frag_count=spec.frag_count,
+        level_bitrates=tuple(int(b) for b in spec.level_bitrates),
+        cdn_bandwidth_bps=spec.cdn_bps,
+        cdn_latency_ms=spec.cdn_latency_ms, seed=spec.seed)
+    cellular = cellular_ids(spec)
+    recorder = FlightRecorder(
+        trace_dir, f"fleet{host_index:02d}",
+        clock=(lambda: harness.clock.now() + skew_ms),
+        registry=harness.metrics,
+        counter_filter=_is_twin_family,
+        bump_filter=(host_bump_filter(host_index, n_hosts)
+                     if n_hosts > 1 else None),
+        binary=True)
+
+    def maybe_die(window_index: int) -> None:
+        if 0 <= die_after_window <= window_index:
+            # the window's mark is already flushed (flush_every=1):
+            # the shard dies torn-tail-legal with K+1 durable windows
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    sampler = TwinSampler(harness, spec.window_s * 1000.0,
+                          recorder=recorder, flush_every=1,
+                          on_window=maybe_die)
+    all_ids = [f"p{i}" for i in range(spec.total_peers)]
+    if regional_loss:
+        def set_region_loss(rate):
+            for cell in sorted(cellular):
+                for other in all_ids:
+                    if other != cell:
+                        harness.network.set_link(cell, other,
+                                                 loss_rate=rate)
+        harness.clock.call_later(LOSS_START_S * 1000.0,
+                                 lambda: set_region_loss(1.0))
+        harness.clock.call_later(LOSS_END_S * 1000.0,
+                                 lambda: set_region_loss(0.0))
+    joins = spec.join_times_s()
+    for i in sorted(range(len(joins)), key=lambda i: (joins[i], i)):
+        harness.run(max(joins[i] * 1000.0 - harness.clock.now(), 0.0))
+        peer = f"p{i}"
+        harness.add_peer(
+            peer, uplink_bps=spec.uplink_bps,
+            p2p_config=dict(CELLULAR_CFG if peer in cellular
+                            else BROADBAND_CFG))
+    harness.run(spec.watch_s * 1000.0 - harness.clock.now())
+    recorder.close()
+    return {"host": host_index, "shard": recorder.path,
+            "windows": sampler.windows,
+            "peers": sorted(p for p in all_ids)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--trace-dir", required=True)
+    ap.add_argument("--host-index", type=int, required=True)
+    ap.add_argument("--n-hosts", type=int, required=True)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--peers", type=int, default=8)
+    ap.add_argument("--wave", type=int, default=4)
+    ap.add_argument("--uplink-bps", type=float, default=None,
+                    help="override the scenario's per-peer uplink "
+                         "(the gate's scarce-supply family)")
+    ap.add_argument("--cdn-bps", type=float, default=None)
+    ap.add_argument("--skew-ms", type=float, default=0.0,
+                    help="recorder clock offset: this host's clock "
+                         "runs this many ms ahead of the scenario "
+                         "clock (loose fleet synchronization)")
+    ap.add_argument("--die-after-window", type=int, default=-1,
+                    metavar="K",
+                    help="SIGKILL self right after window K's mark "
+                         "flushes (dead-shard chaos); -1 disables")
+    ap.add_argument("--regional-loss", action="store_true",
+                    help="arm the cellular-region loss window")
+    args = ap.parse_args()
+
+    fields = {"seed": args.seed, "n_peers": args.peers,
+              "wave_peers": args.wave}
+    if args.uplink_bps is not None:
+        fields["uplink_bps"] = args.uplink_bps
+    if args.cdn_bps is not None:
+        fields["cdn_bps"] = args.cdn_bps
+    spec = TwinScenario(**fields)
+    result = run_host(spec, args.trace_dir, args.host_index,
+                      args.n_hosts, skew_ms=args.skew_ms,
+                      die_after_window=args.die_after_window,
+                      regional_loss=args.regional_loss)
+    print("RESULT " + json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
